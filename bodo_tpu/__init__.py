@@ -1,0 +1,53 @@
+"""bodo_tpu — a TPU-native distributed dataframe engine.
+
+Re-implements the capabilities of the reference engine (bodo-ai/Bodo: a
+Numba+MPI+C++ distributed dataframe/SQL engine) as an idiomatic JAX/XLA
+stack: columnar tables live in device HBM as padded struct-of-arrays,
+relational kernels are jit-traced XLA programs (segment reductions, sorts,
+Pallas hash kernels), and distribution is SPMD over a `jax.sharding.Mesh`
+with lax collectives instead of MPI (see SURVEY.md §7).
+
+Public surfaces (mirroring the reference's four):
+  - `bodo_tpu.jit`         — @jit decorator (reference bodo/decorators.py:338)
+  - `bodo_tpu.pandas_api`  — lazy drop-in dataframe library
+                             (reference bodo/pandas/frame.py:117)
+  - `bodo_tpu.sql`         — SQL context (reference BodoSQL/bodosql/context.py:504)
+  - `bodo_tpu.ml`          — distributed ML (reference bodo/ml_support/)
+"""
+
+import jax
+
+# Dataframe engines need real 64-bit ints/floats; enable before any trace.
+jax.config.update("jax_enable_x64", True)
+
+from bodo_tpu.config import config, set_config, set_verbose_level  # noqa: E402
+from bodo_tpu.parallel.mesh import (  # noqa: E402
+    get_mesh, set_mesh, use_mesh, make_mesh, num_shards, init_runtime,
+)
+from bodo_tpu.table.table import Table, Column  # noqa: E402
+from bodo_tpu.table import dtypes  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "config", "set_config", "set_verbose_level",
+    "get_mesh", "set_mesh", "use_mesh", "make_mesh", "num_shards",
+    "init_runtime", "Table", "Column", "dtypes", "jit",
+]
+
+
+def __getattr__(name):
+    # Lazy imports to keep `import bodo_tpu` light and avoid cycles.
+    if name == "jit":
+        from bodo_tpu.jit import jit as _jit
+        return _jit
+    if name == "pandas_api":
+        import bodo_tpu.pandas_api as m
+        return m
+    if name == "sql":
+        import bodo_tpu.sql as m
+        return m
+    if name == "ml":
+        import bodo_tpu.ml as m
+        return m
+    raise AttributeError(f"module 'bodo_tpu' has no attribute {name!r}")

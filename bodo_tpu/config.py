@@ -1,0 +1,110 @@
+"""Environment-driven configuration flags.
+
+TPU-native analogue of the reference engine's env-flag system
+(reference: bodo/__init__.py:109-236 — ~30 BODO_* flags read once at import
+into module globals). We keep the same "read once, module-global" model but
+expose a typed dataclass so tests can override via `set_config`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class Config:
+    # -- execution -----------------------------------------------------------
+    # Rows per streaming batch fed through the pipeline executor (analogue of
+    # the reference's bodosql_streaming_batch_size, bodo/__init__.py:114).
+    streaming_batch_size: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_STREAMING_BATCH_SIZE", 1 << 22)
+    )
+    # Pad table capacities up to a multiple of this (TPU lane friendliness).
+    capacity_round: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_CAPACITY_ROUND", 128)
+    )
+    # Re-bucket a table's physical capacity when occupancy falls below this.
+    rebucket_threshold: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_REBUCKET_THRESHOLD", 0.45)
+    )
+    # Mesh axis used for row sharding.
+    data_axis: str = field(default_factory=lambda: _env_str("BODO_TPU_DATA_AXIS", "d"))
+    # Skew headroom factor for all_to_all shuffle bucket capacity.
+    shuffle_skew_factor: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_SHUFFLE_SKEW", 2.0)
+    )
+    # Broadcast-join threshold: build side smaller than this many rows is
+    # all_gather'd instead of hash-shuffled (analogue of broadcast join,
+    # reference bodo/libs/_shuffle.h:153-210).
+    bcast_join_threshold: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_BCAST_JOIN_THRESHOLD", 1 << 20)
+    )
+    # -- frontend ------------------------------------------------------------
+    # Fall back to real pandas for unsupported args (reference:
+    # bodo/pandas/utils.py:346 check_args_fallback).
+    pandas_fallback: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_PANDAS_FALLBACK", True)
+    )
+    warn_fallback: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_WARN_FALLBACK", True)
+    )
+    # Dump optimized plans (analogue BODO_DATAFRAME_LIBRARY_DUMP_PLANS).
+    dump_plans: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_DUMP_PLANS", False)
+    )
+    # -- observability -------------------------------------------------------
+    # 0 = silent, 1 = pushdown/fallback notices, 2 = plan dumps, 3 = kernel trace
+    # (analogue of bodo.set_verbose_level, bodo/user_logging.py:1-40).
+    verbose_level: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_VERBOSE_LEVEL", 0)
+    )
+    tracing_level: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_TRACING_LEVEL", 0)
+    )
+    # -- numerics ------------------------------------------------------------
+    # Use bfloat16 accumulation for mean/var where tolerable (perf knob).
+    low_precision_agg: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_LOW_PRECISION_AGG", False)
+    )
+    # SQL plan cache directory (analogue BODO_SQL_PLAN_CACHE_DIR).
+    sql_plan_cache_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_SQL_PLAN_CACHE_DIR", "")
+    )
+
+
+config = Config()
+
+
+def set_config(**kwargs) -> None:
+    """Override config values at runtime (tests / notebooks)."""
+    valid = {f.name for f in fields(Config)}
+    for k, v in kwargs.items():
+        if k not in valid:
+            raise ValueError(f"unknown config key: {k}")
+        setattr(config, k, v)
+
+
+def set_verbose_level(level: int) -> None:
+    config.verbose_level = int(level)

@@ -1,0 +1,7 @@
+"""Relational operator kernels (XLA/jnp + Pallas).
+
+TPU-native equivalents of the reference's C++ kernel layer
+(bodo/libs/groupby/, _hash_join.cpp, _array_operations.cpp, streaming/):
+segment reductions for groupby, encoded multi-key sorts, compaction-based
+filters, sort-merge joins — all static-shape, padded, jit-traceable.
+"""

@@ -1,0 +1,237 @@
+"""Groupby aggregation kernels.
+
+TPU-native replacement for the reference's hash-groupby C++ family
+(bodo/libs/groupby/_groupby*.cpp, streaming/_groupby.cpp). Instead of
+hash tables we use the XLA-friendly sort+segment-reduce recipe
+(SURVEY.md §7): stable multi-key sort on encoded keys, segment ids from
+group boundaries, `jax.ops.segment_*` reductions onto the MXU/VPU.
+
+Aggregations are split into decomposable partial ops + combine + finalize
+(the same sum/count/sumsq decomposition the reference uses for its
+distributed combine step, bodo/libs/groupby/_groupby_update.cpp), which
+powers the two-phase distributed groupby: local pre-aggregation →
+hash-partition all_to_all shuffle → combine (parallel/shuffle.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bodo_tpu.ops import kernels as K
+from bodo_tpu.ops import sort_encoding as SE
+
+# ---------------------------------------------------------------------------
+# agg spec plumbing
+# ---------------------------------------------------------------------------
+
+# primitive ops computable in one segment pass
+_PRIMITIVE = {"sum", "sumsq", "count", "size", "min", "max", "first", "last",
+              "prod", "mean", "var", "std", "nunique"}
+
+# final op -> (partial ops, combine ops on partial cols)
+DECOMPOSE: Dict[str, List[str]] = {
+    "sum": ["sum"],
+    "prod": ["prod"],
+    "count": ["count"],
+    "size": ["size"],
+    "min": ["min"],
+    "max": ["max"],
+    "first": ["first"],
+    "last": ["last"],
+    "mean": ["sum", "count"],
+    "var": ["sum", "sumsq", "count"],
+    "std": ["sum", "sumsq", "count"],
+}
+COMBINE_OF = {"sum": "sum", "sumsq": "sum", "count": "sum", "size": "sum",
+              "min": "min", "max": "max", "first": "first", "last": "last",
+              "prod": "prod"}
+
+
+def result_dtype(op: str, dtype):
+    d = jnp.dtype(dtype)
+    if op in ("count", "size", "nunique"):
+        return jnp.dtype(jnp.int64)
+    if op in ("mean", "var", "std"):
+        return jnp.dtype(jnp.float32) if d == jnp.float32 else jnp.dtype(jnp.float64)
+    if op in ("sum", "sumsq", "prod"):
+        if jnp.issubdtype(d, jnp.floating):
+            return d
+        if jnp.issubdtype(d, jnp.unsignedinteger):
+            return jnp.dtype(jnp.uint64)
+        return jnp.dtype(jnp.int64)
+    return d  # min/max/first/last
+
+
+# ---------------------------------------------------------------------------
+# core local kernel
+# ---------------------------------------------------------------------------
+
+def _group_segments(keys: Sequence[Tuple], count):
+    """Sort rows by keys; return (perm, seg_ids, new_group, padmask_s,
+    n_groups). Null-keyed rows are excluded (pandas dropna=True)."""
+    cap = keys[0][0].shape[0]
+    padmask = K.row_mask(count, cap)
+    for data, valid in keys:
+        if valid is not None:
+            padmask = padmask & valid
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            padmask = padmask & ~jnp.isnan(data)
+
+    operands: list = []
+    for d, v in keys:
+        operands.extend(SE.key_operands(d, v, padmask=padmask))
+    num_key_ops = len(operands)
+    operands.append(jnp.arange(cap))
+    sorted_ops = lax.sort(tuple(operands), num_keys=num_key_ops,
+                          is_stable=True)
+    perm = sorted_ops[-1]
+    padmask_s = padmask[perm]
+
+    pos = jnp.arange(cap)
+    diff = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for data, _ in keys:
+        ks = data[perm]
+        diff = diff | (ks != jnp.roll(ks, 1))
+    new_group = padmask_s & (diff | (pos == 0))
+    seg = jnp.maximum(jnp.cumsum(new_group) - 1, 0)
+    n_groups = jnp.sum(new_group)
+    return perm, seg, new_group, padmask_s, n_groups
+
+
+def _segment_agg(op: str, v_s, valid_s, seg, padmask_s, out_cap: int):
+    """One primitive aggregation over sorted values. Returns (data, valid)."""
+    ok = K.value_ok(v_s, valid_s, padmask_s)
+    cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=out_cap)
+    rdt = result_dtype(op, v_s.dtype)
+
+    if op == "count":
+        return cnt, None
+    if op == "size":
+        sz = jax.ops.segment_sum(padmask_s.astype(jnp.int64), seg,
+                                 num_segments=out_cap)
+        return sz, None
+    if op in ("sum", "sumsq"):
+        v = v_s.astype(rdt)
+        if op == "sumsq":
+            v = v * v
+        s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
+        return s, None  # pandas: sum over all-null = 0
+    if op == "prod":
+        v = v_s.astype(rdt)
+        p = jax.ops.segment_prod(jnp.where(ok, v, 1), seg, num_segments=out_cap)
+        return p, None
+    if op in ("min", "max"):
+        if jnp.issubdtype(v_s.dtype, jnp.floating):
+            ident = jnp.array(np.inf if op == "min" else -np.inf, v_s.dtype)
+        elif v_s.dtype == jnp.bool_:
+            ident = jnp.array(op == "min", jnp.bool_)
+        else:
+            info = jnp.iinfo(v_s.dtype)
+            ident = jnp.array(info.max if op == "min" else info.min, v_s.dtype)
+        v = jnp.where(ok, v_s, ident)
+        f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = f(v, seg, num_segments=out_cap)
+        return out, cnt > 0
+    if op in ("first", "last"):
+        cap = v_s.shape[0]
+        if op == "first":
+            idx_enc = jnp.where(ok, jnp.arange(cap), cap)
+            idx = jax.ops.segment_min(idx_enc, seg, num_segments=out_cap)
+        else:
+            idx_enc = jnp.where(ok, jnp.arange(cap), -1)
+            idx = jax.ops.segment_max(idx_enc, seg, num_segments=out_cap)
+        has = (idx >= 0) & (idx < cap)
+        out = v_s[jnp.clip(idx, 0, cap - 1)]
+        out = jnp.where(has, out, 0)
+        return out, has
+    if op == "mean":
+        v = v_s.astype(rdt)
+        s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
+        m = s / jnp.maximum(cnt, 1)
+        return jnp.where(cnt > 0, m, jnp.nan), None
+    if op in ("var", "std"):
+        v = v_s.astype(rdt)
+        s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
+        s2 = jax.ops.segment_sum(jnp.where(ok, v * v, 0), seg,
+                                 num_segments=out_cap)
+        out = _var_from_moments(s, s2, cnt, ddof=1)
+        if op == "std":
+            out = jnp.sqrt(out)
+        return out, None
+    if op == "nunique":
+        raise NotImplementedError("nunique handled in groupby_local")
+    raise ValueError(f"unknown agg op: {op}")
+
+
+def _var_from_moments(s, s2, cnt, ddof: int = 1):
+    cntf = cnt.astype(s.dtype)
+    m = s / jnp.maximum(cntf, 1)
+    num = s2 - cntf * m * m
+    var = num / jnp.maximum(cntf - ddof, 1)
+    return jnp.where(cnt > ddof, jnp.maximum(var, 0), jnp.nan)
+
+
+@partial(jax.jit, static_argnames=("specs", "out_capacity", "num_keys"))
+def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
+                  num_keys: int):
+    """Local (single-shard) groupby.
+
+    arrays: tuple of (data, valid) — first `num_keys` are key columns, the
+    rest align 1:1 with `specs` (one value column per agg op; repeat the
+    column for multiple aggs on it).
+    Returns (out_keys, out_vals, n_groups); outputs sorted by key ascending
+    (pandas groupby sort=True).
+    """
+    keys = arrays[:num_keys]
+    values = arrays[num_keys:]
+    perm, seg, new_group, padmask_s, n_groups = _group_segments(keys, count)
+
+    out_keys = []
+    idx_scatter = jnp.where(new_group, seg, out_capacity)
+    for data, valid in keys:
+        k_s = data[perm]
+        z = jnp.zeros((out_capacity,), dtype=data.dtype)
+        out_keys.append((z.at[idx_scatter].set(k_s, mode="drop"), None))
+
+    out_vals = []
+    for (data, valid), op in zip(values, specs):
+        v_s = data[perm]
+        valid_s = valid[perm] if valid is not None else None
+        if op == "nunique":
+            out_vals.append(_nunique(keys, (data, valid), perm, seg,
+                                     padmask_s, out_capacity))
+        else:
+            out_vals.append(_segment_agg(op, v_s, valid_s, seg, padmask_s,
+                                         out_capacity))
+    return tuple(out_keys), tuple(out_vals), n_groups
+
+
+def _nunique(keys, value, perm, seg, padmask_s, out_cap: int):
+    """nunique per group: re-sort by (group seg, value), count distinct
+    adjacent values (reference analogue: groupby nunique path in
+    bodo/libs/groupby/_groupby_ftypes.cpp)."""
+    data, valid = value
+    cap = data.shape[0]
+    v_s = data[perm]
+    valid_s = valid[perm] if valid is not None else None
+    ok = K.value_ok(v_s, valid_s, padmask_s)
+    # non-ok rows (nulls/padding) get seg_key = cap and sort last; among ok
+    # rows the exact value encoding detects distinct adjacent values
+    enc_v = SE.encode_value(v_s)
+    seg_key = jnp.where(ok, seg, cap).astype(jnp.int64)
+    s_seg, s_val = lax.sort((seg_key.view(jnp.uint64), enc_v), num_keys=2,
+                            is_stable=False)
+    pos = jnp.arange(cap)
+    newv = (s_seg != jnp.roll(s_seg, 1)) | (s_val != jnp.roll(s_val, 1)) | (pos == 0)
+    okrow = s_seg < jnp.uint64(cap)
+    contrib = (newv & okrow).astype(jnp.int64)
+    out = jax.ops.segment_sum(contrib,
+                              jnp.minimum(s_seg, jnp.uint64(out_cap)).astype(jnp.int64),
+                              num_segments=out_cap + 1)[:out_cap]
+    return out, None

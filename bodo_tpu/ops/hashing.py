@@ -1,0 +1,69 @@
+"""Hashing kernels for shuffle partitioning and hash keys.
+
+Replaces the reference's xxh3/murmur3 C++ hashing
+(bodo/libs/_array_hash.cpp, vendored murmurhash3/xxhash) with a
+vectorized splitmix64-style finalizer that XLA maps onto the VPU.
+Collision-safety note: hashes are used only for *partitioning* (dest
+shard) and never for key equality — grouping/joins compare real key
+values — so 64-bit mixing quality is all we need.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_SEED_MIX = np.uint64(0x9E3779B97F4A7C15)
+
+
+def splitmix64(x):
+    """splitmix64 finalizer on uint64 lanes."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> np.uint64(30))) * _C1
+    x = (x ^ (x >> np.uint64(27))) * _C2
+    return x ^ (x >> np.uint64(31))
+
+
+def _to_u64(data):
+    dt = data.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        # canonicalize so equal keys hash equal: -0.0 -> +0.0, all NaN
+        # payloads -> one quiet NaN
+        data = data + jnp.zeros((), dt)
+        data = jnp.where(jnp.isnan(data), jnp.array(np.nan, dt), data)
+        if dt == jnp.float64:
+            return data.view(jnp.uint64)
+        return data.view(jnp.uint32).astype(jnp.uint64)
+    if dt == jnp.bool_:
+        return data.astype(jnp.uint64)
+    return data.astype(jnp.int64).view(jnp.uint64)
+
+
+def hash_column(data, valid=None):
+    """64-bit hash of one column; nulls hash to a fixed tag."""
+    h = splitmix64(_to_u64(data))
+    if valid is not None:
+        h = jnp.where(valid, h, np.uint64(0xDEAD_BEEF_CAFE_F00D))
+    return h
+
+
+def hash_columns(cols: Sequence[Tuple], seed: int = 0):
+    """Combined hash over multiple (data, valid) key columns — the
+    partition hash of the reference's shuffle (bodo/libs/_shuffle.h:9
+    `hash_to_bucket`)."""
+    acc = jnp.full(cols[0][0].shape, np.uint64(seed) + _SEED_MIX,
+                   dtype=jnp.uint64)
+    for data, valid in cols:
+        h = hash_column(data, valid)
+        acc = splitmix64(acc ^ (h + _SEED_MIX + (acc << np.uint64(6))
+                                + (acc >> np.uint64(2))))
+    return acc
+
+
+def dest_shard(hashes, num_shards: int):
+    """Destination shard for each row (hash_to_bucket analogue)."""
+    return (hashes % np.uint64(num_shards)).astype(jnp.int32)

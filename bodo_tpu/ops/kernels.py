@@ -1,0 +1,65 @@
+"""Shared kernel utilities: padding masks, null handling, compaction.
+
+Replaces the reference's C++ array utilities (bodo/libs/_array_utils.cpp,
+_array_build_buffer.cpp) with jit-traceable equivalents. All kernels obey
+the padded-capacity convention: arrays are fixed-capacity, the first
+`count` rows are real, the rest is padding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def row_mask(count, capacity: int):
+    """Boolean mask of real (non-padding) rows."""
+    return jnp.arange(capacity) < count
+
+
+def value_ok(data, valid, padmask):
+    """Mask of rows whose value participates in aggregation:
+    real row AND not null (explicit mask or float NaN)."""
+    ok = padmask
+    if valid is not None:
+        ok = ok & valid
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        ok = ok & ~jnp.isnan(data)
+    return ok
+
+
+def compact(mask, arrays: Tuple, capacity_out: Optional[int] = None):
+    """Stable-compact rows where `mask` is True to the front.
+
+    Returns (compacted arrays, new_count). Rows past new_count are zeroed.
+    This is the workhorse for filters and shuffle-receive cleanup — the
+    analogue of the reference's RetrieveTable/filter paths
+    (bodo/libs/_array_utils.cpp).
+    """
+    cap = mask.shape[0]
+    out_cap = capacity_out if capacity_out is not None else cap
+    pos = jnp.cumsum(mask) - 1
+    idx = jnp.where(mask, pos, out_cap)  # out-of-range rows dropped
+    outs = []
+    for a in arrays:
+        if a is None:
+            outs.append(None)
+            continue
+        z = jnp.zeros((out_cap,) + a.shape[1:], dtype=a.dtype)
+        outs.append(z.at[idx].set(a, mode="drop"))
+    return tuple(outs), jnp.sum(mask)
+
+
+def gather_rows(perm, arrays: Tuple):
+    """Apply a row permutation/selection index to several arrays."""
+    return tuple(None if a is None else a[perm] for a in arrays)
+
+
+def fill_null(data, valid, fill):
+    """Replace null slots with `fill` (for min/max identity values)."""
+    if valid is None:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            return jnp.where(jnp.isnan(data), fill, data)
+        return data
+    return jnp.where(valid, data, fill)

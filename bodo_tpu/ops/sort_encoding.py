@@ -1,0 +1,79 @@
+"""Order-preserving uint64 key encodings for multi-key sorts.
+
+The reference sorts with type-dispatched C++ comparators
+(bodo/libs/_array_operations.cpp KeyComparisonAsPython). On TPU we instead
+map every key column to a uint64 whose unsigned order equals the logical
+order (IEEE-754 total-order trick for floats, sign-bit flip for ints,
+dictionary codes for strings — dictionaries are kept sorted at ingest so
+code order == lexicographic order). Descending keys invert bits.
+
+Nulls and padding rows are NOT folded into the value encoding (clamping
+the value range to make room for sentinels collapses distinct extreme
+values — e.g. bool False/True, INT64_MIN vs MIN+1). Instead each key
+contributes *two* sort operands: a small rank operand (padding/null
+ordering) followed by the full-width value encoding; `lax.sort` with
+num_keys spanning both gives exact lexicographic order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_SIGN64 = np.uint64(0x8000000000000000)
+
+
+def encode_value(data, ascending: bool = True):
+    """uint64 encoding of values; unsigned order == logical order.
+    Exact (bijective) — no range clamping."""
+    dt = data.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        data = data + jnp.zeros((), dt)  # -0.0 -> +0.0 (equal keys, one code)
+        if dt == jnp.float32:
+            bits = data.view(jnp.uint32).astype(jnp.uint64) << np.uint64(32)
+        else:
+            bits = data.view(jnp.uint64)
+        sign = (bits & _SIGN64) != 0
+        enc = jnp.where(sign, ~bits, bits | _SIGN64)
+    elif dt == jnp.bool_:
+        enc = data.astype(jnp.uint64)
+    elif jnp.issubdtype(dt, jnp.unsignedinteger):
+        enc = data.astype(jnp.uint64)
+    else:  # signed ints (incl. dict codes, datetimes)
+        enc = data.astype(jnp.int64).view(jnp.uint64) ^ _SIGN64
+    return ~enc if not ascending else enc
+
+
+def null_flag(data, valid=None):
+    """Boolean null indicator (explicit mask OR float NaN)."""
+    null = None
+    if valid is not None:
+        null = ~valid
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        isnan = jnp.isnan(data)
+        null = isnan if null is None else (null | isnan)
+    return null
+
+
+def key_operands(data, valid=None, ascending: bool = True,
+                 na_last: bool = True, padmask=None) -> List:
+    """Sort operands for one key column: [rank, value_enc].
+
+    rank (uint8) orders padding rows last, then nulls per na_last, then
+    real values; value_enc breaks ties exactly. Pass the resulting lists
+    concatenated to lax.sort with num_keys = total operand count.
+    """
+    enc = encode_value(data, ascending)
+    null = null_flag(data, valid)
+    if null is None and padmask is None:
+        return [enc]
+    rank = jnp.zeros(data.shape, dtype=jnp.uint8)
+    if null is not None:
+        rank = jnp.where(null, np.uint8(2) if na_last else np.uint8(0), np.uint8(1))
+    else:
+        rank = jnp.full(data.shape, np.uint8(1), dtype=jnp.uint8)
+    if padmask is not None:
+        rank = jnp.where(padmask, rank, np.uint8(3))  # padding strictly last
+    return [rank, enc]

@@ -1,0 +1,5 @@
+"""Distribution layer: mesh management + collectives.
+
+TPU-native replacement for the reference's MPI stack
+(bodo/libs/_distributed.h, bodo/libs/distributed_api.py, bodo/spawn/).
+"""

@@ -1,0 +1,96 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's MPI process model: one JAX
+process per host, all chips joined into a `jax.sharding.Mesh`. Where the
+reference derives parallelism from `MPI_Comm_rank/size`
+(reference: bodo/libs/distributed_api.py:510, bodo/spawn/spawner.py:134),
+we derive it from the mesh: rows are sharded over a single "data" axis,
+and collectives ride ICI/DCN via jax.lax primitives under shard_map.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bodo_tpu.config import config
+
+_active_mesh: Optional[Mesh] = None
+
+
+def init_runtime() -> None:
+    """Initialize the multi-host runtime if launched as one process per host.
+
+    Analogue of the reference spawner bootstrapping MPI
+    (bodo/spawn/spawner.py:148-190); here the coordination service is
+    jax.distributed's KV store instead of an MPI intercomm.
+    """
+    # Guard on env vars only: touching jax.process_count() here would
+    # initialize the local backend and make distributed.initialize fail.
+    if ("JAX_COORDINATOR_ADDRESS" in os.environ
+            and int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+        )
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the default 1-D data mesh over all addressable devices."""
+    devs = np.array(devices if devices is not None else jax.devices())
+    return Mesh(devs, axis_names=(config.data_axis,))
+
+
+def get_mesh() -> Mesh:
+    """Return the active mesh (creating the default one lazily)."""
+    global _active_mesh
+    if _active_mesh is None:
+        _active_mesh = make_mesh()
+    return _active_mesh
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _active_mesh
+    _active_mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    global _active_mesh
+    prev = _active_mesh
+    _active_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _active_mesh = prev
+
+
+def num_shards(mesh: Optional[Mesh] = None) -> int:
+    """Number of row shards — the analogue of MPI world size
+    (reference bodo/libs/distributed_api.py `get_size`)."""
+    m = mesh or get_mesh()
+    return m.shape[config.data_axis]
+
+
+def row_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for a 1-D row-partitioned array (the reference's OneD
+    distribution, bodo/transforms/distributed_analysis.py:83)."""
+    m = mesh or get_mesh()
+    return NamedSharding(m, P(config.data_axis))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for a replicated array (the reference's REP distribution)."""
+    m = mesh or get_mesh()
+    return NamedSharding(m, P())
+
+
+def data_axis() -> str:
+    return config.data_axis

@@ -1,0 +1,222 @@
+"""Hash/range shuffle over the mesh — the engine's repartitioning core.
+
+TPU-native replacement for the reference's MPI alltoallv shuffle
+(bodo/libs/_shuffle.cpp `shuffle_table`, bodo/libs/streaming/_shuffle.h:777
+`IncrementalShuffleState`). The variable-count alltoallv becomes a
+fixed-capacity `lax.all_to_all`: each shard packs its rows into S buckets
+of static capacity C (destination = hash or range of the key), exchanges
+the buckets over ICI, then compacts received rows using exchanged
+per-source counts. Overflowing a bucket sets a flag the host checks and
+retries with a larger C (the analogue of the reference's partition
+re-splitting on memory pressure, streaming/_join.h:267).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from bodo_tpu.config import config
+from bodo_tpu.ops import kernels as K
+from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, _var_from_moments,
+                                  groupby_local, result_dtype)
+from bodo_tpu.ops.hashing import dest_shard, hash_columns
+from bodo_tpu.parallel import collectives as C
+from bodo_tpu.parallel import mesh as mesh_mod
+
+
+# ---------------------------------------------------------------------------
+# bucket pack / unpack (runs per shard, inside shard_map)
+# ---------------------------------------------------------------------------
+
+def bucket_rows(dest, arrays: Sequence, count, num_shards: int,
+                bucket_cap: int):
+    """Pack rows into per-destination buckets of capacity `bucket_cap`.
+
+    dest: int32 [cap] destination shard per row (padding rows ignored).
+    Returns (packed arrays [S*C,...], send_counts [S], overflow flag).
+    """
+    cap = dest.shape[0]
+    padmask = K.row_mask(count, cap)
+    d = jnp.where(padmask, dest, num_shards).astype(jnp.int32)
+    # stable sort rows by destination
+    d_s, perm = lax.sort((d, jnp.arange(cap)), num_keys=1, is_stable=True)
+    pos = jnp.arange(cap)
+    is_new = (d_s != jnp.roll(d_s, 1)) | (pos == 0)
+    group_start = lax.cummax(jnp.where(is_new, pos, 0))
+    idx_in = pos - group_start
+    ok = (d_s < num_shards) & (idx_in < bucket_cap)
+    overflow = jnp.any((d_s < num_shards) & (idx_in >= bucket_cap))
+    scatter_idx = jnp.where(ok, d_s * bucket_cap + idx_in,
+                            num_shards * bucket_cap)
+    packed = []
+    for a in arrays:
+        if a is None:
+            packed.append(None)
+            continue
+        z = jnp.zeros((num_shards * bucket_cap,) + a.shape[1:], dtype=a.dtype)
+        packed.append(z.at[scatter_idx].set(a[perm], mode="drop"))
+    send_counts = jax.ops.segment_sum(
+        padmask.astype(jnp.int64), jnp.minimum(d, num_shards),
+        num_segments=num_shards + 1)[:num_shards]
+    send_counts = jnp.minimum(send_counts, bucket_cap)
+    return packed, send_counts, overflow
+
+
+def exchange_and_compact(packed: Sequence, send_counts, num_shards: int,
+                         bucket_cap: int, axis: Optional[str] = None):
+    """all_to_all the packed buckets + counts, then compact received rows.
+
+    Returns (arrays [S*C,...] compacted to front, recv_count scalar).
+    """
+    recvd = [None if a is None else C.all_to_all_rows(a, axis) for a in packed]
+    rcounts = C.all_to_all_rows(send_counts, axis)  # [S]: rows from each src
+    total = num_shards * bucket_cap
+    slot = jnp.arange(total)
+    mask = (slot % bucket_cap) < rcounts[slot // bucket_cap]
+    out, cnt = K.compact(mask, tuple(recvd))
+    return list(out), cnt
+
+
+def shuffle_rows(dest, arrays: Sequence, count, num_shards: int,
+                 bucket_cap: int, axis: Optional[str] = None):
+    """Full shuffle: bucket → all_to_all → compact. The `shuffle_table`
+    analogue (reference bodo/libs/_shuffle.h:41)."""
+    packed, send_counts, ovf = bucket_rows(dest, arrays, count, num_shards,
+                                           bucket_cap)
+    out, cnt = exchange_and_compact(packed, send_counts, num_shards,
+                                    bucket_cap, axis)
+    return out, cnt, ovf
+
+
+# ---------------------------------------------------------------------------
+# distributed groupby: partial-agg → hash shuffle → combine → finalize
+# ---------------------------------------------------------------------------
+
+def _plan_decomposition(specs: Tuple[str, ...]):
+    """Map final agg specs to (partial specs, combine specs, layout).
+
+    layout[i] = (offset, n) slice of partial columns feeding final spec i.
+    """
+    partial_specs: List[str] = []
+    combine_specs: List[str] = []
+    layout = []
+    for op in specs:
+        if op not in DECOMPOSE:
+            raise NotImplementedError(
+                f"agg '{op}' is not decomposable for the distributed "
+                f"two-phase groupby; execute it via gather + local groupby "
+                f"(supported distributed aggs: {sorted(DECOMPOSE)})")
+        parts = DECOMPOSE[op]
+        layout.append((len(partial_specs), len(parts)))
+        partial_specs.extend(parts)
+        combine_specs.extend(COMBINE_OF[p] for p in parts)
+    return tuple(partial_specs), tuple(combine_specs), tuple(layout)
+
+
+def _finalize(op: str, cols, orig_dtype):
+    """Derive the final column from combined partial columns."""
+    if op == "mean":
+        (s, _), (cnt, _) = cols
+        rdt = result_dtype("mean", orig_dtype)
+        m = s.astype(rdt) / jnp.maximum(cnt, 1).astype(rdt)
+        return jnp.where(cnt > 0, m, jnp.nan), None
+    if op in ("var", "std"):
+        (s, _), (s2, _), (cnt, _) = cols
+        rdt = result_dtype(op, orig_dtype)
+        out = _var_from_moments(s.astype(rdt), s2.astype(rdt), cnt)
+        return (jnp.sqrt(out) if op == "std" else out), None
+    return cols[0]
+
+
+@lru_cache(maxsize=256)
+def _build_groupby_sharded(mesh_key, num_keys: int, specs: Tuple[str, ...],
+                           bucket_cap: int, final_cap: int):
+    """Build the jitted shard_map groupby pipeline for a mesh/spec combo."""
+    mesh = _MESHES[mesh_key]
+    axis = config.data_axis
+    S = mesh.shape[axis]
+    partial_specs, combine_specs, layout = _plan_decomposition(specs)
+
+    def body(arrays, counts):
+        count = counts[0]
+        cap = arrays[0][0].shape[0]
+        # 1. local partial aggregation (shrinks data before the wire —
+        #    same motivation as the reference's local combine step)
+        keys = arrays[:num_keys]
+        values = arrays[num_keys:]
+        p_inputs = tuple(keys) + tuple(
+            values[i] for i, op in enumerate(specs)
+            for _ in DECOMPOSE[op])
+        pk, pv, ng = groupby_local(p_inputs, count, partial_specs, cap,
+                                   num_keys)
+        # 2. hash-partition shuffle of partial rows
+        h = hash_columns(pk)
+        dest = dest_shard(h, S)
+        flat: List = [d for d, _ in pk]
+        valmask_slots = []
+        for d, v in pv:
+            flat.append(d)
+            if v is not None:
+                valmask_slots.append(len(flat))
+                flat.append(v)
+            else:
+                valmask_slots.append(None)
+        out, cnt2, ovf = shuffle_rows(dest, flat, ng, S, bucket_cap, axis)
+        # rebuild (data, valid) structure
+        rk = tuple((out[i], None) for i in range(num_keys))
+        rv = []
+        j = num_keys
+        for slot in valmask_slots:
+            if slot is None:
+                rv.append((out[j], None))
+                j += 1
+            else:
+                rv.append((out[j], out[j + 1].astype(bool)))
+                j += 2
+        # 3. combine
+        c_inputs = rk + tuple(rv)
+        fk, fv, ng2 = groupby_local(c_inputs, cnt2, combine_specs, final_cap,
+                                    num_keys)
+        # 4. finalize
+        finals = []
+        for i, op in enumerate(specs):
+            off, n = layout[i]
+            orig_dtype = values[i][0].dtype
+            finals.append(_finalize(op, fv[off:off + n], orig_dtype))
+        out_tree = (fk, tuple(finals))
+        return out_tree, ng2[None], ovf[None]
+
+    shd = C.smap(body,
+                 in_specs=(P(axis), P(axis)),
+                 out_specs=(P(axis), P(axis), P(axis)),
+                 mesh=mesh)
+    return jax.jit(shd)
+
+
+_MESHES = {}
+
+
+def _mesh_key(mesh):
+    k = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    _MESHES[k] = mesh
+    return k
+
+
+def groupby_sharded(arrays, counts, num_keys: int, specs: Tuple[str, ...],
+                    bucket_cap: int, final_cap: int, mesh=None):
+    """Distributed groupby over row-sharded arrays.
+
+    arrays: tuple of (data, valid) with data sharded [S*cap]; counts [S].
+    Returns ((out_keys, out_finals), n_groups [S], overflow [S]).
+    """
+    m = mesh or mesh_mod.get_mesh()
+    fn = _build_groupby_sharded(_mesh_key(m), num_keys, specs, bucket_cap,
+                                final_cap)
+    return fn(tuple(arrays), counts)

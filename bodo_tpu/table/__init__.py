@@ -1,0 +1,6 @@
+"""Columnar data model (analogue of bodo/libs/_bodo_common.h structures)."""
+
+from bodo_tpu.table.table import Table, Column, round_capacity, REP, ONED
+from bodo_tpu.table import dtypes
+
+__all__ = ["Table", "Column", "round_capacity", "REP", "ONED", "dtypes"]

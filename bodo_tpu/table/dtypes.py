@@ -1,0 +1,91 @@
+"""Logical dtype system for device-resident columns.
+
+TPU-native analogue of the reference's `Bodo_CTypes::CTypeEnum` +
+`bodo_array_type` (reference: bodo/libs/_bodo_common.h:341, :524). Every
+logical type maps to a TPU-friendly physical numpy dtype:
+
+  - integers/floats/bool map directly,
+  - strings are dictionary-encoded: int32 codes on device, the dictionary
+    (unique strings, lexicographically sorted so code order == string order)
+    stays on host (the reference leans on the same trick:
+    bodo/libs/_dict_builder.cpp, bodo/libs/dict_arr_ext.py),
+  - datetime64[ns]/timedelta64[ns] are int64 ticks, dates are int32 days.
+
+Nullability is carried by a separate validity mask (Arrow-style), matching
+the reference's nullable arrays (bodo/libs/int_arr_ext.py etc.).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DType:
+    name: str          # logical name
+    np_dtype: str      # physical device representation
+    kind: str          # 'i', 'u', 'f', 'b', 'str', 'dt', 'td', 'date'
+
+    @property
+    def numpy(self) -> np.dtype:
+        return np.dtype(self.np_dtype)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"DType({self.name})"
+
+
+INT8 = DType("int8", "int8", "i")
+INT16 = DType("int16", "int16", "i")
+INT32 = DType("int32", "int32", "i")
+INT64 = DType("int64", "int64", "i")
+UINT8 = DType("uint8", "uint8", "u")
+UINT16 = DType("uint16", "uint16", "u")
+UINT32 = DType("uint32", "uint32", "u")
+UINT64 = DType("uint64", "uint64", "u")
+FLOAT32 = DType("float32", "float32", "f")
+FLOAT64 = DType("float64", "float64", "f")
+BOOL = DType("bool", "bool", "b")
+STRING = DType("string", "int32", "str")          # dict codes
+DATETIME = DType("datetime64[ns]", "int64", "dt")  # ns ticks
+TIMEDELTA = DType("timedelta64[ns]", "int64", "td")
+DATE = DType("date", "int32", "date")              # days since epoch
+
+_BY_NAME = {
+    t.name: t
+    for t in (INT8, INT16, INT32, INT64, UINT8, UINT16, UINT32, UINT64,
+              FLOAT32, FLOAT64, BOOL, STRING, DATETIME, TIMEDELTA, DATE)
+}
+
+
+def by_name(name: str) -> DType:
+    return _BY_NAME[name]
+
+
+def from_numpy(dt: np.dtype) -> DType:
+    dt = np.dtype(dt)
+    if dt.kind == "M":
+        return DATETIME
+    if dt.kind == "m":
+        return TIMEDELTA
+    if dt.kind in ("U", "S", "O", "T"):
+        return STRING
+    name = dt.name
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise TypeError(f"unsupported numpy dtype: {dt}")
+
+
+def is_numeric(t: DType) -> bool:
+    return t.kind in ("i", "u", "f", "b")
+
+
+def is_float(t: DType) -> bool:
+    return t.kind == "f"
+
+
+def common_numeric(a: DType, b: DType) -> DType:
+    """Result dtype of arithmetic between two numeric columns."""
+    res = np.result_type(a.numpy, b.numpy)
+    return from_numpy(res)

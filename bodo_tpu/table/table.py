@@ -1,0 +1,331 @@
+"""Device-resident columnar tables.
+
+TPU-native analogue of the reference's `array_info`/`table_info` columnar
+core (reference: bodo/libs/_bodo_common.h:936, :1828) and the Python⇄C++
+bridge (bodo/libs/array.py:242 `array_to_info`, :1993 `cpp_table_to_py_table`).
+
+Design (SURVEY.md §7):
+  - struct-of-arrays: each column is a fixed-capacity padded device array
+    plus an optional validity bitmask; the number of real rows is tracked
+    host-side (`nrows`, per-shard `counts` when row-sharded). Padded static
+    shapes keep XLA happy; the reference's 1D_Var distribution becomes
+    (padded buffer + row-count).
+  - strings are dictionary-encoded; the dictionary (sorted unique strings)
+    lives on host, int32 codes live on device.
+  - a Table is either replicated ("REP") or row-sharded over the mesh data
+    axis ("1D") — the reference's distribution lattice REP/OneD/OneD_Var
+    (bodo/transforms/distributed_analysis.py:83) collapses to these two
+    plus the padding counts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from bodo_tpu.config import config
+from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.table.dtypes import DType
+
+REP = "REP"   # replicated: one logical copy (device or host)
+ONED = "1D"   # row-sharded over the mesh data axis
+
+
+def round_capacity(n: int) -> int:
+    """Round a row count up to a padded, tile-friendly capacity."""
+    r = config.capacity_round
+    return max(r, ((n + r - 1) // r) * r)
+
+
+@dataclass
+class Column:
+    """One column: device data + optional validity + host dictionary."""
+    data: jax.Array                      # [capacity] physical values/codes
+    valid: Optional[jax.Array]           # [capacity] bool, None = no nulls
+    dtype: DType
+    dictionary: Optional[np.ndarray] = None  # sorted unique strings (host)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def with_data(self, data, valid=None) -> "Column":
+        return Column(data=data, valid=valid, dtype=self.dtype,
+                      dictionary=self.dictionary)
+
+    # ---- construction ----------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, capacity: Optional[int] = None,
+                   valid: Optional[np.ndarray] = None) -> "Column":
+        n = len(arr)
+        cap = capacity if capacity is not None else round_capacity(n)
+        dtype = dt.from_numpy(arr.dtype)
+        dictionary = None
+        if dtype is dt.STRING:
+            vals = np.asarray(arr, dtype=object)
+            isna = np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                             for v in vals])
+            if valid is not None:
+                isna |= ~np.asarray(valid, dtype=bool)
+            fill = vals[~isna]
+            safe = np.where(isna, fill[0] if len(fill) else "", vals)
+            dictionary, codes = np.unique(safe.astype(str), return_inverse=True)
+            phys = codes.astype(np.int32)
+            valid = None if not isna.any() else ~isna
+        elif dtype is dt.DATETIME:
+            a = np.asarray(arr).astype("datetime64[ns]")
+            nat = np.isnat(a)
+            phys = a.view(np.int64).copy()
+            if nat.any():
+                phys[nat] = 0
+                valid = (~nat) if valid is None else (np.asarray(valid) & ~nat)
+        elif dtype is dt.TIMEDELTA:
+            a = np.asarray(arr).astype("timedelta64[ns]")
+            nat = np.isnat(a)
+            phys = a.view(np.int64).copy()
+            if nat.any():
+                phys[nat] = 0
+                valid = (~nat) if valid is None else (np.asarray(valid) & ~nat)
+        else:
+            # NaN stays NaN in float data (pandas float semantics); no mask.
+            phys = np.asarray(arr, dtype=dtype.numpy)
+        padded = np.zeros((cap,) + phys.shape[1:], dtype=dtype.numpy)
+        padded[:n] = phys
+        vcol = None
+        if valid is not None:
+            v = np.zeros(cap, dtype=bool)
+            v[:n] = np.asarray(valid, dtype=bool)
+            vcol = jnp.asarray(v)
+        return Column(data=jnp.asarray(padded), valid=vcol, dtype=dtype,
+                      dictionary=dictionary)
+
+    # ---- materialization -------------------------------------------------
+    def to_numpy(self, nrows: int):
+        """Decode the first `nrows` real rows to a host numpy/object array."""
+        data = np.asarray(jax.device_get(self.data))[:nrows]
+        valid = (np.asarray(jax.device_get(self.valid))[:nrows]
+                 if self.valid is not None else None)
+        if self.dtype is dt.STRING:
+            assert self.dictionary is not None
+            out = self.dictionary[np.clip(data, 0, len(self.dictionary) - 1)]
+            out = out.astype(object)
+            if valid is not None:
+                out[~valid] = None
+            return out
+        if self.dtype is dt.DATETIME:
+            out = data.view("datetime64[ns]").copy()
+            if valid is not None:
+                out[~valid] = np.datetime64("NaT")
+            return out
+        if self.dtype is dt.TIMEDELTA:
+            out = data.view("timedelta64[ns]").copy()
+            if valid is not None:
+                out[~valid] = np.timedelta64("NaT")
+            return out
+        if valid is not None and self.dtype.kind in ("i", "u", "b"):
+            return _masked_to_pandas(data, valid, self.dtype)
+        if valid is not None and self.dtype.kind == "f":
+            out = data.astype(self.dtype.numpy).copy()
+            out[~valid] = np.nan
+            return out
+        return data
+
+
+def _masked_to_pandas(data, valid, dtype: DType):
+    mask = ~np.asarray(valid, dtype=bool)
+    if dtype.kind == "b":
+        return pd.arrays.BooleanArray(
+            np.where(valid, data, False).astype(bool), mask)
+    vals = np.where(valid, data, dtype.numpy.type(0)).astype(dtype.numpy)
+    return pd.arrays.IntegerArray(vals, mask)
+
+
+@dataclass
+class Table:
+    """Host-level handle to device-resident columns.
+
+    Not a pytree: jitted kernels consume/produce raw array pytrees via
+    `device_data()` / `with_device_data()`; dictionaries and schema stay on
+    host (avoids recompiles keyed on dictionary contents).
+    """
+    columns: Dict[str, Column]
+    nrows: int
+    distribution: str = REP
+    counts: Optional[np.ndarray] = None  # per-shard real-row counts when 1D
+
+    # ---- basic accessors -------------------------------------------------
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return 0
+        return next(iter(self.columns.values())).capacity
+
+    @property
+    def num_shards(self) -> int:
+        return 1 if self.counts is None else len(self.counts)
+
+    @property
+    def shard_capacity(self) -> int:
+        return self.capacity // self.num_shards
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table({n: self.columns[n] for n in names}, self.nrows,
+                     self.distribution, self.counts)
+
+    def with_columns(self, columns: Dict[str, Column]) -> "Table":
+        return Table(dict(columns), self.nrows, self.distribution, self.counts)
+
+    # ---- conversion ------------------------------------------------------
+    @staticmethod
+    def from_pandas(df: pd.DataFrame, capacity: Optional[int] = None) -> "Table":
+        n = len(df)
+        cap = capacity if capacity is not None else round_capacity(n)
+        cols: Dict[str, Column] = {}
+        for name in df.columns:
+            s = df[name]
+            valid = None
+            if s.isna().any():
+                valid = (~s.isna()).to_numpy()
+            if hasattr(s.dtype, "numpy_dtype"):
+                # pandas masked extension dtype (Int64/boolean/...): keep the
+                # exact physical dtype, don't let to_numpy() densify to
+                # object/float64 (loses precision for large ints)
+                np_dt = s.dtype.numpy_dtype
+                arr = s.to_numpy(dtype=np_dt, na_value=np_dt.type(0))
+            elif valid is not None and s.dtype.kind not in (
+                    "O", "U", "T", "M", "m", "f"):
+                arr = s.to_numpy(na_value=0)
+            else:
+                arr = s.to_numpy()
+            cols[str(name)] = Column.from_numpy(arr, capacity=cap, valid=valid)
+        return Table(cols, n, REP, None)
+
+    def to_pandas(self) -> pd.DataFrame:
+        t = self.gather() if self.distribution == ONED else self
+        out = {}
+        for name, col in t.columns.items():
+            out[name] = col.to_numpy(t.nrows)
+        return pd.DataFrame(out)
+
+    # ---- distribution ----------------------------------------------------
+    def shard(self) -> "Table":
+        """REP -> 1D: scatter rows over the mesh data axis
+        (scatterv analogue, reference distributed_api.py:1299)."""
+        if self.distribution == ONED:
+            return self
+        m = mesh_mod.get_mesh()
+        s = mesh_mod.num_shards(m)
+        per = round_capacity(-(-max(self.nrows, 1) // s))
+        counts = np.array(
+            [max(0, min(per, self.nrows - i * per)) for i in range(s)],
+            dtype=np.int64)
+        sharding = mesh_mod.row_sharding(m)
+        new_cols = {}
+        for name, col in self.columns.items():
+            host = np.asarray(jax.device_get(col.data))
+            padded = np.zeros((s * per,), dtype=host.dtype)
+            off = 0
+            for i in range(s):  # pack shard i's rows at offset i*per
+                c = int(counts[i])
+                padded[i * per:i * per + c] = host[off:off + c]
+                off += c
+            data = jax.device_put(padded, sharding)
+            valid = None
+            if col.valid is not None:
+                hv = np.asarray(jax.device_get(col.valid))
+                pv = np.zeros((s * per,), dtype=bool)
+                off = 0
+                for i in range(s):
+                    c = int(counts[i])
+                    pv[i * per:i * per + c] = hv[off:off + c]
+                    off += c
+                valid = jax.device_put(pv, sharding)
+            new_cols[name] = Column(data, valid, col.dtype, col.dictionary)
+        return Table(new_cols, self.nrows, ONED, counts)
+
+    def gather(self) -> "Table":
+        """1D -> REP: gather shards, trim padding, repack contiguous
+        (gatherv analogue, reference distributed_api.py:713)."""
+        if self.distribution == REP:
+            return self
+        s = self.num_shards
+        per = self.shard_capacity
+        cap = round_capacity(max(self.nrows, 1))
+        new_cols = {}
+        for name, col in self.columns.items():
+            host = np.asarray(jax.device_get(col.data))
+            pieces = [host[i * per: i * per + int(self.counts[i])]
+                      for i in range(s)]
+            packed = np.concatenate(pieces) if pieces else host[:0]
+            padded = np.zeros((cap,), dtype=host.dtype)
+            padded[: self.nrows] = packed
+            valid = None
+            if col.valid is not None:
+                hv = np.asarray(jax.device_get(col.valid))
+                vp = [hv[i * per: i * per + int(self.counts[i])]
+                      for i in range(s)]
+                vpacked = np.concatenate(vp) if vp else hv[:0]
+                vpad = np.zeros((cap,), dtype=bool)
+                vpad[: self.nrows] = vpacked
+                valid = jnp.asarray(vpad)
+            new_cols[name] = Column(jnp.asarray(padded), valid, col.dtype,
+                                    col.dictionary)
+        return Table(new_cols, self.nrows, REP, None)
+
+    # ---- kernel interface ------------------------------------------------
+    def device_data(self):
+        """Pytree view for jitted kernels: {name: (data, valid_or_None)}."""
+        return {n: (c.data, c.valid) for n, c in self.columns.items()}
+
+    def counts_device(self):
+        """Per-shard row counts as a device array sharded one-per-shard
+        (shape [S]; inside shard_map each shard sees [1])."""
+        if self.counts is None:
+            return jnp.asarray(np.array([self.nrows], dtype=np.int64))
+        m = mesh_mod.get_mesh()
+        return jax.device_put(self.counts.astype(np.int64),
+                              mesh_mod.row_sharding(m))
+
+    def with_device_data(self, tree, nrows: Optional[int] = None,
+                         counts: Optional[np.ndarray] = None,
+                         dtypes: Optional[Dict[str, DType]] = None,
+                         dicts: Optional[Dict[str, np.ndarray]] = None
+                         ) -> "Table":
+        """Rebuild a Table from a kernel-output pytree, preserving schema
+        metadata for columns that still exist (host-side dictionary
+        re-attachment — see module docstring)."""
+        cols = {}
+        for name, (data, valid) in tree.items():
+            if dtypes and name in dtypes:
+                dtype = dtypes[name]
+            elif name in self.columns:
+                dtype = self.columns[name].dtype
+            else:
+                dtype = dt.from_numpy(np.dtype(data.dtype))
+            dictionary = None
+            if dicts and name in dicts:
+                dictionary = dicts[name]
+            elif name in self.columns:
+                dictionary = self.columns[name].dictionary
+            cols[name] = Column(data, valid, dtype, dictionary)
+        new_dist = self.distribution if counts is None else ONED
+        return Table(cols, self.nrows if nrows is None else nrows,
+                     new_dist, self.counts if counts is None else counts)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        schema = ", ".join(f"{n}:{c.dtype.name}" for n, c in self.columns.items())
+        return (f"Table[{self.nrows} rows, cap={self.capacity}, "
+                f"{self.distribution}]({schema})")

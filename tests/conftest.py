@@ -1,0 +1,56 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+Mirrors the reference's strategy of using MPI itself as the multi-node
+simulator (`mpiexec -n 3` on one machine, SURVEY.md §4): here the
+simulator is XLA's host-platform device count — all collective paths
+(all_to_all shuffle, psum, all_gather, ppermute halos) are exercised for
+real on 8 virtual devices.
+"""
+
+import os
+
+# Force the CPU backend with 8 virtual devices. NOTE: this environment's
+# site customization force-registers a TPU-tunnel PJRT plugin and
+# overwrites jax_platforms at import time, so an env var alone is not
+# enough — override the config after importing jax, before backend init.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import bodo_tpu
+    m = bodo_tpu.make_mesh()
+    bodo_tpu.set_mesh(m)
+    return m
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_df(n=1000, seed=0, nulls=False):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": r.integers(0, 10, n),
+        "b": r.normal(size=n),
+        "c": r.choice(["x", "yy", "zzz", "w"], n),
+        "d": r.integers(-1000, 1000, n).astype(np.int32),
+    })
+    if nulls:
+        df.loc[r.random(n) < 0.1, "b"] = np.nan
+        df["e"] = pd.array(r.integers(0, 5, n), dtype="Int64")
+        df.loc[r.random(n) < 0.1, "e"] = pd.NA
+    return df

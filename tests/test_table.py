@@ -1,0 +1,77 @@
+"""Tests for the columnar Table model (roundtrips, sharding, nulls)."""
+
+import numpy as np
+import pandas as pd
+import pandas.testing as pdt
+import pytest
+
+from tests.conftest import make_df
+
+
+def test_roundtrip_basic(mesh8):
+    from bodo_tpu import Table
+    df = make_df(257)
+    t = Table.from_pandas(df)
+    assert t.nrows == 257
+    assert t.capacity % 128 == 0
+    out = t.to_pandas()
+    pdt.assert_frame_equal(out.astype(df.dtypes.to_dict()), df,
+                           check_dtype=False)
+
+
+def test_roundtrip_nulls(mesh8):
+    from bodo_tpu import Table
+    df = make_df(300, nulls=True)
+    t = Table.from_pandas(df)
+    out = t.to_pandas()
+    # float nulls stay NaN
+    assert np.array_equal(np.isnan(out["b"]), np.isnan(df["b"]))
+    # nullable int nulls preserved
+    assert out["e"].isna().sum() == df["e"].isna().sum()
+    assert (out["e"].dropna().to_numpy() == df["e"].dropna().to_numpy()).all()
+
+
+def test_string_dictionary_sorted(mesh8):
+    from bodo_tpu import Table
+    df = pd.DataFrame({"s": ["b", "a", "c", "a", None, "b"]})
+    t = Table.from_pandas(df)
+    col = t.column("s")
+    assert col.dictionary is not None
+    assert list(col.dictionary) == sorted(col.dictionary)
+    out = t.to_pandas()
+    assert list(out["s"][[0, 1, 2, 3, 5]]) == ["b", "a", "c", "a", "b"]
+    assert out["s"].isna().tolist() == [False] * 4 + [True, False]
+
+
+def test_datetime_roundtrip(mesh8):
+    from bodo_tpu import Table
+    df = pd.DataFrame({
+        "t": pd.to_datetime(["2024-01-01", "2024-06-15 12:34:56", None],
+                            format="mixed"),
+    })
+    t = Table.from_pandas(df)
+    out = t.to_pandas()
+    assert out["t"].isna().tolist() == [False, False, True]
+    assert (out["t"][:2] == df["t"][:2]).all()
+
+
+def test_shard_gather_roundtrip(mesh8):
+    from bodo_tpu import Table
+    df = make_df(1000, nulls=True)
+    t = Table.from_pandas(df).shard()
+    assert t.distribution == "1D"
+    assert t.counts.sum() == 1000
+    assert t.num_shards == 8
+    back = t.to_pandas()
+    assert len(back) == 1000
+    assert np.allclose(back["b"].to_numpy(), df["b"].to_numpy(),
+                       equal_nan=True)
+    assert list(back["c"]) == list(df["c"])
+
+
+def test_shard_small_table(mesh8):
+    from bodo_tpu import Table
+    df = make_df(5)
+    t = Table.from_pandas(df).shard()
+    assert t.nrows == 5
+    assert len(t.to_pandas()) == 5

@@ -1,0 +1,36 @@
+"""Regression tests: pandas masked extension dtypes must keep their
+physical type through ingestion (large Int64 precision, boolean)."""
+
+import numpy as np
+import pandas as pd
+
+
+def test_large_int64_nullable_roundtrip(mesh8):
+    from bodo_tpu import Table
+    big = 2**62 + 1
+    df = pd.DataFrame({"x": pd.array([big, None, 3], dtype="Int64")})
+    t = Table.from_pandas(df)
+    assert t.column("x").dtype.name == "int64"
+    out = t.to_pandas()
+    assert out["x"][0] == big
+    assert out["x"].isna().tolist() == [False, True, False]
+
+
+def test_boolean_nullable_roundtrip(mesh8):
+    from bodo_tpu import Table
+    df = pd.DataFrame({"b": pd.array([True, None, False], dtype="boolean")})
+    t = Table.from_pandas(df)
+    assert t.column("b").dtype.name == "bool"
+    assert t.column("b").dictionary is None
+    out = t.to_pandas()
+    assert out["b"][0] == True  # noqa: E712
+    assert out["b"][2] == False  # noqa: E712
+    assert out["b"].isna().tolist() == [False, True, False]
+
+
+def test_uint64_roundtrip(mesh8):
+    from bodo_tpu import Table
+    df = pd.DataFrame({"u": np.array([0, 2**63 + 5, 7], dtype=np.uint64)})
+    t = Table.from_pandas(df)
+    out = t.to_pandas()
+    assert out["u"].tolist() == [0, 2**63 + 5, 7]

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Benchmark driver: NYC-taxi-shaped filter+join+groupby workload.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline anchor: the reference engine reports ~3x over pandas for this
+workload on a single host (BASELINE.md: "NYC Taxi local subset — Bodo JIT
+≈3x vs pandas"). vs_baseline = our_speedup_over_pandas / 3.0, so
+vs_baseline >= 1.0 means we match the reference's single-host win.
+
+Usage: python bench.py [--rows N] [--quick] [--cpu]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20_000_000)
+    ap.add_argument("--quick", action="store_true",
+                    help="200k rows (CI / CPU-mesh smoke run)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU backend with an 8-device mesh")
+    args = ap.parse_args()
+    n_rows = 200_000 if args.quick else args.rows
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import pandas as pd  # noqa: F401
+
+    import bodo_tpu
+    from bodo_tpu.workloads.taxi import (bodo_tpu_pipeline, gen_taxi_data,
+                                         pandas_pipeline)
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench_data")
+    os.makedirs(data_dir, exist_ok=True)
+    pq = os.path.join(data_dir, f"trips_{n_rows}.parquet")
+    csv = os.path.join(data_dir, f"weather_{n_rows}.csv")
+    if not (os.path.exists(pq) and os.path.exists(csv)):
+        print(f"generating {n_rows} rows ...", file=sys.stderr)
+        gen_taxi_data(n_rows, pq, csv)
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh())
+
+    # pandas baseline (includes IO, like the reference harness)
+    t0 = time.perf_counter()
+    exp = pandas_pipeline(pq, csv)
+    t_pandas = time.perf_counter() - t0
+    print(f"pandas: {t_pandas:.3f}s ({len(exp)} groups)", file=sys.stderr)
+
+    # ours: cold (compile) + hot runs
+    t0 = time.perf_counter()
+    out = bodo_tpu_pipeline(pq, csv, shard=True)
+    out.to_pandas()
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = bodo_tpu_pipeline(pq, csv, shard=True)
+    got = out.to_pandas()
+    t_hot = time.perf_counter() - t0
+    print(f"bodo_tpu: cold {t_cold:.3f}s hot {t_hot:.3f}s "
+          f"({len(got)} groups)", file=sys.stderr)
+
+    if len(got) != len(exp):
+        print(json.dumps({"metric": "nyc_taxi_speedup_vs_pandas",
+                          "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+                          "error": "result mismatch"}))
+        return 1
+
+    speedup = t_pandas / t_hot
+    print(json.dumps({
+        "metric": "nyc_taxi_speedup_vs_pandas",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 3.0, 3),
+        "detail": {"rows": n_rows, "pandas_s": round(t_pandas, 3),
+                   "hot_s": round(t_hot, 3), "cold_s": round(t_cold, 3),
+                   "n_devices": len(jax.devices())},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

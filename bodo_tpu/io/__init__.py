@@ -1,0 +1,14 @@
+"""Host I/O: Arrow-based readers/writers feeding device tables.
+
+Analogue of the reference's I/O layer (bodo/io/ — arrow_reader.h,
+parquet_reader.cpp, csv_json_reader.cpp): pyarrow does the parsing on
+host; columns are converted straight into the padded device layout with
+dictionary-encoded strings.
+"""
+
+from bodo_tpu.io.arrow_bridge import arrow_to_table, table_to_arrow
+from bodo_tpu.io.parquet import read_parquet, write_parquet
+from bodo_tpu.io.csv import read_csv
+
+__all__ = ["arrow_to_table", "table_to_arrow", "read_parquet",
+           "write_parquet", "read_csv"]

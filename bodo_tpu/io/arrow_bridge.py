@@ -1,0 +1,139 @@
+"""Arrow ⇄ device-table conversion.
+
+Analogue of the reference's Arrow bridge (bodo/libs/_bodo_to_arrow.cpp,
+bodo/io/arrow_reader.h TableBuilder): host Arrow columns become padded
+device arrays + validity masks; strings are dictionary-encoded with a
+lexicographically sorted dictionary (so device code order == string
+order, see table/dtypes.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.table.table import (Column, ONED, REP, Table,
+                                  round_capacity)
+
+
+def _pad(arr: np.ndarray, cap: int) -> np.ndarray:
+    out = np.zeros((cap,) + arr.shape[1:], dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def _arrow_column(arr: pa.ChunkedArray, cap: int) -> Column:
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    typ = arr.type
+    n = len(arr)
+    valid_np = None
+    if arr.null_count:
+        valid_np = ~np.asarray(arr.is_null())
+
+    if pa.types.is_dictionary(typ) or pa.types.is_string(typ) or \
+            pa.types.is_large_string(typ):
+        if not pa.types.is_dictionary(typ):
+            arr = pc.dictionary_encode(arr)
+        darr = arr
+        dictionary = np.asarray(darr.dictionary.to_pylist(), dtype=str) \
+            if len(darr.dictionary) else np.array([], dtype=str)
+        codes = darr.indices.to_numpy(zero_copy_only=False)
+        codes = np.where(np.isnan(codes.astype(np.float64)), 0, codes) \
+            if codes.dtype.kind == "f" else codes
+        codes = codes.astype(np.int32)
+        # sort the dictionary so code order == lexicographic order
+        order = np.argsort(dictionary, kind="stable")
+        rank = np.empty_like(order)
+        rank[order] = np.arange(len(order))
+        sorted_dict = dictionary[order]
+        codes = rank[np.clip(codes, 0, max(len(dictionary) - 1, 0))] \
+            if len(dictionary) else codes
+        data = jnp.asarray(_pad(codes, cap))
+        v = jnp.asarray(_pad(valid_np, cap)) if valid_np is not None else None
+        return Column(data, v, dt.STRING, sorted_dict)
+
+    if pa.types.is_timestamp(typ):
+        a64 = arr.cast(pa.timestamp("ns")).to_numpy(zero_copy_only=False)
+        nat = np.isnat(a64)
+        ticks = a64.view(np.int64).copy()
+        if nat.any():
+            ticks[nat] = 0
+            valid_np = ~nat if valid_np is None else (valid_np & ~nat)
+        return Column(jnp.asarray(_pad(ticks, cap)),
+                      jnp.asarray(_pad(valid_np, cap))
+                      if valid_np is not None else None,
+                      dt.DATETIME, None)
+    if pa.types.is_date(typ):
+        days = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        days = np.nan_to_num(days).astype(np.int32)
+        return Column(jnp.asarray(_pad(days, cap)),
+                      jnp.asarray(_pad(valid_np, cap))
+                      if valid_np is not None else None,
+                      dt.DATE, None)
+    if pa.types.is_boolean(typ):
+        vals = arr.to_numpy(zero_copy_only=False)
+        if vals.dtype == object:
+            vals = np.array([bool(x) if x is not None else False
+                             for x in vals])
+        vals = np.nan_to_num(vals.astype(np.float64)).astype(bool) \
+            if vals.dtype.kind == "f" else vals.astype(bool)
+        return Column(jnp.asarray(_pad(vals, cap)),
+                      jnp.asarray(_pad(valid_np, cap))
+                      if valid_np is not None else None, dt.BOOL, None)
+
+    # numeric
+    vals = arr.to_numpy(zero_copy_only=False)
+    if valid_np is not None and vals.dtype.kind == "f" and \
+            not pa.types.is_floating(typ):
+        # ints with nulls densified to float by Arrow — restore exact ints
+        vals = np.nan_to_num(vals)
+    np_dtype = typ.to_pandas_dtype()
+    vals = vals.astype(np_dtype)
+    dtype = dt.from_numpy(np.dtype(np_dtype))
+    if dtype.kind == "f":
+        valid_np = None  # NaN carries the null
+    return Column(jnp.asarray(_pad(vals, cap)),
+                  jnp.asarray(_pad(valid_np, cap))
+                  if valid_np is not None else None, dtype, None)
+
+
+def arrow_to_table(at: pa.Table, columns: Optional[Sequence[str]] = None,
+                   capacity: Optional[int] = None) -> Table:
+    if columns is not None:
+        at = at.select(list(columns))
+    n = at.num_rows
+    cap = capacity if capacity is not None else round_capacity(n)
+    cols: Dict[str, Column] = {}
+    for name in at.column_names:
+        cols[name] = _arrow_column(at.column(name), cap)
+    return Table(cols, n, REP, None)
+
+
+def table_to_arrow(t: Table) -> pa.Table:
+    t = t.gather() if t.distribution == ONED else t
+    import jax
+    arrays = {}
+    for name, col in t.columns.items():
+        data = np.asarray(jax.device_get(col.data))[: t.nrows]
+        valid = (np.asarray(jax.device_get(col.valid))[: t.nrows]
+                 if col.valid is not None else None)
+        mask = None if valid is None else ~valid
+        if col.dtype is dt.STRING:
+            dic = pa.array(col.dictionary if col.dictionary is not None
+                           else np.array([], dtype=str))
+            idx = np.clip(data, 0, max(len(dic) - 1, 0)).astype(np.int32)
+            arr = pa.DictionaryArray.from_arrays(
+                pa.array(idx, mask=mask), dic)
+            arrays[name] = arr.cast(pa.string())
+        elif col.dtype is dt.DATETIME:
+            arrays[name] = pa.array(data.view("datetime64[ns]"), mask=mask)
+        elif col.dtype is dt.DATE:
+            arrays[name] = pa.array(data, type=pa.date32(), mask=mask)
+        else:
+            arrays[name] = pa.array(data, mask=mask)
+    return pa.table(arrays)

@@ -1,0 +1,32 @@
+"""CSV reader (Arrow-based).
+
+Analogue of the reference's chunked parallel CSV reader
+(bodo/io/_csv_json_reader.cpp, bodo/ir/csv_ext.py:49). pyarrow's
+multithreaded C++ parser does the heavy lifting on host; parse_dates
+mirrors the pandas read_csv option used by the benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from bodo_tpu.io.arrow_bridge import arrow_to_table
+from bodo_tpu.table.table import Table
+
+
+def read_csv(path: str, columns: Optional[Sequence[str]] = None,
+             parse_dates: Optional[Sequence[str]] = None) -> Table:
+    convert = {}
+    if parse_dates:
+        convert = {c: pa.timestamp("ns") for c in parse_dates}
+    at = pacsv.read_csv(
+        path,
+        convert_options=pacsv.ConvertOptions(
+            column_types=convert,
+            include_columns=list(columns) if columns else None,
+        ),
+    )
+    return arrow_to_table(at)

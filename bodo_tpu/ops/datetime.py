@@ -1,0 +1,104 @@
+"""Datetime field extraction kernels (int64 ns ticks → civil fields).
+
+TPU-native replacement for the reference's Timestamp/datetime extension
+kernels (bodo/hiframes/pd_timestamp_ext.py, series_dt_impl.py). All
+kernels are branch-free integer arithmetic over the VPU, using the
+standard civil-from-days algorithm; no host callbacks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+NS_PER_DAY = np.int64(86_400_000_000_000)
+NS_PER_HOUR = np.int64(3_600_000_000_000)
+NS_PER_MIN = np.int64(60_000_000_000)
+NS_PER_SEC = np.int64(1_000_000_000)
+
+
+def days_from_ns(ns):
+    """Days since 1970-01-01 (floor division — correct for pre-epoch)."""
+    return jnp.floor_divide(ns, NS_PER_DAY).astype(jnp.int64)
+
+
+def _civil(days):
+    """(year, month, day) from days-since-epoch; branch-free."""
+    z = days + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(doe - doe // 1460 + doe // 36524 - doe // 146096,
+                           365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int64), m.astype(jnp.int64), d.astype(jnp.int64)
+
+
+def year(ns):
+    return _civil(days_from_ns(ns))[0]
+
+
+def month(ns):
+    return _civil(days_from_ns(ns))[1]
+
+
+def day(ns):
+    return _civil(days_from_ns(ns))[2]
+
+
+def hour(ns):
+    tod = ns - days_from_ns(ns) * NS_PER_DAY
+    return jnp.floor_divide(tod, NS_PER_HOUR).astype(jnp.int64)
+
+
+def minute(ns):
+    tod = ns - days_from_ns(ns) * NS_PER_DAY
+    return jnp.floor_divide(tod % NS_PER_HOUR, NS_PER_MIN).astype(jnp.int64)
+
+
+def second(ns):
+    tod = ns - days_from_ns(ns) * NS_PER_DAY
+    return jnp.floor_divide(tod % NS_PER_MIN, NS_PER_SEC).astype(jnp.int64)
+
+
+def dayofweek(ns):
+    """Monday=0 (pandas convention); 1970-01-01 was a Thursday (=3)."""
+    return ((days_from_ns(ns) + 3) % 7).astype(jnp.int64)
+
+
+def date(ns):
+    """Date as int32 days since epoch (the DATE physical repr)."""
+    return days_from_ns(ns).astype(jnp.int32)
+
+
+def dayofyear(ns):
+    y, m, d = _civil(days_from_ns(ns))
+    # days from civil for Jan 1 of y
+    jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+    return (days_from_ns(ns) - jan1 + 1).astype(jnp.int64)
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def quarter(ns):
+    return jnp.floor_divide(month(ns) - 1, 3) + 1
+
+
+FIELDS = {
+    "year": year, "month": month, "day": day, "hour": hour,
+    "minute": minute, "second": second, "dayofweek": dayofweek,
+    "weekday": dayofweek, "dayofyear": dayofyear, "quarter": quarter,
+    "date": date,
+}

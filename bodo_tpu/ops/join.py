@@ -1,0 +1,164 @@
+"""Join kernels: sort-based equi-join with exact multi-key matching.
+
+TPU-native replacement for the reference's hash-join family
+(bodo/libs/_hash_join.cpp, _join_hashing.cpp, streaming/_join.h:892
+HashJoinState). Hash tables don't map well to XLA's static dataflow, so
+we use a union-segmentation design instead (SURVEY.md §7 "sort-based
+fallback is the safety net", here promoted to the primary):
+
+  1. concatenate probe+build key columns and segment them with the same
+     stable sort machinery as groupby — every row gets an exact group id
+     (gid); key equality becomes integer gid equality, which also makes
+     multi-key joins exact without composite-key bit-packing.
+  2. order build rows by gid; per-gid [start, count) ranges come from a
+     cumsum. Each probe row matches `count[gid]` build rows.
+  3. expansion: output slot j maps back to its (probe, build) pair with
+     one searchsorted over the exclusive cumsum of match counts — fully
+     static shapes, with an overflow flag the host uses to re-bucket
+     (the analogue of the reference's partition re-splitting).
+
+Dynamic output size is handled by the two-call pattern: `join_count`
+returns the exact row count, the host picks a padded capacity bucket,
+then `join_local` materializes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bodo_tpu.ops import kernels as K
+from bodo_tpu.ops import sort_encoding as SE
+
+
+def _union_gids(probe_keys, build_keys, p_padmask, b_padmask):
+    """Segment the union of probe+build keys; returns (gid_p, gid_b).
+
+    Excluded rows (padding or null key) get gid == ucap (sentinel, matches
+    nothing because build counts are only accumulated for real rows)."""
+    pcap = probe_keys[0][0].shape[0]
+    bcap = build_keys[0][0].shape[0]
+    ucap = pcap + bcap
+    unionmask = jnp.concatenate([p_padmask, b_padmask])
+    operands: List = []
+    ukeys = []
+    for (pd_, pv), (bd, bv) in zip(probe_keys, build_keys):
+        d = jnp.concatenate([pd_, bd.astype(pd_.dtype)])
+        if pv is None and bv is None:
+            v = None
+        else:
+            pv_ = pv if pv is not None else jnp.ones(pcap, dtype=bool)
+            bv_ = bv if bv is not None else jnp.ones(bcap, dtype=bool)
+            v = jnp.concatenate([pv_, bv_])
+        ukeys.append((d, v))
+        nf = SE.null_flag(d, v)
+        if nf is not None:
+            unionmask = unionmask & ~nf
+        operands.extend(SE.key_operands(d, v, padmask=unionmask))
+    nko = len(operands)
+    operands.append(jnp.arange(ucap))
+    perm = lax.sort(tuple(operands), num_keys=nko, is_stable=True)[-1]
+    umask_s = unionmask[perm]
+    pos = jnp.arange(ucap)
+    diff = jnp.zeros(ucap, dtype=bool).at[0].set(True)
+    for d, _ in ukeys:
+        ks = d[perm]
+        diff = diff | (ks != jnp.roll(ks, 1))
+    new_group = umask_s & (diff | (pos == 0))
+    seg = jnp.maximum(jnp.cumsum(new_group) - 1, 0)
+    seg = jnp.where(umask_s, seg, ucap)  # sentinel for excluded rows
+    gid = jnp.zeros(ucap, dtype=jnp.int64).at[perm].set(seg)
+    return gid[:pcap], gid[pcap:]
+
+
+def _join_plan(probe_keys, build_keys, probe_count, build_count,
+               how: str):
+    pcap = probe_keys[0][0].shape[0]
+    bcap = build_keys[0][0].shape[0]
+    ucap = pcap + bcap
+    p_pad = K.row_mask(probe_count, pcap)
+    b_pad = K.row_mask(build_count, bcap)
+    gid_p, gid_b = _union_gids(probe_keys, build_keys, p_pad, b_pad)
+
+    # order build rows by gid (sentinel rows last)
+    gid_b_s, b_perm = lax.sort((gid_b, jnp.arange(bcap)), num_keys=1,
+                               is_stable=True)
+    bc = jax.ops.segment_sum(jnp.ones(bcap, dtype=jnp.int64),
+                             jnp.minimum(gid_b, ucap),
+                             num_segments=ucap + 1)
+    bc = bc.at[ucap].set(0)  # sentinel gid matches nothing
+    starts = jnp.cumsum(bc) - bc
+
+    keyed = gid_p < ucap  # real probe rows with non-null keys
+    matches = jnp.where(keyed, bc[jnp.minimum(gid_p, ucap)], 0)
+    if how in ("left", "outer"):
+        L = jnp.where(p_pad, jnp.maximum(matches, 1), 0)
+    else:  # inner
+        L = matches
+    offsets = jnp.cumsum(L) - L
+    total = jnp.sum(L)
+    return gid_p, b_perm, bc, starts, offsets, L, total, p_pad
+
+
+@partial(jax.jit, static_argnames=("num_keys", "how"))
+def join_count(probe_keys, build_keys, probe_count, build_count,
+               num_keys: int, how: str):
+    """Exact output row count of the join (cheap pre-pass; the host uses
+    it to pick the materialization capacity bucket)."""
+    *_, total, _ = _join_plan(probe_keys, build_keys, probe_count,
+                              build_count, how)
+    return total
+
+
+@partial(jax.jit, static_argnames=("num_keys", "how", "out_capacity"))
+def join_local(probe_arrays, build_arrays, probe_count, build_count,
+               num_keys: int, how: str, out_capacity: int):
+    """Materialize the equi-join.
+
+    probe_arrays/build_arrays: tuples of (data, valid); the first
+    `num_keys` of each are the join keys (positionally aligned).
+    Returns (out_probe, out_build, out_count, overflow):
+      out_probe — all probe columns gathered per output row,
+      out_build — all build columns (valid=False on unmatched left rows),
+      overflow — True if out_capacity was too small (host retries bigger).
+    """
+    probe_keys = probe_arrays[:num_keys]
+    build_keys = build_arrays[:num_keys]
+    gid_p, b_perm, bc, starts, offsets, L, total, p_pad = _join_plan(
+        probe_keys, build_keys, probe_count, build_count, how)
+    ucap = gid_p.shape[0] + b_perm.shape[0]
+    bcap = b_perm.shape[0]
+
+    j = jnp.arange(out_capacity)
+    live = j < total
+    pidx = jnp.clip(jnp.searchsorted(offsets, j, side="right") - 1,
+                    0, gid_p.shape[0] - 1)
+    k = j - offsets[pidx]
+    g = jnp.minimum(gid_p[pidx], ucap)
+    matched = live & (k < bc[g])
+    bpos = jnp.clip(starts[g] + k, 0, bcap - 1)
+    bidx = b_perm[bpos]
+
+    out_probe = []
+    for d, v in probe_arrays:
+        od = jnp.where(live, d[pidx], jnp.zeros((), d.dtype))
+        ov = None
+        if v is not None:
+            ov = live & v[pidx]
+        out_probe.append((od, ov))
+    out_build = []
+    for d, v in build_arrays:
+        od = jnp.where(matched, d[bidx], jnp.zeros((), d.dtype))
+        base_v = matched if v is None else (matched & v[bidx])
+        # build side columns are nullable after a left join
+        ov = base_v if how in ("left", "outer") else (
+            None if v is None else base_v)
+        out_build.append((od, ov))
+    out_count = jnp.minimum(total, out_capacity)
+    overflow = total > out_capacity
+    return tuple(out_probe), tuple(out_build), out_count, overflow

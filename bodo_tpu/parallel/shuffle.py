@@ -136,19 +136,16 @@ def _finalize(op: str, cols, orig_dtype):
 
 
 @lru_cache(maxsize=256)
-def _build_groupby_sharded(mesh_key, num_keys: int, specs: Tuple[str, ...],
-                           bucket_cap: int, final_cap: int):
-    """Build the jitted shard_map groupby pipeline for a mesh/spec combo."""
+def _build_groupby_partial(mesh_key, num_keys: int, specs: Tuple[str, ...]):
+    """Stage 1: per-shard partial aggregation (shrinks data before the
+    wire — the reference's local-combine motivation)."""
     mesh = _MESHES[mesh_key]
     axis = config.data_axis
-    S = mesh.shape[axis]
-    partial_specs, combine_specs, layout = _plan_decomposition(specs)
+    partial_specs, _, _ = _plan_decomposition(specs)
 
     def body(arrays, counts):
         count = counts[0]
         cap = arrays[0][0].shape[0]
-        # 1. local partial aggregation (shrinks data before the wire —
-        #    same motivation as the reference's local combine step)
         keys = arrays[:num_keys]
         values = arrays[num_keys:]
         p_inputs = tuple(keys) + tuple(
@@ -156,7 +153,28 @@ def _build_groupby_sharded(mesh_key, num_keys: int, specs: Tuple[str, ...],
             for _ in DECOMPOSE[op])
         pk, pv, ng = groupby_local(p_inputs, count, partial_specs, cap,
                                    num_keys)
-        # 2. hash-partition shuffle of partial rows
+        return (pk, pv), ng[None]
+
+    shd = C.smap(body, in_specs=(P(axis), P(axis)),
+                 out_specs=(P(axis), P(axis)), mesh=mesh)
+    return jax.jit(shd)
+
+
+@lru_cache(maxsize=256)
+def _build_groupby_combine(mesh_key, num_keys: int, specs: Tuple[str, ...],
+                           value_dtypes: Tuple, bucket_cap: int,
+                           final_cap: int):
+    """Stage 2: hash-shuffle partial rows at a tight bucket capacity, then
+    combine + finalize. The host sizes bucket_cap from stage-1 counts and
+    retries on overflow (analogue of partition re-splitting)."""
+    mesh = _MESHES[mesh_key]
+    axis = config.data_axis
+    S = mesh.shape[axis]
+    _, combine_specs, layout = _plan_decomposition(specs)
+
+    def body(partials, ngs):
+        pk, pv = partials
+        ng = ngs[0]
         h = hash_columns(pk)
         dest = dest_shard(h, S)
         flat: List = [d for d, _ in pk]
@@ -169,7 +187,6 @@ def _build_groupby_sharded(mesh_key, num_keys: int, specs: Tuple[str, ...],
             else:
                 valmask_slots.append(None)
         out, cnt2, ovf = shuffle_rows(dest, flat, ng, S, bucket_cap, axis)
-        # rebuild (data, valid) structure
         rk = tuple((out[i], None) for i in range(num_keys))
         rv = []
         j = num_keys
@@ -180,23 +197,17 @@ def _build_groupby_sharded(mesh_key, num_keys: int, specs: Tuple[str, ...],
             else:
                 rv.append((out[j], out[j + 1].astype(bool)))
                 j += 2
-        # 3. combine
-        c_inputs = rk + tuple(rv)
-        fk, fv, ng2 = groupby_local(c_inputs, cnt2, combine_specs, final_cap,
-                                    num_keys)
-        # 4. finalize
+        fk, fv, ng2 = groupby_local(rk + tuple(rv), cnt2, combine_specs,
+                                    final_cap, num_keys)
         finals = []
         for i, op in enumerate(specs):
             off, n = layout[i]
-            orig_dtype = values[i][0].dtype
-            finals.append(_finalize(op, fv[off:off + n], orig_dtype))
-        out_tree = (fk, tuple(finals))
-        return out_tree, ng2[None], ovf[None]
+            finals.append(_finalize(op, fv[off:off + n],
+                                    jnp.dtype(value_dtypes[i])))
+        return (fk, tuple(finals)), ng2[None], ovf[None]
 
-    shd = C.smap(body,
-                 in_specs=(P(axis), P(axis)),
-                 out_specs=(P(axis), P(axis), P(axis)),
-                 mesh=mesh)
+    shd = C.smap(body, in_specs=(P(axis), P(axis)),
+                 out_specs=(P(axis), P(axis), P(axis)), mesh=mesh)
     return jax.jit(shd)
 
 
@@ -210,13 +221,40 @@ def _mesh_key(mesh):
 
 
 def groupby_sharded(arrays, counts, num_keys: int, specs: Tuple[str, ...],
-                    bucket_cap: int, final_cap: int, mesh=None):
-    """Distributed groupby over row-sharded arrays.
+                    bucket_cap=None, final_cap=None, mesh=None):
+    """Distributed two-phase groupby over row-sharded arrays.
 
     arrays: tuple of (data, valid) with data sharded [S*cap]; counts [S].
     Returns ((out_keys, out_finals), n_groups [S], overflow [S]).
+
+    Host-visible staging: after the partial stage the host reads the
+    per-shard partial counts and sizes the shuffle buckets tightly
+    (expected rows per (src,dest) pair × skew headroom), growing them on
+    overflow up to the always-safe bound (= max partial count).
     """
+    from bodo_tpu.table.table import round_capacity
     m = mesh or mesh_mod.get_mesh()
-    fn = _build_groupby_sharded(_mesh_key(m), num_keys, specs, bucket_cap,
-                                final_cap)
-    return fn(tuple(arrays), counts)
+    S = m.shape[config.data_axis]
+    mk = _mesh_key(m)
+    value_dtypes = tuple(str(arrays[num_keys + i][0].dtype)
+                         for i in range(len(specs)))
+
+    partials, ngs = _build_groupby_partial(mk, num_keys, specs)(
+        tuple(arrays), counts)
+    png = np.asarray(jax.device_get(ngs)).reshape(-1)
+    max_png = int(png.max()) if len(png) else 0
+    safe_cap = round_capacity(max(max_png, 1))
+    if bucket_cap is None:
+        bucket_cap = round_capacity(
+            int(config.shuffle_skew_factor * max(max_png, 1) / S) + 64)
+        bucket_cap = min(bucket_cap, safe_cap)
+    while True:
+        fcap = final_cap if final_cap is not None else S * bucket_cap
+        fn = _build_groupby_combine(mk, num_keys, specs, value_dtypes,
+                                    bucket_cap, fcap)
+        out, ng2, ovf = fn(partials, ngs)
+        if not np.asarray(jax.device_get(ovf)).any():
+            return out, ng2, ovf
+        if bucket_cap >= safe_cap:
+            raise RuntimeError("groupby shuffle overflow at safe capacity")
+        bucket_cap = min(bucket_cap * 4, safe_cap)

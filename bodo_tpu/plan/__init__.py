@@ -1,0 +1,6 @@
+"""Logical plan layer: expression IR, plan nodes, optimizer.
+
+Analogue of the reference's LazyPlan node set and expression nodes
+(bodo/pandas/plan.py:44-1060) — but optimized by our own rules instead of
+the vendored DuckDB optimizer.
+"""

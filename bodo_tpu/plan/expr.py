@@ -1,0 +1,368 @@
+"""Hashable expression IR + JAX evaluator.
+
+Analogue of the reference's expression nodes (bodo/pandas/plan.py:560-760
+ColRefExpression/ArithOpExpression/ComparisonOpExpression/...). Being
+frozen dataclasses, expressions are hashable and serve directly as jit
+cache keys, so each distinct expression tree compiles exactly once.
+
+String predicates evaluate against the host-side dictionary (tiny) and
+become a boolean lookup-table gather on device — the dict-encoding trick
+the reference uses for string-heavy workloads (bodo/libs/dict_arr_ext.py).
+Null semantics follow SQL/pandas-float behavior: arithmetic propagates
+nulls; comparisons with null produce null, and filters treat null as
+False.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.ops import datetime as dtops
+from bodo_tpu.table import dtypes as dt
+
+
+class Expr:
+    """Base class; all subclasses are frozen/hashable."""
+
+    # -- operator sugar used by the frontend --------------------------------
+    def _bin(self, op, other, reverse=False):
+        o = other if isinstance(other, Expr) else Lit(other)
+        return BinOp(op, o, self) if reverse else BinOp(op, self, o)
+
+    def __add__(self, o): return self._bin("+", o)
+    def __radd__(self, o): return self._bin("+", o, True)
+    def __sub__(self, o): return self._bin("-", o)
+    def __rsub__(self, o): return self._bin("-", o, True)
+    def __mul__(self, o): return self._bin("*", o)
+    def __rmul__(self, o): return self._bin("*", o, True)
+    def __truediv__(self, o): return self._bin("/", o)
+    def __rtruediv__(self, o): return self._bin("/", o, True)
+    def __floordiv__(self, o): return self._bin("//", o)
+    def __mod__(self, o): return self._bin("%", o)
+    def __pow__(self, o): return self._bin("**", o)
+    def __eq__(self, o): return self._bin("==", o)  # type: ignore[override]
+    def __ne__(self, o): return self._bin("!=", o)  # type: ignore[override]
+    def __lt__(self, o): return self._bin("<", o)
+    def __le__(self, o): return self._bin("<=", o)
+    def __gt__(self, o): return self._bin(">", o)
+    def __ge__(self, o): return self._bin(">=", o)
+    def __and__(self, o): return self._bin("&", o)
+    def __rand__(self, o): return self._bin("&", o, True)
+    def __or__(self, o): return self._bin("|", o)
+    def __ror__(self, o): return self._bin("|", o, True)
+    def __invert__(self): return UnOp("~", self)
+    def __neg__(self): return UnOp("neg", self)
+    def key(self):
+        """Structural cache key (expressions can't be dict keys directly:
+        __eq__ is overloaded as the comparison *builder*)."""
+        raise NotImplementedError
+
+    def isin(self, values): return IsIn(self, tuple(values))
+    def isna(self): return UnOp("isna", self)
+    def notna(self): return UnOp("notna", self)
+    def fillna(self, v): return Where(UnOp("isna", self), Lit(v), self)
+    def astype(self, dtype): return Cast(self, dt.from_numpy(np.dtype(dtype)))
+
+
+def _frozen(cls):
+    return dataclass(frozen=True, eq=False, repr=True)(cls)
+
+
+@_frozen
+class ColRef(Expr):
+    name: str
+    def key(self): return ("col", self.name)
+
+
+@_frozen
+class Lit(Expr):
+    value: Any
+    def key(self): return ("lit", str(type(self.value).__name__), self.value)
+
+
+@_frozen
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+    def key(self): return ("bin", self.op, self.left.key(), self.right.key())
+
+
+@_frozen
+class UnOp(Expr):
+    op: str
+    operand: Expr
+    def key(self): return ("un", self.op, self.operand.key())
+
+
+@_frozen
+class Cast(Expr):
+    operand: Expr
+    to: dt.DType
+    def key(self): return ("cast", self.operand.key(), self.to.name)
+
+
+@_frozen
+class DtField(Expr):
+    field: str
+    operand: Expr
+    def key(self): return ("dtf", self.field, self.operand.key())
+
+
+@_frozen
+class IsIn(Expr):
+    operand: Expr
+    values: Tuple
+    def key(self): return ("isin", self.operand.key(), self.values)
+
+
+@_frozen
+class Where(Expr):
+    cond: Expr
+    iftrue: Expr
+    iffalse: Expr
+    def key(self):
+        return ("where", self.cond.key(), self.iftrue.key(), self.iffalse.key())
+
+
+@_frozen
+class StrPredicate(Expr):
+    """String predicate evaluated on the host dictionary → device LUT.
+    kind: contains | startswith | endswith | match | eq_any | lower_eq"""
+    kind: str
+    pattern: Tuple
+    operand: Expr
+    def key(self):
+        return ("strp", self.kind, self.pattern, self.operand.key())
+
+
+# ---------------------------------------------------------------------------
+# schema-level type inference (host side)
+# ---------------------------------------------------------------------------
+
+def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
+    if isinstance(e, ColRef):
+        return schema[e.name]
+    if isinstance(e, Lit):
+        v = e.value
+        if isinstance(v, bool):
+            return dt.BOOL
+        if isinstance(v, (int, np.integer)):
+            return dt.INT64
+        if isinstance(v, (float, np.floating)):
+            return dt.FLOAT64
+        if isinstance(v, str):
+            return dt.STRING
+        if isinstance(v, (np.datetime64,)):
+            return dt.DATETIME
+        import datetime as _dtmod
+        if isinstance(v, _dtmod.date) and not isinstance(v, _dtmod.datetime):
+            return dt.DATE
+        raise TypeError(f"unsupported literal: {v!r}")
+    if isinstance(e, Cast):
+        return e.to
+    if isinstance(e, DtField):
+        return dt.DATE if e.field == "date" else dt.INT64
+    if isinstance(e, (IsIn, StrPredicate)):
+        return dt.BOOL
+    if isinstance(e, UnOp):
+        if e.op in ("isna", "notna", "~"):
+            return dt.BOOL
+        return infer_dtype(e.operand, schema)
+    if isinstance(e, Where):
+        t = infer_dtype(e.iftrue, schema)
+        f = infer_dtype(e.iffalse, schema)
+        if t is f:
+            return t
+        if dt.is_numeric(t) and dt.is_numeric(f):
+            return dt.common_numeric(t, f)
+        return t
+    if isinstance(e, BinOp):
+        if e.op in ("==", "!=", "<", "<=", ">", ">=", "&", "|"):
+            return dt.BOOL
+        lt = infer_dtype(e.left, schema)
+        rt = infer_dtype(e.right, schema)
+        if e.op == "/":
+            return dt.FLOAT64 if lt.numpy.itemsize == 8 or rt.numpy.itemsize == 8 \
+                else dt.FLOAT32
+        if dt.is_numeric(lt) and dt.is_numeric(rt):
+            return dt.common_numeric(lt, rt)
+        return lt
+    raise TypeError(f"cannot infer dtype of {e}")
+
+
+def expr_columns(e: Expr) -> set:
+    """Free column references (for projection pushdown)."""
+    if isinstance(e, ColRef):
+        return {e.name}
+    if isinstance(e, Lit):
+        return set()
+    if isinstance(e, BinOp):
+        return expr_columns(e.left) | expr_columns(e.right)
+    if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate)):
+        return expr_columns(e.operand)
+    if isinstance(e, Where):
+        return (expr_columns(e.cond) | expr_columns(e.iftrue)
+                | expr_columns(e.iffalse))
+    return set()
+
+
+# ---------------------------------------------------------------------------
+# evaluation (device side, traced)
+# ---------------------------------------------------------------------------
+
+_CMP = {"==": jnp.equal, "!=": jnp.not_equal, "<": jnp.less,
+        "<=": jnp.less_equal, ">": jnp.greater, ">=": jnp.greater_equal}
+
+
+def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
+              schema: Dict[str, dt.DType]):
+    """Evaluate to (data, valid_or_None). `tree` maps column name to
+    (data, valid); `dicts` holds host dictionaries for string columns."""
+    if isinstance(e, ColRef):
+        return tree[e.name]
+    if isinstance(e, Lit):
+        v = e.value
+        if isinstance(v, str):
+            raise TypeError(
+                "string literal outside a string predicate — wrap string "
+                "comparisons in StrPredicate (frontend does this)")
+        if isinstance(v, np.datetime64):
+            # match the DATETIME physical repr (int64 ns ticks)
+            return jnp.asarray(np.int64(v.astype("datetime64[ns]")
+                                        .astype(np.int64))), None
+        import datetime as _dtmod
+        if isinstance(v, _dtmod.date) and not isinstance(v, _dtmod.datetime):
+            # match the DATE physical repr (int32 days since epoch)
+            return jnp.asarray(np.int32(
+                (np.datetime64(v, "D") - np.datetime64(0, "D"))
+                .astype(np.int32))), None
+        return jnp.asarray(v), None
+    if isinstance(e, Cast):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        src = infer_dtype(e.operand, schema)
+        if e.to is dt.STRING:
+            raise TypeError("cast to string not supported on device")
+        if src.kind == "f" and e.to.kind in ("i", "u"):
+            nan = jnp.isnan(d)
+            v = (~nan) if v is None else (v & ~nan)
+            d = jnp.where(nan, 0, d)
+        return d.astype(e.to.numpy), v
+    if isinstance(e, DtField):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        return dtops.FIELDS[e.field](d), v
+    if isinstance(e, UnOp):
+        if e.op in ("isna", "notna"):
+            d, v = eval_expr(e.operand, tree, dicts, schema)
+            isna = jnp.zeros(d.shape, dtype=bool)
+            if v is not None:
+                isna = ~v
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                isna = isna | jnp.isnan(d)
+            return (isna if e.op == "isna" else ~isna), None
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        if e.op == "~":
+            return jnp.logical_not(d), v
+        if e.op == "neg":
+            return jnp.negative(d), v
+        raise ValueError(f"unknown unop {e.op}")
+    if isinstance(e, IsIn):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        src = infer_dtype(e.operand, schema)
+        if src is dt.STRING:
+            return eval_expr(StrPredicate("eq_any", tuple(e.values),
+                                          e.operand), tree, dicts, schema)
+        acc = jnp.zeros(d.shape, dtype=bool)
+        for val in e.values:
+            acc = acc | (d == val)
+        return acc, v
+    if isinstance(e, StrPredicate):
+        col = e.operand
+        if not isinstance(col, ColRef):
+            raise TypeError("string predicates must apply to a column")
+        dic = dicts.get(col.name)
+        if dic is None:
+            raise TypeError(f"column {col.name} has no dictionary")
+        lut = np.zeros(max(len(dic), 1), dtype=bool)
+        pats = [p for p in e.pattern]
+        for i, s in enumerate(dic):
+            if e.kind == "contains":
+                lut[i] = pats[0] in s
+            elif e.kind == "startswith":
+                lut[i] = s.startswith(tuple(pats))
+            elif e.kind == "endswith":
+                lut[i] = s.endswith(tuple(pats))
+            elif e.kind == "match":
+                lut[i] = re.match(pats[0], s) is not None
+            elif e.kind == "eq_any":
+                lut[i] = s in pats
+            elif e.kind == "lower_eq":
+                lut[i] = s.lower() == pats[0]
+            else:
+                raise ValueError(f"unknown str predicate {e.kind}")
+        d, v = tree[col.name]
+        res = jnp.asarray(lut)[jnp.clip(d, 0, len(dic) - 1)]
+        return res, v
+    if isinstance(e, Where):
+        c, cv = eval_expr(e.cond, tree, dicts, schema)
+        t, tv = eval_expr(e.iftrue, tree, dicts, schema)
+        f, fv = eval_expr(e.iffalse, tree, dicts, schema)
+        rdt = infer_dtype(e, schema)
+        if rdt is dt.STRING:
+            raise TypeError("string Where requires frontend dict rewrite")
+        t = jnp.asarray(t).astype(rdt.numpy)
+        f = jnp.asarray(f).astype(rdt.numpy)
+        cond = c if cv is None else (c & cv)
+        out = jnp.where(cond, t, f)
+        valid = None
+        if tv is not None or fv is not None:
+            tvv = tv if tv is not None else jnp.ones(out.shape, bool)
+            fvv = fv if fv is not None else jnp.ones(out.shape, bool)
+            valid = jnp.where(cond, tvv, fvv)
+        return out, valid
+    if isinstance(e, BinOp):
+        if e.op in ("&", "|"):
+            ld, lv = eval_expr(e.left, tree, dicts, schema)
+            rd, rv = eval_expr(e.right, tree, dicts, schema)
+            # null-as-False three-valued logic collapse (filter semantics)
+            if lv is not None:
+                ld = ld & lv
+            if rv is not None:
+                rd = rd & rv
+            return (ld & rd if e.op == "&" else ld | rd), None
+        ld, lv = eval_expr(e.left, tree, dicts, schema)
+        rd, rv = eval_expr(e.right, tree, dicts, schema)
+        lt = infer_dtype(e.left, schema)
+        rt = infer_dtype(e.right, schema)
+        if lt is dt.STRING or rt is dt.STRING:
+            raise TypeError(
+                "string comparison must be rewritten to dict codes by the "
+                "frontend (StrPredicate / code-space compare)")
+        valid = None
+        if lv is not None or rv is not None:
+            valid = (lv if lv is not None else jnp.ones(ld.shape, bool)) & \
+                    (rv if rv is not None else jnp.ones(rd.shape, bool))
+        if e.op in _CMP:
+            return _CMP[e.op](ld, rd), valid
+        if e.op == "+":
+            return ld + rd, valid
+        if e.op == "-":
+            return ld - rd, valid
+        if e.op == "*":
+            return ld * rd, valid
+        if e.op == "/":
+            rdt = infer_dtype(e, schema)
+            return ld.astype(rdt.numpy) / rd.astype(rdt.numpy), valid
+        if e.op == "//":
+            return jnp.floor_divide(ld, jnp.where(rd == 0, 1, rd)), valid
+        if e.op == "%":
+            return jnp.mod(ld, jnp.where(rd == 0, 1, rd)), valid
+        if e.op == "**":
+            return jnp.power(ld, rd), valid
+        raise ValueError(f"unknown binop {e.op}")
+    raise TypeError(f"cannot evaluate {e}")

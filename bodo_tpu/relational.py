@@ -1,0 +1,565 @@
+"""Table-level relational operators (the physical-op layer).
+
+This is the analogue of the reference's physical operator set
+(bodo/pandas/physical/*.h — project/filter/join/aggregate/sort) driving
+the C++ streaming pipelines (bodo/pandas/_executor.h:76). Here each
+operator is a host function over `Table` that dispatches cached jitted
+kernels; REP tables run the local kernel, 1D tables run the shard_map
+pipeline with explicit collectives. Dynamic result sizes use the
+count-sync + capacity-bucket pattern: kernels return device row counts,
+the host reads them (one scalar sync per pipeline stage, the analogue of
+the reference's batch-size bookkeeping) and retries with a larger
+capacity on overflow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from bodo_tpu.config import config
+from bodo_tpu.ops import kernels as K
+from bodo_tpu.ops.groupby import groupby_local, result_dtype
+from bodo_tpu.ops.hashing import dest_shard, hash_columns
+from bodo_tpu.ops.join import join_count, join_local
+from bodo_tpu.ops.sort import sort_local, sort_sharded
+from bodo_tpu.parallel import collectives as C
+from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.parallel.shuffle import (_mesh_key, _MESHES, groupby_sharded,
+                                       shuffle_rows)
+from bodo_tpu.plan.expr import Expr, eval_expr, infer_dtype
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.table.dict_utils import unify_dictionaries
+from bodo_tpu.table.table import Column, ONED, REP, Table, round_capacity
+
+_jit_cache: Dict = {}
+
+
+def _schema(t: Table) -> Dict[str, dt.DType]:
+    return {n: c.dtype for n, c in t.columns.items()}
+
+
+def _dicts(t: Table) -> Dict[str, np.ndarray]:
+    return {n: c.dictionary for n, c in t.columns.items()
+            if c.dictionary is not None}
+
+
+_dict_fp_cache: Dict[int, Tuple] = {}  # id -> (weakref, fingerprint)
+
+
+def _dict_fp(d: Optional[np.ndarray]) -> int:
+    if d is None:
+        return 0
+    ent = _dict_fp_cache.get(id(d))
+    if ent is not None and ent[0]() is d:  # guard against id reuse after GC
+        return ent[1]
+    import weakref
+    fp = hash(d.tobytes())
+    key = id(d)
+    _dict_fp_cache[key] = (weakref.ref(
+        d, lambda _: _dict_fp_cache.pop(key, None)), fp)
+    return fp
+
+
+def _sig(t: Table) -> Tuple:
+    """Schema signature for kernel caching (dict contents included because
+    string predicates bake the dictionary LUT into the trace)."""
+    return tuple((n, c.dtype.name, c.valid is not None,
+                  _dict_fp(c.dictionary)) for n, c in t.columns.items())
+
+
+# ---------------------------------------------------------------------------
+# projection / assignment
+# ---------------------------------------------------------------------------
+
+def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
+    """Add/replace columns computed from expressions (df.assign analogue)."""
+    schema = _schema(t)
+    dicts = _dicts(t)
+    key = ("assign", _sig(t), tuple((n, e.key()) for n, e in new.items()),
+           t.distribution)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        exprs = dict(new)
+
+        @jax.jit
+        def fn(tree):
+            out = dict(tree)
+            for name, e in exprs.items():
+                out[name] = eval_expr(e, tree, dicts, schema)
+            return out
+        _jit_cache[key] = fn
+    out_tree = fn(t.device_data())
+    dtypes = {n: infer_dtype(e, schema) for n, e in new.items()}
+    res = t.with_device_data(out_tree, dtypes=dtypes)
+    # expression outputs that are plain numerics drop any stale dictionary
+    for n in new:
+        if res.columns[n].dtype is not dt.STRING:
+            res.columns[n] = Column(res.columns[n].data, res.columns[n].valid,
+                                    res.columns[n].dtype, None)
+    return res
+
+
+def select_columns(t: Table, names: Sequence[str]) -> Table:
+    return t.select(list(names))
+
+
+def assign_categorical(t: Table, name: str, code_expr: Expr,
+                       categories: Sequence[str]) -> Table:
+    """Add a string column from an integer code expression + category list
+    (the device-side analogue of `Series.map({...})` onto strings: strings
+    never touch the device, only their codes do).
+
+    `code_expr` must produce indices into `sorted(categories)`.
+    """
+    cats = np.asarray(sorted(categories), dtype=str)
+    res = assign_columns(t, {name: code_expr})
+    c = res.columns[name]
+    res.columns[name] = Column(c.data.astype(np.int32), c.valid, dt.STRING,
+                               cats)
+    return res
+
+
+def category_code(categories: Sequence[str], value: str) -> int:
+    """Code of `value` in the sorted-category dictionary."""
+    return int(np.searchsorted(np.asarray(sorted(categories)), value))
+
+
+# ---------------------------------------------------------------------------
+# filter
+# ---------------------------------------------------------------------------
+
+def filter_table(t: Table, predicate: Expr) -> Table:
+    """Filter rows; null predicate counts as False (SQL semantics)."""
+    schema = _schema(t)
+    dicts = _dicts(t)
+    names = t.names
+    m = mesh_mod.get_mesh()
+    key = ("filter", _mesh_key(m), _sig(t), predicate.key(), t.distribution)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        def body(tree, count):
+            cap = tree[names[0]][0].shape[0]
+            mask, mv = eval_expr(predicate, tree, dicts, schema)
+            if mv is not None:
+                mask = mask & mv
+            mask = mask & K.row_mask(count, cap)
+            flat = []
+            for n in names:
+                d, v = tree[n]
+                flat.append(d)
+                flat.append(v)
+            out, cnt = K.compact(mask, tuple(flat))
+            out_tree = {n: (out[2 * i], out[2 * i + 1])
+                        for i, n in enumerate(names)}
+            return out_tree, cnt
+
+        if t.distribution == ONED:
+            m = mesh_mod.get_mesh()
+            ax = config.data_axis
+
+            def sharded(tree, counts):
+                out_tree, cnt = body(tree, counts[0])
+                return out_tree, cnt[None]
+            fn = jax.jit(C.smap(sharded, in_specs=(P(ax), P(ax)),
+                                out_specs=(P(ax), P(ax)), mesh=m))
+        else:
+            def rep(tree, count):
+                return body(tree, count)
+            fn = jax.jit(rep)
+        _jit_cache[key] = fn
+
+    if t.distribution == ONED:
+        out_tree, cnts = fn(t.device_data(), t.counts_device())
+        counts = np.asarray(jax.device_get(cnts)).astype(np.int64)
+        return rebucket(t.with_device_data(out_tree, nrows=int(counts.sum()),
+                                           counts=counts))
+    out_tree, cnt = fn(t.device_data(), jnp.asarray(t.nrows))
+    return rebucket(t.with_device_data(out_tree, nrows=int(cnt)))
+
+
+# ---------------------------------------------------------------------------
+# groupby aggregate
+# ---------------------------------------------------------------------------
+
+def groupby_agg(t: Table, keys: Sequence[str],
+                aggs: Sequence[Tuple[str, str, str]]) -> Table:
+    """Group by `keys`; aggs = [(value_col, op, out_name)].
+    Output sorted by keys ascending (pandas sort=True)."""
+    keys = list(keys)
+    specs = tuple(op for _, op, _ in aggs)
+    val_names = [c for c, _, _ in aggs]
+    arrays = tuple((t.column(k).data, t.column(k).valid) for k in keys) + \
+        tuple((t.column(c).data, t.column(c).valid) for c in val_names)
+
+    if t.distribution == ONED:
+        t = shrink_to_fit(t)
+        arrays = tuple((t.column(k).data, t.column(k).valid) for k in keys) + \
+            tuple((t.column(c).data, t.column(c).valid) for c in val_names)
+        # bucket/final capacities are sized by the host from stage-1
+        # partial counts (with overflow retry) inside groupby_sharded
+        (out_keys, out_vals), ngs, ovf = groupby_sharded(
+            arrays, t.counts_device(), len(keys), specs)
+        counts = np.asarray(jax.device_get(ngs)).reshape(-1).astype(np.int64)
+        nrows, dist = int(counts.sum()), ONED
+    else:
+        out_keys, out_vals, ng = groupby_local(
+            arrays, jnp.asarray(t.nrows), specs, t.capacity, len(keys))
+        counts, dist = None, REP
+        nrows = int(ng)
+
+    cols: Dict[str, Column] = {}
+    for kname, (kd, kv) in zip(keys, out_keys):
+        src = t.column(kname)
+        cols[kname] = Column(kd, kv, src.dtype, src.dictionary)
+    for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
+        src = t.column(cname)
+        rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
+        if op in ("min", "max", "first", "last"):
+            rdt = src.dtype
+        cols[oname] = Column(vd, vv, rdt,
+                             src.dictionary if rdt is dt.STRING else None)
+    return shrink_to_fit(Table(cols, nrows, dist, counts))
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def sort_table(t: Table, by: Sequence[str], ascending=None,
+               na_last: bool = True) -> Table:
+    by = list(by)
+    if ascending is None:
+        ascending = [True] * len(by)
+    elif isinstance(ascending, bool):
+        ascending = [ascending] * len(by)
+    others = [n for n in t.names if n not in by]
+    order = by + others
+    arrays = tuple((t.column(n).data, t.column(n).valid) for n in order)
+
+    if t.distribution == ONED:
+        t = shrink_to_fit(t)
+        arrays = tuple((t.column(n).data, t.column(n).valid) for n in order)
+        out, cnts = sort_sharded(arrays, t.counts_device(), len(by),
+                                 tuple(ascending), na_last)
+        counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
+        res_tree = {n: out[i] for i, n in enumerate(order)}
+        res = shrink_to_fit(t.with_device_data(
+            res_tree, nrows=int(counts.sum()), counts=counts))
+    else:
+        out, _ = sort_local(arrays, jnp.asarray(t.nrows), len(by),
+                            tuple(ascending), na_last)
+        res_tree = {n: out[i] for i, n in enumerate(order)}
+        res = t.with_device_data(res_tree, nrows=t.nrows)
+    return res.select(t.names)
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def _suffix_columns(left: Table, right: Table, left_on, right_on,
+                    suffixes) -> Tuple[Dict[str, str], Dict[str, str]]:
+    overlap = (set(left.names) & set(right.names)) - \
+        (set(left_on) & set(right_on))
+    lmap = {n: (n + suffixes[0] if n in overlap else n) for n in left.names}
+    rmap = {n: (n + suffixes[1] if n in overlap else n) for n in right.names
+            if not (n in right_on and left_on[right_on.index(n)] == n)}
+    return lmap, rmap
+
+
+def join_tables(left: Table, right: Table, left_on: Sequence[str],
+                right_on: Sequence[str], how: str = "inner",
+                suffixes=("_x", "_y")) -> Table:
+    """Equi-join (pandas merge analogue). Build side = right."""
+    left_on, right_on = list(left_on), list(right_on)
+    assert how in ("inner", "left"), f"join how={how} not yet supported"
+
+    # unify dictionaries of string join keys so codes are comparable, and
+    # align numeric key dtypes so hashing/comparison agree across sides
+    left = left.with_columns(left.columns)
+    right = right.with_columns(right.columns)
+    for lk, rk in zip(left_on, right_on):
+        lc, rc = left.columns[lk], right.columns[rk]
+        if lc.dtype is dt.STRING or rc.dtype is dt.STRING:
+            _, (nl, nr) = unify_dictionaries([lc, rc])
+            left.columns[lk] = nl
+            right.columns[rk] = nr
+        elif lc.dtype is not rc.dtype and dt.is_numeric(lc.dtype) and \
+                dt.is_numeric(rc.dtype):
+            common = dt.common_numeric(lc.dtype, rc.dtype)
+            if lc.dtype is not common:
+                left.columns[lk] = Column(lc.data.astype(common.numpy),
+                                          lc.valid, common, None)
+            if rc.dtype is not common:
+                right.columns[rk] = Column(rc.data.astype(common.numpy),
+                                           rc.valid, common, None)
+
+    if left.distribution == REP and right.distribution == ONED:
+        left = left.shard()
+    if left.distribution == ONED and right.distribution == ONED:
+        return _join_sharded(left, right, left_on, right_on, how, suffixes)
+    if left.distribution == ONED and right.distribution == REP:
+        return _join_broadcast(left, right, left_on, right_on, how, suffixes)
+    return _join_rep(left, right, left_on, right_on, how, suffixes)
+
+
+def _probe_build_arrays(left, right, left_on, right_on):
+    lorder = left_on + [n for n in left.names if n not in left_on]
+    rorder = right_on + [n for n in right.names if n not in right_on]
+    pa = tuple((left.column(n).data, left.column(n).valid) for n in lorder)
+    ba = tuple((right.column(n).data, right.column(n).valid) for n in rorder)
+    return lorder, rorder, pa, ba
+
+
+def _assemble_join(left, right, left_on, right_on, lorder, rorder,
+                   out_p, out_b, nrows, counts, how, suffixes) -> Table:
+    lmap, rmap = _suffix_columns(left, right, left_on, right_on, suffixes)
+    cols: Dict[str, Column] = {}
+    for i, n in enumerate(lorder):
+        src = left.column(n)
+        d, v = out_p[i]
+        cols[lmap[n]] = Column(d, v, src.dtype, src.dictionary)
+    for i, n in enumerate(rorder):
+        if n not in rmap:
+            continue
+        src = right.column(n)
+        d, v = out_b[i]
+        cols[rmap[n]] = Column(d, v, src.dtype, src.dictionary)
+    dist = ONED if counts is not None else REP
+    res = Table(cols, nrows, dist, counts)
+    # restore pandas-ish column order: left cols then right cols
+    names = [lmap[n] for n in left.names] + \
+        [rmap[n] for n in right.names if n in rmap]
+    return res.select(names)
+
+
+def _join_rep(left, right, left_on, right_on, how, suffixes) -> Table:
+    lorder, rorder, pa, ba = _probe_build_arrays(left, right, left_on,
+                                                 right_on)
+    pc = jnp.asarray(left.nrows)
+    bc = jnp.asarray(right.nrows)
+    nk = len(left_on)
+    out_cap = round_capacity(max(left.nrows, right.nrows, 1))
+    for _ in range(2):
+        out_p, out_b, cnt, ovf = join_local(pa, ba, pc, bc, nk, how, out_cap)
+        if not bool(jax.device_get(ovf)):
+            break
+        total = int(join_count(pa[:nk], ba[:nk], pc, bc, nk, how))
+        out_cap = round_capacity(total)
+    nrows = int(jax.device_get(cnt))
+    return _assemble_join(left, right, left_on, right_on, lorder, rorder,
+                          out_p, out_b, nrows, None, how, suffixes)
+
+
+def _flatten_with_valids(arrays):
+    flat, slots = [], []
+    for d, v in arrays:
+        flat.append(d)
+        if v is not None:
+            slots.append(True)
+            flat.append(v)
+        else:
+            slots.append(False)
+    return flat, slots
+
+
+def _rebuild_from_flat(flat, slots):
+    out, j = [], 0
+    for has_v in slots:
+        if has_v:
+            out.append((flat[j], flat[j + 1].astype(bool)))
+            j += 2
+        else:
+            out.append((flat[j], None))
+            j += 1
+    return tuple(out)
+
+
+def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
+                           sig_key):
+    """shard_map join of co-located shards — probe rows and build rows
+    with equal keys are already on the same shard (hash shuffle happened
+    as a separate sized stage via shuffle_by_key), or the build side is
+    replicated (broadcast join, reference bodo/libs/_shuffle.h:153).
+    Analogue of the reference's partitioned hash join
+    (streaming/_join.h:892)."""
+    key = ("join", mesh_key, nk, how, out_cap, broadcast, sig_key)
+    fn = _jit_cache.get(key)
+    if fn is not None:
+        return fn
+    mesh = _MESHES[mesh_key]
+    ax = config.data_axis
+
+    def body(p_arrays, b_arrays, pcounts, bcounts):
+        out_p, out_b, cnt, ovf = join_local(
+            p_arrays, b_arrays, pcounts[0], bcounts[0], nk, how, out_cap)
+        return out_p, out_b, cnt[None], ovf[None]
+
+    fn = jax.jit(C.smap(body,
+                        in_specs=(P(ax), P() if broadcast else P(ax),
+                                  P(ax), P() if broadcast else P(ax)),
+                        out_specs=(P(ax), P(ax), P(ax), P(ax)),
+                        mesh=mesh))
+    _jit_cache[key] = fn
+    return fn
+
+
+def _join_sharded(left, right, left_on, right_on, how, suffixes,
+                  broadcast: bool = False) -> Table:
+    m = mesh_mod.get_mesh()
+    if not broadcast:
+        # co-locate equal keys, then join at tight static shapes
+        left = shuffle_by_key(left, left_on)
+        right = shuffle_by_key(right, right_on)
+    left = shrink_to_fit(left)
+    lorder, rorder, pa, ba = _probe_build_arrays(left, right, left_on,
+                                                 right_on)
+    nk = len(left_on)
+    pcap = left.shard_capacity
+    # optimistic: ≈1 match per probe row (the FK-join common case); the
+    # overflow flag grows the bucket, exact count caps the last retry
+    out_cap = round_capacity(2 * pcap)
+    if broadcast:
+        bcounts = jnp.asarray([right.nrows], dtype=jnp.int64)
+    else:
+        bcounts = right.counts_device()
+    sig_key = (_sig(left), _sig(right))
+    for attempt in range(2):
+        fn = _build_join_sharded_fn(_mesh_key(m), nk, how, out_cap,
+                                    broadcast, sig_key)
+        out_p, out_b, cnts, ovf = fn(pa, ba, left.counts_device(), bcounts)
+        if not np.asarray(jax.device_get(ovf)).any():
+            break
+        # exact per-shard counts, then one final right-sized run
+        cfn_key = ("join_count", _mesh_key(m), nk, how, sig_key)
+        cfn = _jit_cache.get(cfn_key)
+        if cfn is None:
+            ax = config.data_axis
+
+            def cbody(p_arrays, b_arrays, pcounts, bcounts_):
+                return join_count(p_arrays[:nk], b_arrays[:nk], pcounts[0],
+                                  bcounts_[0], nk, how)[None]
+            cfn = jax.jit(C.smap(
+                cbody,
+                in_specs=(P(ax), P() if broadcast else P(ax), P(ax),
+                          P() if broadcast else P(ax)),
+                out_specs=P(ax), mesh=m))
+            _jit_cache[cfn_key] = cfn
+        exact = np.asarray(jax.device_get(
+            cfn(pa, ba, left.counts_device(), bcounts)))
+        out_cap = round_capacity(int(exact.max()))
+    else:
+        raise RuntimeError("join output overflow after exact-count retry")
+    counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
+    res = _assemble_join(left, right, left_on, right_on, lorder, rorder,
+                         out_p, out_b, int(counts.sum()), counts, how,
+                         suffixes)
+    return shrink_to_fit(res)
+
+
+def _join_broadcast(left, right, left_on, right_on, how, suffixes) -> Table:
+    return _join_sharded(left, right, left_on, right_on, how, suffixes,
+                         broadcast=True)
+
+
+# ---------------------------------------------------------------------------
+# capacity hygiene
+# ---------------------------------------------------------------------------
+
+def _shrink_fn(S: int, old_cap: int, new_cap: int):
+    key = ("shrink", S, old_cap, new_cap)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        @jax.jit
+        def fn(tree):
+            out = {}
+            for n, (d, v) in tree.items():
+                d2 = d.reshape(S, old_cap)[:, :new_cap].reshape(S * new_cap)
+                v2 = None if v is None else \
+                    v.reshape(S, old_cap)[:, :new_cap].reshape(S * new_cap)
+                out[n] = (d2, v2)
+            return out
+        _jit_cache[key] = fn
+    return fn
+
+
+def shrink_to_fit(t: Table) -> Table:
+    """Shrink per-shard capacity to fit the real row counts (device-side
+    slice; rows are already compacted to the front of each shard). This is
+    the padding-hygiene step that keeps downstream sorts/shuffles sized to
+    the data, not to worst-case capacities."""
+    if t.distribution == ONED:
+        S = t.num_shards
+        old = t.shard_capacity
+        new = round_capacity(int(t.counts.max()) if len(t.counts) else 1)
+        if new >= old:
+            return t
+        tree = _shrink_fn(S, old, new)(t.device_data())
+        return t.with_device_data(tree, nrows=t.nrows, counts=t.counts)
+    old = t.capacity
+    new = round_capacity(max(t.nrows, 1))
+    if new >= old:
+        return t
+    tree = {n: (c.data[:new], None if c.valid is None else c.valid[:new])
+            for n, c in t.columns.items()}
+    return t.with_device_data(tree, nrows=t.nrows)
+
+
+def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
+    """Hash-partition rows over the mesh by key columns (the standalone
+    shuffle_table analogue, reference bodo/libs/_shuffle.h:41). Rows with
+    equal keys land on the same shard."""
+    assert t.distribution == ONED
+    m = mesh_mod.get_mesh()
+    S = mesh_mod.num_shards(m)
+    ax = config.data_axis
+    names = t.names
+    cap = t.shard_capacity
+    nk = len(key_cols)
+    korder = list(key_cols) + [n for n in names if n not in key_cols]
+    key = ("shuffle", _mesh_key(m), _sig(t.select(korder)), nk, cap)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        def body(arrs, counts):
+            cnt = counts[0]
+            dest = dest_shard(hash_columns(arrs[:nk]), S)
+            flat, _ = _flatten_with_valids(arrs)
+            out, cnt2, _ = shuffle_rows(dest, flat, cnt, S, cap, ax)
+            return _rebuild_from_flat(out, tuple(slots2)), cnt2[None]
+        slots2 = [t.column(n).valid is not None for n in korder]
+        fn = jax.jit(C.smap(body, in_specs=(P(ax), P(ax)),
+                            out_specs=(P(ax), P(ax)), mesh=m))
+        _jit_cache[key] = fn
+    karrays = tuple((t.column(n).data, t.column(n).valid) for n in korder)
+    out, cnts = fn(karrays, t.counts_device())
+    counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
+    tree = {n: out[i] for i, n in enumerate(korder)}
+    res = t.with_device_data(tree, nrows=int(counts.sum()), counts=counts)
+    return shrink_to_fit(res.select(names))
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def head_table(t: Table, n: int) -> Table:
+    g = t.gather() if t.distribution == ONED else t
+    n = min(n, g.nrows)
+    return Table(dict(g.columns), n, REP, None)
+
+
+def rebucket(t: Table) -> Table:
+    """Shrink physical capacity when occupancy drops below the threshold
+    (the re-bucketing step of the padded-capacity design, SURVEY.md §7)."""
+    occupancy_cap = (max(t.counts.max(), 1) * t.num_shards
+                     if t.distribution == ONED and len(t.counts)
+                     else max(t.nrows, 1))
+    if occupancy_cap / t.capacity >= config.rebucket_threshold:
+        return t
+    return shrink_to_fit(t)

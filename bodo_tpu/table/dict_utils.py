@@ -1,0 +1,43 @@
+"""Dictionary (string) column utilities: unification and re-encoding.
+
+The reference unifies dictionary-encoded string columns in its C++
+dict-builder (bodo/libs/_dict_builder.cpp, streaming/dict_encoding.py) so
+codes are comparable across tables (joins, concat). Here dictionaries are
+host-side sorted numpy string arrays; unification is a host `np.union1d`
+plus a device gather remap of the int32 codes (order-preserving since
+dictionaries stay sorted).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.table.table import Column
+
+
+def unify_dictionaries(cols: Sequence[Column]) -> Tuple[np.ndarray, List[Column]]:
+    """Re-encode string columns onto a shared sorted dictionary.
+
+    Returns (union_dictionary, new columns with remapped codes)."""
+    dicts = [c.dictionary if c.dictionary is not None
+             else np.array([], dtype=str) for c in cols]
+    if len(dicts) > 1:
+        union = dicts[0]
+        for d in dicts[1:]:
+            union = np.union1d(union, d)
+    else:
+        union = dicts[0]
+    out = []
+    for c, d in zip(cols, dicts):
+        if len(d) == len(union) and (len(d) == 0 or np.array_equal(d, union)):
+            out.append(Column(c.data, c.valid, c.dtype, union))
+            continue
+        mapping = np.searchsorted(union, d).astype(np.int32)
+        mp = jnp.asarray(mapping if len(mapping) else np.zeros(1, np.int32))
+        new_codes = mp[jnp.clip(c.data, 0, max(len(d) - 1, 0))]
+        out.append(Column(new_codes, c.valid, c.dtype, union))
+    return union, out

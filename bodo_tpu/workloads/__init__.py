@@ -1,0 +1,1 @@
+"""Benchmark/e2e workloads (north-star configs from BASELINE.md)."""

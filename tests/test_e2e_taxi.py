@@ -1,0 +1,33 @@
+"""End-to-end NYC-taxi-shaped workload vs the pandas oracle (M1 north-star
+slice: parquet+csv → datetime fields → join → derived cols → 6-key
+groupby → sort; reference benchmark shape from benchmarks/nyc_taxi)."""
+
+import numpy as np
+import pytest
+
+from bodo_tpu.workloads.taxi import (bodo_tpu_pipeline, gen_taxi_data,
+                                 pandas_pipeline)
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_taxi_pipeline_vs_pandas(mesh8, tmp_path, shard):
+    pq = str(tmp_path / "trips.parquet")
+    csv = str(tmp_path / "weather.csv")
+    gen_taxi_data(5000, pq, csv)
+
+    exp = pandas_pipeline(pq, csv)
+    out = bodo_tpu_pipeline(pq, csv, shard=shard)
+    got = out.to_pandas()
+
+    assert len(got) == len(exp)
+    keys = ["PULocationID", "DOLocationID", "month", "weekday",
+            "date_with_precipitation", "time_bucket"]
+    got = got.sort_values(keys).reset_index(drop=True)
+    for k in ("PULocationID", "DOLocationID", "month"):
+        np.testing.assert_array_equal(got[k].to_numpy(),
+                                      exp[k].to_numpy(), err_msg=k)
+    assert list(got["time_bucket"]) == list(exp["time_bucket"])
+    np.testing.assert_array_equal(got["weekday"].to_numpy().astype(bool),
+                                  exp["weekday"].to_numpy().astype(bool))
+    np.testing.assert_array_equal(got["trip_count"], exp["trip_count"])
+    np.testing.assert_allclose(got["avg_miles"], exp["avg_miles"], rtol=1e-9)

@@ -134,9 +134,8 @@ def test_groupby_sharded_vs_pandas(mesh8):
     arrays = tuple((t.column(k).data, t.column(k).valid) for k in keys) + \
         tuple((t.column(c).data, t.column(c).valid) for c, _ in aggs)
     specs = tuple(op for _, op in aggs)
-    cap = t.shard_capacity
     (out_keys, out_vals), ngs, ovf = groupby_sharded(
-        arrays, t.counts_device(), len(keys), specs, cap, cap)
+        arrays, t.counts_device(), len(keys), specs)
     assert not np.asarray(ovf).any()
     ngs = np.asarray(ngs)
     per = np.asarray(out_keys[0][0]).shape[0] // 8

@@ -61,6 +61,11 @@ class Config:
     bcast_join_threshold: int = field(
         default_factory=lambda: _env_int("BODO_TPU_BCAST_JOIN_THRESHOLD", 1 << 20)
     )
+    # Sources with fewer rows stay replicated (broadcast-join heuristic);
+    # larger ones are row-sharded over the mesh.
+    shard_min_rows: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_SHARD_MIN_ROWS", 100_000)
+    )
     # -- frontend ------------------------------------------------------------
     # Fall back to real pandas for unsupported args (reference:
     # bodo/pandas/utils.py:346 check_args_fallback).

@@ -32,7 +32,7 @@ from bodo_tpu.ops import sort_encoding as SE
 
 # primitive ops computable in one segment pass
 _PRIMITIVE = {"sum", "sumsq", "count", "size", "min", "max", "first", "last",
-              "prod", "mean", "var", "std", "nunique"}
+              "prod", "mean", "var", "std", "var0", "std0", "nunique"}
 
 # final op -> (partial ops, combine ops on partial cols)
 DECOMPOSE: Dict[str, List[str]] = {
@@ -47,6 +47,8 @@ DECOMPOSE: Dict[str, List[str]] = {
     "mean": ["sum", "count"],
     "var": ["sum", "sumsq", "count"],
     "std": ["sum", "sumsq", "count"],
+    "var0": ["sum", "sumsq", "count"],
+    "std0": ["sum", "sumsq", "count"],
 }
 COMBINE_OF = {"sum": "sum", "sumsq": "sum", "count": "sum", "size": "sum",
               "min": "min", "max": "max", "first": "first", "last": "last",
@@ -57,7 +59,7 @@ def result_dtype(op: str, dtype):
     d = jnp.dtype(dtype)
     if op in ("count", "size", "nunique"):
         return jnp.dtype(jnp.int64)
-    if op in ("mean", "var", "std"):
+    if op in ("mean", "var", "std", "var0", "std0"):
         return jnp.dtype(jnp.float32) if d == jnp.float32 else jnp.dtype(jnp.float64)
     if op in ("sum", "sumsq", "prod"):
         if jnp.issubdtype(d, jnp.floating):
@@ -155,13 +157,13 @@ def _segment_agg(op: str, v_s, valid_s, seg, padmask_s, out_cap: int):
         s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
         m = s / jnp.maximum(cnt, 1)
         return jnp.where(cnt > 0, m, jnp.nan), None
-    if op in ("var", "std"):
+    if op in ("var", "std", "var0", "std0"):
         v = v_s.astype(rdt)
         s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
         s2 = jax.ops.segment_sum(jnp.where(ok, v * v, 0), seg,
                                  num_segments=out_cap)
-        out = _var_from_moments(s, s2, cnt, ddof=1)
-        if op == "std":
+        out = _var_from_moments(s, s2, cnt, ddof=0 if op.endswith("0") else 1)
+        if op.startswith("std"):
             out = jnp.sqrt(out)
         return out, None
     if op == "nunique":

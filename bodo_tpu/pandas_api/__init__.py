@@ -1,0 +1,28 @@
+"""Lazy drop-in dataframe library (the `bodo.pandas` analogue).
+
+Mirrors the reference's lazy frontend (bodo/pandas/ — BodoDataFrame
+frame.py:117, BodoSeries series.py, read entry points base.py:74-392):
+every operation builds a logical plan node; execution triggers on
+materialization points (to_pandas/len/repr/write). Unsupported APIs fall
+back to real pandas with a warning (check_args_fallback semantics,
+bodo/pandas/utils.py:346).
+"""
+
+from bodo_tpu.pandas_api.frame import BodoDataFrame
+from bodo_tpu.pandas_api.series import BodoSeries
+from bodo_tpu.plan import logical as L
+
+__all__ = ["BodoDataFrame", "BodoSeries", "read_parquet", "read_csv",
+           "from_pandas"]
+
+
+def read_parquet(path, columns=None) -> BodoDataFrame:
+    return BodoDataFrame(L.ReadParquet(path, columns))
+
+
+def read_csv(path, columns=None, parse_dates=None) -> BodoDataFrame:
+    return BodoDataFrame(L.ReadCsv(path, columns, parse_dates))
+
+
+def from_pandas(df) -> BodoDataFrame:
+    return BodoDataFrame(L.FromPandas(df))

@@ -1,0 +1,228 @@
+"""BodoDataFrame: the lazy dataframe (reference bodo/pandas/frame.py:117).
+
+Every method builds a plan node; unsupported surface falls back to real
+pandas with a warning (the reference's check_args_fallback design —
+bodo/pandas/utils.py:346 — is replicated by the __getattr__ fallback that
+materializes and delegates, re-wrapping frame results lazily).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from bodo_tpu.plan import logical as L
+from bodo_tpu.plan.expr import ColRef, Expr, Lit
+from bodo_tpu.pandas_api.series import BodoSeries
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.utils.logging import warn_fallback
+
+
+class BodoDataFrame:
+    def __init__(self, plan: L.Node):
+        object.__setattr__(self, "_plan", plan)
+        # plans this frame has pointed at (mutated by __setitem__), with the
+        # columns overwritten since: a Series captured from an older plan
+        # stays usable as long as none of its referenced columns changed
+        object.__setattr__(self, "_history", {id(plan): set()})
+
+    # ---- schema ----------------------------------------------------------
+    @property
+    def columns(self) -> pd.Index:
+        return pd.Index(list(self._plan.schema))
+
+    @property
+    def dtypes(self) -> pd.Series:
+        out = {}
+        for n, t in self._plan.schema.items():
+            out[n] = np.dtype("O") if t is dt.STRING else np.dtype(t.np_dtype)
+        return pd.Series(out)
+
+    @property
+    def shape(self):
+        return (len(self), len(self._plan.schema))
+
+    # ---- selection -------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            if key not in self._plan.schema:
+                raise KeyError(key)
+            return BodoSeries(self._plan, ColRef(key), key)
+        if isinstance(key, list):
+            exprs = [(n, ColRef(n)) for n in key]
+            return BodoDataFrame(L.Projection(self._plan, exprs))
+        if isinstance(key, BodoSeries):
+            try:
+                e = self._expr_of(key)
+            except ValueError:
+                raise ValueError("boolean mask must come from this frame")
+            return BodoDataFrame(L.Filter(self._plan, e))
+        raise TypeError(f"unsupported key: {key!r}")
+
+    def __setitem__(self, name: str, value):
+        if isinstance(value, (list, np.ndarray, pd.Series)) and \
+                not isinstance(value, BodoSeries):
+            # positional data needs host alignment — fallback semantics
+            warn_fallback("DataFrame.__setitem__", "raw array value")
+            pdf = self.to_pandas()
+            pdf[name] = value
+            plan = L.FromPandas(pdf)
+        else:
+            plan = self._assign_plan({name: value})
+        hist = object.__getattribute__(self, "_history")
+        for dirty in hist.values():
+            dirty.add(name)
+        hist[id(plan)] = set()
+        object.__setattr__(self, "_plan", plan)
+
+    def __getattr__(self, name):
+        plan = object.__getattribute__(self, "_plan")
+        if name in plan.schema:
+            return BodoSeries(plan, ColRef(name), name)
+        if not name.startswith("_") and hasattr(pd.DataFrame, name):
+            warn_fallback(f"DataFrame.{name}", "not yet lazy")
+            attr = getattr(self.to_pandas(), name)
+            if callable(attr):
+                def wrapped(*a, **k):
+                    res = attr(*a, **k)
+                    if isinstance(res, pd.DataFrame) and isinstance(
+                            res.index, pd.RangeIndex):
+                        # re-wrap lazily; frames with meaningful indexes
+                        # (describe etc.) stay plain pandas
+                        return BodoDataFrame(L.FromPandas(res))
+                    return res
+                return wrapped
+            return attr
+        raise AttributeError(name)
+
+    def _expr_of(self, value) -> Expr:
+        if isinstance(value, BodoSeries):
+            hist = object.__getattribute__(self, "_history")
+            if value._plan is self._plan:
+                return value._expr
+            dirty = hist.get(id(value._plan))
+            if dirty is not None:
+                from bodo_tpu.plan.expr import expr_columns
+                stale = expr_columns(value._expr) & dirty
+                if stale:
+                    raise ValueError(
+                        f"Series references columns overwritten since it was "
+                        f"captured: {sorted(stale)}")
+                return value._expr
+            raise ValueError("column must come from this frame")
+        return Lit(value)
+
+    def _assign_plan(self, new: Dict[str, object]) -> L.Node:
+        exprs = [(n, ColRef(n)) for n in self._plan.schema]
+        names = {n for n, _ in exprs}
+        for n, v in new.items():
+            e = self._expr_of(v)
+            if n in names:
+                exprs = [(nn, e if nn == n else ee) for nn, ee in exprs]
+            else:
+                exprs.append((n, e))
+        return L.Projection(self._plan, exprs)
+
+    def assign(self, **kwargs) -> "BodoDataFrame":
+        """Add columns. Series values may come from this frame (evaluated
+        over the in-progress projection chain — all original columns pass
+        through by name); callables receive the frame built so far."""
+        plan = self._plan
+        allowed = {id(self._plan)}
+        for n, v in kwargs.items():
+            if callable(v):
+                v = v(BodoDataFrame(plan))
+            if isinstance(v, BodoSeries):
+                if id(v._plan) not in allowed:
+                    raise ValueError("column must come from this frame")
+                e = v._expr
+            else:
+                e = Lit(v)
+            exprs = [(nn, ColRef(nn)) for nn in plan.schema if nn != n]
+            exprs.append((n, e))
+            plan = L.Projection(plan, exprs)
+            allowed.add(id(plan))
+        return BodoDataFrame(plan)
+
+    def drop(self, columns=None, **kw) -> "BodoDataFrame":
+        if columns is None:
+            warn_fallback("DataFrame.drop", "only columns= supported")
+            return BodoDataFrame(L.FromPandas(self.to_pandas().drop(**kw)))
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        keep = [n for n in self._plan.schema if n not in cols]
+        return self[keep]
+
+    def rename(self, columns: Optional[Dict[str, str]] = None, copy=None,
+               **kw) -> "BodoDataFrame":
+        if columns is None:
+            warn_fallback("DataFrame.rename", "only columns= supported")
+            return BodoDataFrame(L.FromPandas(
+                self.to_pandas().rename(**kw)))
+        exprs = [(columns.get(n, n), ColRef(n)) for n in self._plan.schema]
+        return BodoDataFrame(L.Projection(self._plan, exprs))
+
+    # ---- relational ops ----------------------------------------------------
+    def merge(self, right: "BodoDataFrame", on=None, left_on=None,
+              right_on=None, how: str = "inner",
+              suffixes=("_x", "_y")) -> "BodoDataFrame":
+        if on is not None:
+            left_on = right_on = [on] if isinstance(on, str) else list(on)
+        if left_on is None or right_on is None:
+            raise ValueError("merge requires on= or left_on=/right_on=")
+        left_on = [left_on] if isinstance(left_on, str) else list(left_on)
+        right_on = [right_on] if isinstance(right_on, str) else list(right_on)
+        if how == "right":
+            return right.merge(self, left_on=right_on, right_on=left_on,
+                               how="left", suffixes=(suffixes[1], suffixes[0]))
+        return BodoDataFrame(L.Join(self._plan, right._plan, left_on,
+                                    right_on, how, suffixes))
+
+    def groupby(self, by, as_index: bool = True, dropna: bool = True,
+                sort: bool = True):
+        from bodo_tpu.pandas_api.groupby import BodoGroupBy
+        keys = [by] if isinstance(by, str) else list(by)
+        return BodoGroupBy(self, keys, as_index=as_index)
+
+    def sort_values(self, by, ascending=True, na_position: str = "last",
+                    kind=None, ignore_index: bool = True) -> "BodoDataFrame":
+        by = [by] if isinstance(by, str) else list(by)
+        asc = [ascending] * len(by) if isinstance(ascending, bool) \
+            else list(ascending)
+        return BodoDataFrame(L.Sort(self._plan, by, asc,
+                                    na_last=(na_position == "last")))
+
+    def drop_duplicates(self, subset=None) -> "BodoDataFrame":
+        subset = [subset] if isinstance(subset, str) else \
+            (list(subset) if subset else None)
+        return BodoDataFrame(L.Distinct(self._plan, subset))
+
+    def head(self, n: int = 5) -> "BodoDataFrame":
+        return BodoDataFrame(L.Limit(self._plan, n))
+
+    # ---- materialization ---------------------------------------------------
+    def _execute(self):
+        from bodo_tpu.plan.physical import execute
+        return execute(self._plan)
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self._execute().to_pandas()
+
+    def to_parquet(self, path: str, index: bool = False) -> None:
+        from bodo_tpu.io import write_parquet
+        write_parquet(self._execute(), path)
+
+    def __len__(self) -> int:
+        return self._execute().nrows
+
+    def __repr__(self) -> str:  # pragma: no cover
+        head = BodoDataFrame(L.Limit(self._plan, 10)).to_pandas()
+        n = len(self)
+        return repr(head) + f"\n[{n} rows x {len(self._plan.schema)} columns]"
+
+    def __setattr__(self, name, value):  # guard accidental attr writes
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self[name] = value

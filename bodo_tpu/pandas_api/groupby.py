@@ -1,0 +1,167 @@
+"""GroupBy object (reference: bodo/pandas/groupby.py,
+bodo/hiframes/pd_groupby_ext.py:96 DataFrameGroupByType surface)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import pandas as pd
+
+from bodo_tpu.plan import logical as L
+from bodo_tpu.utils.logging import warn_fallback
+
+_AGG_OPS = ("sum", "mean", "count", "min", "max", "var", "std", "size",
+            "first", "last", "nunique", "prod", "median")
+
+
+class BodoGroupBy:
+    def __init__(self, df, keys: List[str], as_index: bool = True,
+                 selection: Optional[List[str]] = None):
+        self._df = df
+        self._keys = keys
+        self._as_index = as_index
+        self._selection = selection
+        self._single = False
+
+    def __getitem__(self, key):
+        sel = [key] if isinstance(key, str) else list(key)
+        g = BodoGroupBy(self._df, self._keys, self._as_index, sel)
+        g._single = isinstance(key, str)
+        return g
+
+    # ---- agg spec normalization -------------------------------------------
+    def _value_cols(self) -> List[str]:
+        if self._selection is not None:
+            return self._selection
+        return [n for n in self._df._plan.schema if n not in self._keys]
+
+    def agg(self, arg=None, **named):
+        aggs: List[Tuple[str, str, str]] = []
+        if arg is None and named:
+            # named aggregation: out=("col", "op")
+            for out, (col, op) in named.items():
+                aggs.append((col, op, out))
+        elif isinstance(arg, dict):
+            for col, ops in arg.items():
+                if isinstance(ops, str):
+                    aggs.append((col, ops, col))
+                else:
+                    for op in ops:
+                        aggs.append((col, op, f"{col}_{op}"))
+        elif isinstance(arg, str):
+            for col in self._value_cols():
+                aggs.append((col, arg, col))
+        elif isinstance(arg, (list, tuple)):
+            for col in self._value_cols():
+                for op in arg:
+                    aggs.append((col, op, f"{col}_{op}"))
+        else:
+            warn_fallback("groupby.agg", f"unsupported spec {type(arg)}")
+            gb = self._df.to_pandas().groupby(self._keys,
+                                              as_index=self._as_index)
+            if self._selection:
+                gb = gb[self._selection]
+            return gb.agg(arg, **named)
+        return self._run(aggs)
+
+    aggregate = agg
+
+    def _run(self, aggs):
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        node = L.Aggregate(self._df._plan, self._keys, aggs)
+        out = BodoDataFrame(node)
+        single = aggs[0][2] if (self._single and len(aggs) == 1) else None
+        if self._as_index:
+            return _IndexedAggResult(out, self._keys, single)
+        return out
+
+    def _simple(self, op):
+        if op == "size":
+            aggs = [(self._keys[0], "size", "size")]
+        else:
+            aggs = [(c, op, c) for c in self._value_cols()
+                    if op in ("count", "nunique", "first", "last")
+                    or _numericish(self._df._plan.schema[c])]
+        return self._run(aggs)
+
+    def sum(self): return self._simple("sum")
+    def mean(self): return self._simple("mean")
+    def count(self): return self._simple("count")
+    def min(self): return self._simple("min")
+    def max(self): return self._simple("max")
+
+    def var(self, ddof=1):
+        from bodo_tpu.pandas_api.series import _ddof_op
+        return self._simple(_ddof_op("var", ddof))
+
+    def std(self, ddof=1):
+        from bodo_tpu.pandas_api.series import _ddof_op
+        return self._simple(_ddof_op("std", ddof))
+    def first(self): return self._simple("first")
+    def last(self): return self._simple("last")
+    def nunique(self): return self._simple("nunique")
+    def prod(self): return self._simple("prod")
+
+    def size(self):
+        res = self._run([(self._keys[0], "size", "size")])
+        if isinstance(res, _IndexedAggResult):
+            return res.to_pandas()["size"]
+        return res
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._df._plan.schema:
+            return self[name]
+        warn_fallback(f"groupby.{name}", "not yet lazy")
+        gb = self._df.to_pandas().groupby(self._keys, as_index=self._as_index)
+        if self._selection:
+            sel = self._selection[0] if len(self._selection) == 1 \
+                else self._selection
+            gb = gb[sel]
+        return getattr(gb, name)
+
+
+class _IndexedAggResult:
+    """as_index=True result: behaves like the frame but sets the key index
+    on materialization (our Tables are always index-free). With a single
+    selected column it materializes as a pandas Series."""
+
+    def __init__(self, frame, keys, single_col: Optional[str] = None):
+        self._frame = frame
+        self._keys = keys
+        self._single = single_col
+
+    def to_pandas(self):
+        df = self._frame.to_pandas().set_index(self._keys)
+        if self._single is not None:
+            return df[self._single]
+        return df
+
+    def reset_index(self):
+        return self._frame
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as np
+        return np.asarray(self.to_pandas(), dtype=dtype)
+
+    def __getitem__(self, key):
+        return self.to_pandas()[key]
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in ("to_numpy", "sort_index", "sort_values", "index",
+                    "values", "loc", "iloc", "equals"):
+            return getattr(self.to_pandas(), name)
+        return getattr(self._frame, name)
+
+    def __len__(self):
+        return len(self._frame)
+
+    def __repr__(self):  # pragma: no cover
+        return repr(self.to_pandas().head(10))
+
+
+def _numericish(t) -> bool:
+    return t.kind in ("i", "u", "f", "b")

@@ -1,0 +1,276 @@
+"""BodoSeries: lazy column expression bound to a plan.
+
+Analogue of the reference's BodoSeries (bodo/pandas/series.py) with the
+str/dt accessors (reference: series_str_impl.py, series_dt_impl.py
+surfaces). A Series is (plan, expr): arithmetic composes expressions
+without execution; reductions execute a Reduce node; comparisons against
+strings rewrite to dictionary-code predicates (StrPredicate) since raw
+strings never reach the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import pandas as pd
+
+from bodo_tpu.plan import logical as L
+from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DtField, Expr, IsIn,
+                                Lit, StrPredicate, UnOp, Where, infer_dtype)
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.utils.logging import warn_fallback
+
+_REDUCTIONS = ("sum", "mean", "min", "max", "count", "var", "std", "prod")
+
+
+def _ddof_op(op: str, ddof: int) -> str:
+    """var/std with ddof 0/1 map to dedicated ops; others are unsupported."""
+    if ddof == 1:
+        return op
+    if ddof == 0:
+        return op + "0"
+    raise NotImplementedError(f"{op} with ddof={ddof} (only 0 and 1)")
+
+
+class BodoSeries:
+    def __init__(self, plan: L.Node, expr: Expr, name: str = None):
+        self._plan = plan
+        self._expr = expr
+        self._name = name if name is not None else (
+            expr.name if isinstance(expr, ColRef) else None)
+
+    # ---- dtype ------------------------------------------------------------
+    @property
+    def _dtype(self) -> dt.DType:
+        return infer_dtype(self._expr, self._plan.schema)
+
+    @property
+    def dtype(self):
+        d = self._dtype
+        return np.dtype(d.np_dtype) if d is not dt.STRING else np.dtype("O")
+
+    @property
+    def name(self):
+        return self._name
+
+    # ---- expression building ----------------------------------------------
+    def _wrap(self, expr: Expr, name=None) -> "BodoSeries":
+        return BodoSeries(self._plan, expr, name or self._name)
+
+    def _coerce(self, other):
+        """Other operand → Expr (string literals become predicates at the
+        comparison level, handled in _cmp)."""
+        if isinstance(other, BodoSeries):
+            if other._plan is not self._plan:
+                raise ValueError(
+                    "cannot combine Series from different frames lazily; "
+                    "merge the frames first")
+            return other._expr
+        if isinstance(other, pd.Timestamp):
+            return Lit(np.datetime64(other.to_datetime64()))
+        return Lit(other)
+
+    def _bin(self, op, other, reverse=False):
+        o = self._coerce(other)
+        e = BinOp(op, o, self._expr) if reverse else BinOp(op, self._expr, o)
+        return self._wrap(e, None)
+
+    def _cmp(self, op, other):
+        # string comparison → dictionary predicate
+        if isinstance(other, str) and self._dtype is dt.STRING:
+            if op == "==":
+                return self._wrap(StrPredicate("eq_any", (other,), self._expr))
+            if op == "!=":
+                return self._wrap(UnOp("~", StrPredicate(
+                    "eq_any", (other,), self._expr)))
+            raise TypeError(f"string ordering comparison {op} unsupported")
+        return self._bin(op, other)
+
+    def __add__(self, o): return self._bin("+", o)
+    def __radd__(self, o): return self._bin("+", o, True)
+    def __sub__(self, o): return self._bin("-", o)
+    def __rsub__(self, o): return self._bin("-", o, True)
+    def __mul__(self, o): return self._bin("*", o)
+    def __rmul__(self, o): return self._bin("*", o, True)
+    def __truediv__(self, o): return self._bin("/", o)
+    def __rtruediv__(self, o): return self._bin("/", o, True)
+    def __floordiv__(self, o): return self._bin("//", o)
+    def __mod__(self, o): return self._bin("%", o)
+    def __pow__(self, o): return self._bin("**", o)
+    def __eq__(self, o): return self._cmp("==", o)  # type: ignore[override]
+    def __ne__(self, o): return self._cmp("!=", o)  # type: ignore[override]
+    def __lt__(self, o): return self._cmp("<", o)
+    def __le__(self, o): return self._cmp("<=", o)
+    def __gt__(self, o): return self._cmp(">", o)
+    def __ge__(self, o): return self._cmp(">=", o)
+    def __and__(self, o): return self._bin("&", o)
+    def __or__(self, o): return self._bin("|", o)
+    def __invert__(self): return self._wrap(UnOp("~", self._expr))
+    def __neg__(self): return self._wrap(UnOp("neg", self._expr))
+    __hash__ = None  # type: ignore[assignment]
+
+    def isin(self, values):
+        vals = tuple(values)
+        if self._dtype is dt.STRING:
+            return self._wrap(StrPredicate("eq_any", vals, self._expr))
+        return self._wrap(IsIn(self._expr, vals))
+
+    def isna(self): return self._wrap(UnOp("isna", self._expr))
+    def notna(self): return self._wrap(UnOp("notna", self._expr))
+    def fillna(self, v): return self._wrap(
+        Where(UnOp("isna", self._expr), Lit(v), self._expr))
+
+    def astype(self, dtype) -> "BodoSeries":
+        return self._wrap(Cast(self._expr, dt.from_numpy(np.dtype(dtype))))
+
+    def where(self, cond, other) -> "BodoSeries":
+        c = cond._expr if isinstance(cond, BodoSeries) else Lit(cond)
+        o = other._expr if isinstance(other, BodoSeries) else Lit(other)
+        return self._wrap(Where(c, self._expr, o))
+
+    # ---- accessors ----------------------------------------------------------
+    @property
+    def dt(self):
+        return _DtAccessor(self)
+
+    @property
+    def str(self):
+        return _StrAccessor(self)
+
+    # ---- reductions ---------------------------------------------------------
+    def _reduce(self, op):
+        name = self._name or "_val"
+        node = L.Reduce(self._as_projection(name), [(name, op, name)])
+        from bodo_tpu.plan.physical import execute
+        t = execute(node)
+        return t.to_pandas()[name].iloc[0]
+
+    def sum(self): return self._reduce("sum")
+    def mean(self): return self._reduce("mean")
+    def min(self): return self._reduce("min")
+    def max(self): return self._reduce("max")
+    def count(self): return self._reduce("count")
+    def prod(self): return self._reduce("prod")
+
+    def var(self, ddof: int = 1):
+        return self._reduce(_ddof_op("var", ddof))
+
+    def std(self, ddof: int = 1):
+        return self._reduce(_ddof_op("std", ddof))
+
+    def nunique(self):
+        name = self._name or "_val"
+        node = L.Aggregate(self._as_projection(name), [name], [])
+        from bodo_tpu.plan.physical import execute
+        return execute(node).nrows
+
+    def unique(self):
+        name = self._name or "_val"
+        node = L.Aggregate(self._as_projection(name), [name], [])
+        from bodo_tpu.plan.physical import execute
+        return execute(node).to_pandas()[name].to_numpy()
+
+    def value_counts(self, ascending: bool = False):
+        name = self._name or "_val"
+        proj = self._as_projection(name)
+        agg = L.Aggregate(proj, [name], [(name, "size", "count")])
+        srt = L.Sort(agg, ["count"], [ascending])
+        from bodo_tpu.plan.physical import execute
+        pdf = execute(srt).to_pandas()
+        s = pd.Series(pdf["count"].to_numpy(),
+                      index=pd.Index(pdf[name], name=name), name="count")
+        return s
+
+    # ---- materialization ------------------------------------------------
+    def _as_projection(self, name: Optional[str] = None) -> L.Node:
+        name = name or self._name or "_val"
+        return L.Projection(self._plan, [(name, self._expr)])
+
+    def to_pandas(self) -> pd.Series:
+        from bodo_tpu.plan.physical import execute
+        name = self._name or "_val"
+        t = execute(self._as_projection(name))
+        return t.to_pandas()[name].rename(self._name)
+
+    def head(self, n: int = 5) -> pd.Series:
+        from bodo_tpu.plan.physical import execute
+        name = self._name or "_val"
+        t = execute(L.Limit(self._as_projection(name), n))
+        return t.to_pandas()[name].rename(self._name)
+
+    def __len__(self):
+        from bodo_tpu.plan.physical import execute
+        return execute(self._plan).nrows
+
+    def __repr__(self):  # pragma: no cover
+        return f"BodoSeries(name={self._name}, dtype={self._dtype.name})\n" \
+            + repr(self.head(10))
+
+    def map(self, arg):
+        """dict mapping compiles to a device Where-chain / code LUT; callables
+        fall back to pandas (compiled UDFs arrive with the @jit layer)."""
+        if isinstance(arg, dict) and len(arg) <= 64 and \
+                self._dtype is not dt.STRING:
+            vals = list(arg.items())
+            default = Lit(np.nan)
+            expr: Expr = default
+            for k, v in reversed(vals):
+                expr = Where(BinOp("==", self._expr, Lit(k)), Lit(v), expr)
+            return self._wrap(expr)
+        warn_fallback("Series.map", "non-dict or string mapper")
+        return self.to_pandas().map(arg)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if hasattr(pd.Series, name):
+            warn_fallback(f"Series.{name}", "not yet lazy")
+            attr = getattr(self.to_pandas(), name)
+            return attr
+        raise AttributeError(name)
+
+
+class _DtAccessor:
+    """Series.dt — datetime field extraction (device kernels)."""
+
+    def __init__(self, s: BodoSeries):
+        self._s = s
+
+    def __getattr__(self, field):
+        from bodo_tpu.ops.datetime import FIELDS
+        if field in FIELDS:
+            return self._s._wrap(DtField(field, self._s._expr))
+        raise AttributeError(f"dt.{field} not supported")
+
+    def isocalendar(self):  # pragma: no cover
+        raise NotImplementedError
+
+
+class _StrAccessor:
+    """Series.str — predicates evaluate on the host dictionary (LUT)."""
+
+    def __init__(self, s: BodoSeries):
+        self._s = s
+
+    def contains(self, pat, regex: bool = False):
+        kind = "match" if regex else "contains"
+        pat_ = (".*" + pat,) if regex else (pat,)
+        return self._s._wrap(StrPredicate(kind, pat_, self._s._expr))
+
+    def startswith(self, pat):
+        pats = (pat,) if isinstance(pat, str) else tuple(pat)
+        return self._s._wrap(StrPredicate("startswith", pats, self._s._expr))
+
+    def endswith(self, pat):
+        pats = (pat,) if isinstance(pat, str) else tuple(pat)
+        return self._s._wrap(StrPredicate("endswith", pats, self._s._expr))
+
+    def match(self, pat):
+        return self._s._wrap(StrPredicate("match", (pat,), self._s._expr))
+
+    def __getattr__(self, name):
+        if hasattr(pd.Series.str, name):
+            warn_fallback(f"Series.str.{name}", "not yet lazy")
+            return getattr(self._s.to_pandas().str, name)
+        raise AttributeError(name)

@@ -127,11 +127,12 @@ def _finalize(op: str, cols, orig_dtype):
         rdt = result_dtype("mean", orig_dtype)
         m = s.astype(rdt) / jnp.maximum(cnt, 1).astype(rdt)
         return jnp.where(cnt > 0, m, jnp.nan), None
-    if op in ("var", "std"):
+    if op in ("var", "std", "var0", "std0"):
         (s, _), (s2, _), (cnt, _) = cols
         rdt = result_dtype(op, orig_dtype)
-        out = _var_from_moments(s.astype(rdt), s2.astype(rdt), cnt)
-        return (jnp.sqrt(out) if op == "std" else out), None
+        out = _var_from_moments(s.astype(rdt), s2.astype(rdt), cnt,
+                                ddof=0 if op.endswith("0") else 1)
+        return (jnp.sqrt(out) if op.startswith("std") else out), None
     return cols[0]
 
 

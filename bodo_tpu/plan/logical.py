@@ -1,0 +1,272 @@
+"""Logical plan nodes (lazy query DAG).
+
+Analogue of the reference's LazyPlan node set (bodo/pandas/plan.py:44 —
+LogicalProjection/Filter/Aggregate/Distinct/ComparisonJoin/Limit/Order and
+the scan/write nodes at :480-556). Each node carries its output schema
+(host-side dtype dict), computed at construction so the frontend can
+type-check without executing. Nodes memoize their executed Table
+(`_cached`) — re-using a materialized prefix is the reference's
+plan-collapse behavior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bodo_tpu.ops.groupby import result_dtype
+from bodo_tpu.plan.expr import Expr, expr_columns, infer_dtype
+from bodo_tpu.table import dtypes as dt
+
+Schema = Dict[str, dt.DType]
+
+
+class Node:
+    schema: Schema
+    children: List["Node"]
+    _cached = None  # executed Table
+
+    def key(self) -> Tuple:
+        raise NotImplementedError
+
+    def __repr__(self):  # pragma: no cover
+        name = type(self).__name__
+        return f"{name}({', '.join(self.schema)})[{len(self.children)} ch]"
+
+
+class ReadParquet(Node):
+    def __init__(self, path: str, columns: Optional[Sequence[str]] = None):
+        import pyarrow.parquet as pq
+
+        from bodo_tpu.io.parquet import _dataset_files
+        self.path = path
+        self.children = []
+        f = _dataset_files(path)[0]
+        arrow_schema = pq.read_schema(f)
+        names = list(columns) if columns else arrow_schema.names
+        self.columns = names
+        self.schema = {}
+        for n in names:
+            self.schema[n] = _arrow_field_dtype(arrow_schema.field(n).type)
+
+    def key(self):
+        return ("read_parquet", self.path, tuple(self.columns))
+
+
+class ReadCsv(Node):
+    def __init__(self, path: str, columns=None, parse_dates=None,
+                 schema: Optional[Schema] = None):
+        self.path = path
+        self.columns = columns
+        self.parse_dates = tuple(parse_dates) if parse_dates else ()
+        self.children = []
+        if schema is None:
+            import pyarrow.csv as pacsv
+            # infer from the first block only — never parse the whole file
+            # at plan-construction time
+            with pacsv.open_csv(path, read_options=pacsv.ReadOptions(
+                    block_size=1 << 20)) as reader:
+                head = reader.read_next_batch()
+            schema = {}
+            for f_ in head.schema:
+                if f_.name in self.parse_dates:
+                    schema[f_.name] = dt.DATETIME
+                else:
+                    schema[f_.name] = _arrow_field_dtype(f_.type)
+            if columns:
+                schema = {n: schema[n] for n in columns}
+        self.schema = schema
+
+    def key(self):
+        return ("read_csv", self.path, tuple(self.columns or ()),
+                self.parse_dates)
+
+
+class FromPandas(Node):
+    """In-memory source (bd.from_pandas analogue, reference base.py:74)."""
+    _counter = [0]
+
+    def __init__(self, df):
+        from bodo_tpu.table.table import Table
+        self.children = []
+        if isinstance(df, Table):
+            self.table = df
+        else:
+            self.table = Table.from_pandas(df)
+        self.schema = {n: c.dtype for n, c in self.table.columns.items()}
+        FromPandas._counter[0] += 1
+        self._id = FromPandas._counter[0]
+
+    def key(self):
+        return ("from_pandas", self._id)
+
+
+class Projection(Node):
+    def __init__(self, child: Node, exprs: Sequence[Tuple[str, Expr]]):
+        self.children = [child]
+        self.exprs = list(exprs)
+        self.schema = {n: infer_dtype(e, child.schema) for n, e in self.exprs}
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("project", self.child.key(),
+                tuple((n, e.key()) for n, e in self.exprs))
+
+
+class Filter(Node):
+    def __init__(self, child: Node, predicate: Expr):
+        self.children = [child]
+        self.predicate = predicate
+        self.schema = dict(child.schema)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("filter", self.child.key(), self.predicate.key())
+
+
+class Aggregate(Node):
+    def __init__(self, child: Node, keys: Sequence[str],
+                 aggs: Sequence[Tuple[str, str, str]]):
+        self.children = [child]
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        sch: Schema = {k: child.schema[k] for k in self.keys}
+        for col, op, out in self.aggs:
+            src = child.schema[col]
+            if op in ("min", "max", "first", "last"):
+                sch[out] = src
+            else:
+                sch[out] = dt.from_numpy(result_dtype(op, src.numpy))
+        self.schema = sch
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("agg", self.child.key(), tuple(self.keys),
+                tuple(self.aggs))
+
+
+class Reduce(Node):
+    """Whole-column reductions (Series.sum() etc.) — 1-row output."""
+
+    def __init__(self, child: Node, aggs: Sequence[Tuple[str, str, str]]):
+        self.children = [child]
+        self.aggs = list(aggs)
+        sch: Schema = {}
+        for col, op, out in self.aggs:
+            src = child.schema[col]
+            if op in ("min", "max", "first", "last"):
+                sch[out] = src
+            else:
+                sch[out] = dt.from_numpy(result_dtype(op, src.numpy))
+        self.schema = sch
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("reduce", self.child.key(), tuple(self.aggs))
+
+
+class Join(Node):
+    def __init__(self, left: Node, right: Node, left_on, right_on,
+                 how: str = "inner", suffixes=("_x", "_y")):
+        self.children = [left, right]
+        self.left_on = list(left_on)
+        self.right_on = list(right_on)
+        self.how = how
+        self.suffixes = tuple(suffixes)
+        overlap = (set(left.schema) & set(right.schema)) - \
+            (set(self.left_on) & set(self.right_on))
+        sch: Schema = {}
+        for n, t in left.schema.items():
+            sch[n + suffixes[0] if n in overlap else n] = t
+        for i, (n, t) in enumerate(right.schema.items()):
+            if n in self.right_on and \
+                    self.left_on[self.right_on.index(n)] == n:
+                continue
+            sch[n + suffixes[1] if n in overlap else n] = t
+        self.schema = sch
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def key(self):
+        return ("join", self.left.key(), self.right.key(),
+                tuple(self.left_on), tuple(self.right_on), self.how,
+                self.suffixes)
+
+
+class Sort(Node):
+    def __init__(self, child: Node, by, ascending, na_last: bool = True):
+        self.children = [child]
+        self.by = list(by)
+        self.ascending = list(ascending)
+        self.na_last = na_last
+        self.schema = dict(child.schema)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("sort", self.child.key(), tuple(self.by),
+                tuple(self.ascending), self.na_last)
+
+
+class Limit(Node):
+    def __init__(self, child: Node, n: int):
+        self.children = [child]
+        self.n = n
+        self.schema = dict(child.schema)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("limit", self.child.key(), self.n)
+
+
+class Distinct(Node):
+    def __init__(self, child: Node, subset: Optional[Sequence[str]] = None):
+        self.children = [child]
+        self.subset = list(subset) if subset else list(child.schema)
+        self.schema = dict(child.schema)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("distinct", self.child.key(), tuple(self.subset))
+
+
+def _arrow_field_dtype(typ) -> dt.DType:
+    import pyarrow as pa
+    if pa.types.is_dictionary(typ) or pa.types.is_string(typ) or \
+            pa.types.is_large_string(typ):
+        return dt.STRING
+    if pa.types.is_timestamp(typ):
+        return dt.DATETIME
+    if pa.types.is_date(typ):
+        return dt.DATE
+    if pa.types.is_duration(typ):
+        return dt.TIMEDELTA
+    return dt.from_numpy(np.dtype(typ.to_pandas_dtype()))

@@ -1,0 +1,168 @@
+"""Logical plan optimizer.
+
+Replaces the reference's vendored DuckDB optimizer
+(bodo/pandas/vendor/duckdb + plan_optimizer.pyx) with our own rule set
+(SURVEY.md §7 M2: "a small logical optimizer... replacing vendored
+DuckDB"). Rules:
+
+  1. column pruning / projection pushdown — scans read only the columns
+     any ancestor needs (the reference gets this from DuckDB + its
+     TableColumnDelPass; here it lands directly in ReadParquet.columns).
+  2. filter pushdown — filters slide below projections (with expression
+     inlining) and joins (to the side that owns the columns), and merge
+     with adjacent filters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Set
+
+from bodo_tpu.plan import logical as L
+from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DtField, Expr, IsIn,
+                                Lit, StrPredicate, UnOp, Where, expr_columns)
+
+
+def optimize(node: L.Node) -> L.Node:
+    node = push_filters(node)
+    node = prune_columns(node, None)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# filter pushdown
+# ---------------------------------------------------------------------------
+
+def _substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
+    if isinstance(e, ColRef):
+        return mapping.get(e.name, e)
+    if isinstance(e, Lit):
+        return e
+    if isinstance(e, BinOp):
+        return BinOp(e.op, _substitute(e.left, mapping),
+                     _substitute(e.right, mapping))
+    if isinstance(e, UnOp):
+        return UnOp(e.op, _substitute(e.operand, mapping))
+    if isinstance(e, Cast):
+        return Cast(_substitute(e.operand, mapping), e.to)
+    if isinstance(e, DtField):
+        return DtField(e.field, _substitute(e.operand, mapping))
+    if isinstance(e, IsIn):
+        return IsIn(_substitute(e.operand, mapping), e.values)
+    if isinstance(e, StrPredicate):
+        return StrPredicate(e.kind, e.pattern, _substitute(e.operand, mapping))
+    if isinstance(e, Where):
+        return Where(_substitute(e.cond, mapping),
+                     _substitute(e.iftrue, mapping),
+                     _substitute(e.iffalse, mapping))
+    raise TypeError(f"substitute: {e}")
+
+
+def push_filters(node: L.Node) -> L.Node:
+    if isinstance(node, L.Filter):
+        child = node.child
+        pred = node.predicate
+        if isinstance(child, L.Filter):
+            # merge adjacent filters, keep pushing
+            return push_filters(L.Filter(child.child,
+                                         BinOp("&", child.predicate, pred)))
+        if isinstance(child, L.Projection):
+            mapping = {n: e for n, e in child.exprs}
+            pushed = L.Filter(push_filters(child.child),
+                              _substitute(pred, mapping))
+            return L.Projection(push_filters(pushed), child.exprs)
+        if isinstance(child, L.Join):
+            cols = expr_columns(pred)
+            lcols = set(child.left.schema)
+            rcols = set(child.right.schema)
+            # only push when the names are unambiguous pass-throughs
+            if cols <= lcols and not (cols & rcols):
+                nl = push_filters(L.Filter(child.left, pred))
+                return L.Join(nl, push_filters(child.right), child.left_on,
+                              child.right_on, child.how, child.suffixes)
+            if cols <= rcols and not (cols & lcols) and child.how == "inner":
+                nr = push_filters(L.Filter(child.right, pred))
+                return L.Join(push_filters(child.left), nr, child.left_on,
+                              child.right_on, child.how, child.suffixes)
+        return L.Filter(push_filters(child), pred)
+    # recurse
+    return _rebuild(node, [push_filters(c) for c in node.children])
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+# ---------------------------------------------------------------------------
+
+def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
+    """required=None means 'all output columns are needed'."""
+    if isinstance(node, (L.ReadParquet, L.ReadCsv)):
+        if required is not None and set(node.schema) - required:
+            cols = [n for n in node.schema if n in required]
+            if isinstance(node, L.ReadParquet):
+                return L.ReadParquet(node.path, cols)
+            return L.ReadCsv(node.path, cols, node.parse_dates,
+                             schema={n: node.schema[n] for n in cols})
+        return node
+    if isinstance(node, L.FromPandas):
+        if required is not None and set(node.schema) - required:
+            cols = [n for n in node.schema if n in required]
+            pruned = L.FromPandas(node.table.select(cols))
+            return pruned
+        return node
+    if isinstance(node, L.Projection):
+        exprs = node.exprs if required is None else \
+            [(n, e) for n, e in node.exprs if n in required]
+        need = set()
+        for _, e in exprs:
+            need |= expr_columns(e)
+        return L.Projection(prune_columns(node.child, need), exprs)
+    if isinstance(node, L.Filter):
+        need = None if required is None else \
+            (set(required) | expr_columns(node.predicate))
+        return L.Filter(prune_columns(node.child, need), node.predicate)
+    if isinstance(node, L.Aggregate):
+        aggs = node.aggs if required is None else \
+            [a for a in node.aggs if a[2] in required or a[2] in node.keys]
+        need = set(node.keys) | {c for c, _, _ in aggs}
+        return L.Aggregate(prune_columns(node.child, need), node.keys, aggs)
+    if isinstance(node, L.Reduce):
+        need = {c for c, _, _ in node.aggs}
+        return L.Reduce(prune_columns(node.child, need), node.aggs)
+    if isinstance(node, L.Join):
+        lneed = rneed = None
+        if required is not None:
+            # un-suffix required names back to source columns
+            overlap = (set(node.left.schema) & set(node.right.schema)) - \
+                (set(node.left_on) & set(node.right_on))
+            lneed, rneed = set(node.left_on), set(node.right_on)
+            for n in node.left.schema:
+                out = n + node.suffixes[0] if n in overlap else n
+                if out in required:
+                    lneed.add(n)
+            for n in node.right.schema:
+                out = n + node.suffixes[1] if n in overlap else n
+                if out in required:
+                    rneed.add(n)
+        return L.Join(prune_columns(node.left, lneed),
+                      prune_columns(node.right, rneed),
+                      node.left_on, node.right_on, node.how, node.suffixes)
+    if isinstance(node, L.Sort):
+        need = None if required is None else \
+            (set(required) | set(node.by))
+        return L.Sort(prune_columns(node.child, need), node.by,
+                      node.ascending, node.na_last)
+    if isinstance(node, L.Distinct):
+        need = None if required is None else \
+            (set(required) | set(node.subset))
+        return L.Distinct(prune_columns(node.child, need), node.subset)
+    if isinstance(node, L.Limit):
+        return L.Limit(prune_columns(node.child, required), node.n)
+    return _rebuild(node, [prune_columns(c, None) for c in node.children])
+
+
+def _rebuild(node: L.Node, children) -> L.Node:
+    if children == node.children:
+        return node
+    import copy
+    new = copy.copy(node)
+    new.children = children
+    return new

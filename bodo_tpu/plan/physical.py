@@ -1,0 +1,119 @@
+"""Plan executor: logical plan → relational-layer calls → Table.
+
+Analogue of the reference's physical conversion + pipeline executor
+(bodo/pandas/_physical_conv.h:29 PhysicalPlanBuilder,
+bodo/pandas/_executor.h:76 Executor). The streaming C++ pipelines become
+a post-order walk issuing cached jitted stages; results memoize on the
+node (plan collapse) and in a session-level cache keyed by plan identity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from bodo_tpu import relational as R
+from bodo_tpu.config import config
+from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.plan import logical as L
+from bodo_tpu.plan.optimizer import optimize
+from bodo_tpu.table.table import ONED, REP, Table
+from bodo_tpu.utils.logging import log
+
+# session-level result cache: plan key -> Table
+_result_cache: Dict = {}
+_result_cache_limit = 64
+
+
+def execute(node: L.Node, optimize_first: bool = True) -> Table:
+    if optimize_first:
+        node = optimize(node)
+        if config.dump_plans:
+            _dump(node)
+    return _exec(node)
+
+
+def _maybe_shard(t: Table) -> Table:
+    """Scan distribution policy: shard large sources over the mesh; keep
+    small ones replicated so joins against them broadcast instead of
+    shuffling (the reference's broadcast-join size heuristic)."""
+    if t.distribution == ONED:
+        return t
+    if t.nrows >= config.shard_min_rows and mesh_mod.num_shards() > 1:
+        return t.shard()
+    return t
+
+
+def _exec(node: L.Node) -> Table:
+    if node._cached is not None:
+        return node._cached
+    key = node.key()
+    hit = _result_cache.get(key)
+    if hit is not None:
+        node._cached = hit
+        return hit
+    t = _exec_inner(node)
+    node._cached = t
+    if len(_result_cache) >= _result_cache_limit:
+        _result_cache.pop(next(iter(_result_cache)))
+    _result_cache[key] = t
+    return t
+
+
+def _exec_inner(node: L.Node) -> Table:
+    if isinstance(node, L.ReadParquet):
+        from bodo_tpu.io import read_parquet
+        log(1, f"read_parquet({node.path}) columns={node.columns}")
+        return _maybe_shard(read_parquet(node.path, columns=node.columns))
+    if isinstance(node, L.ReadCsv):
+        from bodo_tpu.io import read_csv
+        return _maybe_shard(read_csv(
+            node.path, columns=node.columns,
+            parse_dates=list(node.parse_dates) or None))
+    if isinstance(node, L.FromPandas):
+        return _maybe_shard(node.table)
+    if isinstance(node, L.Projection):
+        child = _exec(node.child)
+        from bodo_tpu.plan.expr import ColRef
+        new = {}
+        names = []
+        for n, e in node.exprs:
+            names.append(n)
+            if not (isinstance(e, ColRef) and e.name == n):
+                new[n] = e
+        t = R.assign_columns(child, new) if new else child
+        return t.select(names)
+    if isinstance(node, L.Filter):
+        return R.filter_table(_exec(node.child), node.predicate)
+    if isinstance(node, L.Aggregate):
+        return R.groupby_agg(_exec(node.child), node.keys, node.aggs)
+    if isinstance(node, L.Reduce):
+        scalars = R.reduce_table(_exec(node.child), node.aggs)
+        import pandas as pd
+        df = pd.DataFrame({k: [v] for k, v in scalars.items()})
+        return Table.from_pandas(df)
+    if isinstance(node, L.Join):
+        left = _exec(node.left)
+        right = _exec(node.right)
+        return R.join_tables(left, right, node.left_on, node.right_on,
+                             node.how, node.suffixes)
+    if isinstance(node, L.Sort):
+        return R.sort_table(_exec(node.child), node.by, node.ascending,
+                            node.na_last)
+    if isinstance(node, L.Limit):
+        return R.head_table(_exec(node.child), node.n)
+    if isinstance(node, L.Distinct):
+        child = _exec(node.child)
+        others = [n for n in child.names if n not in node.subset]
+        aggs = [(n, "first", n) for n in others]
+        out = R.groupby_agg(child, node.subset, aggs)
+        return out.select(child.names)
+    raise TypeError(f"cannot execute {node!r}")
+
+
+def _dump(node: L.Node, indent: int = 0) -> None:  # pragma: no cover
+    import sys
+    print("  " * indent + repr(node), file=sys.stderr)
+    for c in node.children:
+        _dump(c, indent + 1)

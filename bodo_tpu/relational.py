@@ -469,6 +469,148 @@ def _join_broadcast(left, right, left_on, right_on, how, suffixes) -> Table:
 
 
 # ---------------------------------------------------------------------------
+# whole-column reductions
+# ---------------------------------------------------------------------------
+
+_REDUCE_PARTIALS = {"sum": ("sum",), "count": ("count",), "size": ("size",),
+                    "min": ("min", "count"), "max": ("max", "count"),
+                    "mean": ("sum", "count"),
+                    "var": ("sum", "sumsq", "count"),
+                    "std": ("sum", "sumsq", "count"),
+                    "var0": ("sum", "sumsq", "count"),
+                    "std0": ("sum", "sumsq", "count"),
+                    "prod": ("prod",)}
+
+
+def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
+    """Whole-column reductions → host scalars (Series.sum() analogue).
+
+    Per-shard partials are one fused jitted pass (masked reductions on the
+    VPU); the tiny [S, n_partials] result combines on host — the same
+    partial/combine decomposition as the distributed groupby.
+    """
+    specs = []
+    layout = []
+    for col, op, _ in aggs:
+        parts = _REDUCE_PARTIALS[op]
+        layout.append((len(specs), parts))
+        specs.extend((col, p) for p in parts)
+    names = t.names
+    key = ("reduce", _sig(t), tuple(specs), t.distribution,
+           _mesh_key(mesh_mod.get_mesh()) if t.distribution == ONED else None)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        def body(tree, count):
+            cap = tree[names[0]][0].shape[0]
+            padmask = K.row_mask(count, cap)
+            outs = []
+            for col, p in specs:
+                d, v = tree[col]
+                ok = K.value_ok(d, v, padmask)
+                if p == "count":
+                    outs.append(jnp.sum(ok).astype(jnp.int64))
+                elif p == "size":
+                    outs.append(jnp.sum(padmask).astype(jnp.int64))
+                elif p in ("sum", "sumsq"):
+                    # exact in the widened source family (int64/float64)
+                    acc = jnp.float64 if jnp.issubdtype(d.dtype, jnp.floating) \
+                        else (jnp.uint64 if jnp.issubdtype(
+                            d.dtype, jnp.unsignedinteger) else jnp.int64)
+                    x = d.astype(acc)
+                    if p == "sumsq":
+                        x = x.astype(jnp.float64) ** 2
+                    outs.append(jnp.sum(jnp.where(ok, x, jnp.zeros((), x.dtype))))
+                elif p == "prod":
+                    outs.append(jnp.prod(jnp.where(ok, d.astype(jnp.float64),
+                                                   1.0)))
+                elif p in ("min", "max"):
+                    # keep the source dtype — int64 ns ticks stay exact
+                    if jnp.issubdtype(d.dtype, jnp.floating):
+                        ident = jnp.array(np.inf if p == "min" else -np.inf,
+                                          d.dtype)
+                    elif d.dtype == jnp.bool_:
+                        ident = jnp.array(p == "min", jnp.bool_)
+                    else:
+                        info = jnp.iinfo(d.dtype)
+                        ident = jnp.array(info.max if p == "min"
+                                          else info.min, d.dtype)
+                    f = jnp.min if p == "min" else jnp.max
+                    outs.append(f(jnp.where(ok, d, ident)))
+            return tuple(outs)
+
+        if t.distribution == ONED:
+            m = mesh_mod.get_mesh()
+            ax = config.data_axis
+
+            def sharded(tree, counts):
+                return tuple(o[None] for o in body(tree, counts[0]))
+            fn = jax.jit(C.smap(sharded, in_specs=(P(ax), P(ax)),
+                                out_specs=tuple(P(ax) for _ in specs),
+                                mesh=m))
+        else:
+            def rep(tree, count):
+                return tuple(o[None] for o in body(tree, count))
+            fn = jax.jit(rep)
+        _jit_cache[key] = fn
+
+    counts_in = t.counts_device() if t.distribution == ONED \
+        else jnp.asarray(t.nrows)
+    raw = jax.device_get(fn(t.device_data(), counts_in))
+    partials = [np.asarray(r).reshape(-1) for r in raw]
+    out = {}
+    for (col, op, oname), (off, parts) in zip(aggs, layout):
+        block = {p: partials[off + i] for i, p in enumerate(parts)}
+        cnt = int(block["count"].sum()) if "count" in block else None
+        if op == "sum":
+            v = block["sum"].sum()
+        elif op == "prod":
+            v = np.prod(block["prod"])
+        elif op in ("count", "size"):
+            v = int(block[op].sum())
+        elif op in ("min", "max"):
+            if cnt == 0:
+                out[oname] = np.nan
+                continue
+            v = block[op].min() if op == "min" else block[op].max()
+        elif op == "mean":
+            v = float(block["sum"].sum()) / cnt if cnt else np.nan
+        elif op in ("var", "std", "var0", "std0"):
+            ddof = 0 if op.endswith("0") else 1
+            if cnt is not None and cnt > ddof:
+                s = float(block["sum"].sum())
+                s2 = float(block["sumsq"].sum())
+                v = max((s2 - s * s / cnt) / (cnt - ddof), 0.0)
+                if op.startswith("std"):
+                    v = float(np.sqrt(v))
+            else:
+                v = np.nan
+        out[oname] = _reduce_scalar(v, op, t.column(col).dtype, cnt)
+    return out
+
+
+def _reduce_scalar(v, op: str, src: dt.DType, cnt: Optional[int]):
+    """Convert a host reduction result back to its logical scalar type."""
+    import pandas as pd
+    if op in ("count", "size"):
+        return int(v)
+    if op in ("min", "max", "first", "last"):
+        if src is dt.DATETIME:
+            return pd.Timestamp(int(v)) if v is not None else pd.NaT
+        if src is dt.TIMEDELTA:
+            return pd.Timedelta(int(v))
+        if src is dt.DATE:
+            return (np.datetime64(0, "D") + int(v)).astype("datetime64[D]")
+        if src.kind in ("i", "u"):
+            return int(v)
+        if src.kind == "b":
+            return bool(v)
+        return float(v)
+    if op in ("sum", "prod") and src.kind in ("i", "u", "b"):
+        return int(v)
+    return float(v)
+
+
+# ---------------------------------------------------------------------------
 # capacity hygiene
 # ---------------------------------------------------------------------------
 

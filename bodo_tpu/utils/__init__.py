@@ -1,0 +1,1 @@
+"""Utilities: verbose logging, tracing, diagnostics."""

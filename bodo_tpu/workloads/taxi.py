@@ -71,6 +71,45 @@ def pandas_pipeline(trips_path: str, weather_path: str) -> pd.DataFrame:
     return out.sort_values(keys).reset_index(drop=True)
 
 
+def frontend_pipeline(trips_path: str, weather_path: str) -> pd.DataFrame:
+    """The same workload through the lazy pandas frontend — written to
+    mirror the reference benchmark's dataframe-library flavor nearly
+    line-for-line (reference: benchmarks/nyc_taxi/bodo/
+    nyc_taxi_precipitation.py get_monthly_travels_weather)."""
+    import bodo_tpu.pandas_api as bd
+
+    weather = bd.read_csv(weather_path, parse_dates=["DATE"])
+    weather = weather.rename(columns={"DATE": "date", "PRCP": "precipitation"})
+    trips = bd.read_parquet(trips_path)
+
+    weather["date"] = weather["date"].dt.date
+    trips["date"] = trips["pickup_datetime"].dt.date
+    trips["month"] = trips["pickup_datetime"].dt.month
+    trips["hour"] = trips["pickup_datetime"].dt.hour
+    trips["weekday"] = trips["pickup_datetime"].dt.dayofweek.isin(
+        [0, 1, 2, 3, 4])
+
+    m = trips.merge(weather, on="date", how="inner")
+    m["date_with_precipitation"] = m["precipitation"] > 0.1
+    m["time_bucket"] = m["hour"].map({8: 0, 9: 0, 10: 0,
+                                      11: 1, 12: 1, 13: 1, 14: 1, 15: 1,
+                                      16: 2, 17: 2, 18: 2,
+                                      19: 3, 20: 3, 21: 3}).fillna(4.0) \
+        .astype("int32")
+    keys = ["PULocationID", "DOLocationID", "month", "weekday",
+            "date_with_precipitation", "time_bucket"]
+    out = m.groupby(keys, as_index=False).agg(
+        trip_count=("hvfhs_license_num", "count"),
+        avg_miles=("trip_miles", "mean"))
+    res = out.to_pandas()
+    bucket_names = np.array(["morning", "midday", "afternoon", "evening",
+                             "other"])
+    res["time_bucket"] = bucket_names[res["time_bucket"]]
+    # sort after mapping so bucket order matches the pandas oracle
+    # (alphabetical names, not integer codes)
+    return res.sort_values(keys).reset_index(drop=True)
+
+
 def bodo_tpu_pipeline(trips_path: str, weather_path: str, shard: bool = True):
     """Same workload on the bodo_tpu relational layer. Returns a Table."""
     import bodo_tpu.relational as R

@@ -1,0 +1,193 @@
+"""Lazy pandas frontend tests — differential vs real pandas (the
+check_func pattern of SURVEY.md §4 at the API level)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import make_df
+
+
+@pytest.fixture
+def bd():
+    import bodo_tpu.pandas_api as bd
+    return bd
+
+
+def _cmp_frames(got: pd.DataFrame, exp: pd.DataFrame, sort_by=None):
+    if sort_by:
+        got = got.sort_values(sort_by).reset_index(drop=True)
+        exp = exp.sort_values(sort_by).reset_index(drop=True)
+    assert list(got.columns) == list(exp.columns)
+    assert len(got) == len(exp)
+    for c in exp.columns:
+        g, e = got[c], exp[c]
+        if e.dtype.kind in "fiu":
+            np.testing.assert_allclose(g.to_numpy(dtype=float),
+                                       e.to_numpy(dtype=float),
+                                       rtol=1e-9, equal_nan=True, err_msg=c)
+        else:
+            assert [str(x) for x in g] == [str(x) for x in e], c
+
+
+def test_filter_mask_and_columns(bd, mesh8):
+    df = make_df(400, nulls=True)
+    b = bd.from_pandas(df)
+    got = b[b["a"] > 5][["a", "b"]].to_pandas()
+    exp = df[df["a"] > 5][["a", "b"]].reset_index(drop=True)
+    _cmp_frames(got, exp)
+
+
+def test_setitem_assign_arith(bd, mesh8):
+    df = make_df(300)
+    b = bd.from_pandas(df)
+    b["e"] = b["a"] * 2 + b["d"]
+    got = b.to_pandas()
+    exp = df.copy()
+    exp["e"] = exp["a"] * 2 + exp["d"]
+    _cmp_frames(got, exp)
+
+    b2 = bd.from_pandas(df).assign(f=lambda x: x["b"] + 1.0)
+    assert np.allclose(b2.to_pandas()["f"], df["b"] + 1.0)
+
+
+def test_merge_groupby_sort(bd, mesh8):
+    df = make_df(500)
+    lookup = pd.DataFrame({"a": range(10), "w": np.arange(10) * 1.5})
+    b = bd.from_pandas(df).merge(bd.from_pandas(lookup), on="a")
+    g = b.groupby(["c"], as_index=False).agg(
+        total=("w", "sum"), mu=("b", "mean"))
+    got = g.sort_values("c").to_pandas()
+    exp = (df.merge(lookup, on="a")
+           .groupby("c", as_index=False)
+           .agg(total=("w", "sum"), mu=("b", "mean"))
+           .sort_values("c").reset_index(drop=True))
+    _cmp_frames(got, exp)
+
+
+def test_groupby_as_index_and_size(bd, mesh8):
+    df = make_df(400)
+    b = bd.from_pandas(df)
+    got = b.groupby("a")["b"].sum()
+    exp = df.groupby("a")["b"].sum()
+    np.testing.assert_allclose(np.asarray(got).ravel(), exp.to_numpy(),
+                               rtol=1e-9)
+    got_sz = b.groupby("a").size()
+    np.testing.assert_array_equal(np.asarray(got_sz).ravel(),
+                                  df.groupby("a").size().to_numpy())
+
+
+def test_groupby_dict_agg(bd, mesh8):
+    df = make_df(400, nulls=True)
+    got = (bd.from_pandas(df).groupby("a", as_index=False)
+           .agg({"b": "sum", "d": "max"}).to_pandas())
+    exp = df.groupby("a", as_index=False).agg({"b": "sum", "d": "max"})
+    _cmp_frames(got, exp, sort_by=["a"])
+
+
+def test_series_reductions(bd, mesh8):
+    df = make_df(500, nulls=True)
+    s = bd.from_pandas(df)["b"]
+    assert np.isclose(s.sum(), df["b"].sum())
+    assert np.isclose(s.mean(), df["b"].mean())
+    assert np.isclose(s.std(), df["b"].std())
+    assert s.count() == df["b"].count()
+    e = bd.from_pandas(df)["e"]
+    assert e.count() == df["e"].count()
+    assert int(e.sum()) == int(df["e"].sum())
+
+
+def test_series_value_counts_unique(bd, mesh8):
+    df = make_df(400)
+    s = bd.from_pandas(df)["c"]
+    got = s.value_counts()
+    exp = df["c"].value_counts().sort_index()
+    pd.testing.assert_series_equal(got.sort_index(), exp,
+                                   check_names=False, check_dtype=False)
+    assert sorted(s.unique()) == sorted(df["c"].unique())
+    assert s.nunique() == df["c"].nunique()
+
+
+def test_str_and_dt_accessors(bd, mesh8):
+    df = pd.DataFrame({
+        "s": ["apple", "banana", "cherry", "apricot"] * 25,
+        "t": pd.date_range("2024-01-01", periods=100, freq="11h"),
+    })
+    b = bd.from_pandas(df)
+    got = b[b["s"].str.startswith("ap")].to_pandas()
+    exp = df[df["s"].str.startswith("ap")].reset_index(drop=True)
+    assert len(got) == len(exp)
+    got2 = b[b["s"].str.contains("an")].to_pandas()
+    assert len(got2) == (df["s"].str.contains("an")).sum()
+    b = b.assign(mo=b["t"].dt.month, hr=b["t"].dt.hour)
+    got3 = b.to_pandas()
+    np.testing.assert_array_equal(got3["mo"], df["t"].dt.month)
+    np.testing.assert_array_equal(got3["hr"], df["t"].dt.hour)
+
+
+def test_series_eq_string_and_isin(bd, mesh8):
+    df = make_df(300)
+    b = bd.from_pandas(df)
+    assert len(b[b["c"] == "x"]) == (df["c"] == "x").sum()
+    assert len(b[b["c"].isin(["x", "w"])]) == df["c"].isin(["x", "w"]).sum()
+    assert len(b[b["c"] != "x"]) == (df["c"] != "x").sum()
+
+
+def test_map_dict(bd, mesh8):
+    df = make_df(200)
+    b = bd.from_pandas(df)
+    b["m"] = b["a"].map({i: i * 10.0 for i in range(10)})
+    got = b.to_pandas()["m"]
+    exp = df["a"].map({i: i * 10.0 for i in range(10)})
+    np.testing.assert_allclose(got, exp)
+
+
+def test_drop_rename_head_dedup(bd, mesh8):
+    df = make_df(300)
+    b = bd.from_pandas(df)
+    assert list(b.drop(columns=["b"]).columns) == ["a", "c", "d"]
+    assert list(b.rename(columns={"a": "A"}).columns) == ["A", "b", "c", "d"]
+    assert len(b.head(7)) == 7
+    dd = b[["a", "c"]].drop_duplicates()
+    assert len(dd) == len(df[["a", "c"]].drop_duplicates())
+
+
+def test_fallback_warns(bd, mesh8):
+    df = make_df(100)
+    b = bd.from_pandas(df)
+    with pytest.warns(UserWarning, match="falling back"):
+        res = b.describe()
+    assert isinstance(res, pd.DataFrame)
+
+
+def test_read_parquet_column_pruning(bd, mesh8, tmp_path):
+    from bodo_tpu.plan.optimizer import optimize
+    df = make_df(300)
+    path = str(tmp_path / "t.parquet")
+    df.to_parquet(path)
+    b = bd.read_parquet(path)
+    g = b.groupby("a", as_index=False).agg(s=("b", "sum"))
+    plan = optimize(g._plan)
+    # scan must be pruned to the two needed columns
+    scan = plan
+    while scan.children:
+        scan = scan.children[0]
+    assert set(scan.columns) == {"a", "b"}
+    got = g.to_pandas()
+    exp = df.groupby("a", as_index=False).agg(s=("b", "sum"))
+    _cmp_frames(got, exp, sort_by=["a"])
+
+
+def test_filter_pushdown_through_projection(bd, mesh8):
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.plan.optimizer import optimize
+    df = make_df(300)
+    b = bd.from_pandas(df)
+    b["e"] = b["a"] * 2
+    f = b[b["a"] > 3]
+    plan = optimize(f._plan)
+    # filter must sit below the projection after optimization
+    assert isinstance(plan, L.Projection)
+    assert isinstance(plan.child, L.Filter)
+    _cmp_frames(f.to_pandas(),
+                df.assign(e=df["a"] * 2)[df["a"] > 3].reset_index(drop=True))

@@ -1,0 +1,23 @@
+"""The NYC-taxi workload through the lazy pandas frontend (drop-in API
+proof: the code mirrors the reference benchmark nearly line-for-line)."""
+
+import numpy as np
+
+from bodo_tpu.workloads.taxi import (frontend_pipeline, gen_taxi_data,
+                                     pandas_pipeline)
+
+
+def test_frontend_taxi_vs_pandas(mesh8, tmp_path):
+    pq = str(tmp_path / "trips.parquet")
+    csv = str(tmp_path / "weather.csv")
+    gen_taxi_data(4000, pq, csv)
+
+    exp = pandas_pipeline(pq, csv)
+    got = frontend_pipeline(pq, csv)
+    assert len(got) == len(exp)
+    keys = ["PULocationID", "DOLocationID", "month", "weekday",
+            "date_with_precipitation", "time_bucket"]
+    got = got.sort_values(keys).reset_index(drop=True)
+    exp = exp.sort_values(keys).reset_index(drop=True)
+    np.testing.assert_array_equal(got["trip_count"], exp["trip_count"])
+    np.testing.assert_allclose(got["avg_miles"], exp["avg_miles"], rtol=1e-9)

@@ -65,3 +65,52 @@ def test_sharded_join_high_multiplicity(mesh8):
                         Table.from_pandas(right).shard(), ["k"], ["k"],
                         "inner")
     assert out.nrows == 600 * 300
+
+
+def test_reduce_datetime_minmax(mesh8):
+    import bodo_tpu.pandas_api as bd
+    ts = pd.DatetimeIndex([pd.Timestamp("2023-05-01 00:00:00.000000001"),
+                           pd.Timestamp("2024-01-01")])
+    df = pd.DataFrame({"t": ts})
+    s = bd.from_pandas(df)["t"]
+    assert s.min() == pd.Timestamp("2023-05-01 00:00:00.000000001")
+    assert s.max() == pd.Timestamp("2024-01-01")
+
+
+def test_ddof_zero(mesh8):
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"v": [1.0, 2.0, 3.0], "k": [1, 1, 1]})
+    s = bd.from_pandas(df)["v"]
+    assert np.isclose(s.var(ddof=0), df["v"].var(ddof=0))
+    assert np.isclose(s.std(ddof=0), df["v"].std(ddof=0))
+    g = bd.from_pandas(df).groupby("k", as_index=False).var(ddof=0)
+    assert np.isclose(g.to_pandas()["v"][0], df["v"].var(ddof=0))
+
+
+def test_captured_series_survives_setitem(mesh8):
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"a": [1, 2, 3], "x": [1.0, 2.0, 3.0]})
+    f = bd.from_pandas(df)
+    s = f["a"]
+    f["b"] = f["x"] * 2
+    f["c"] = s + 1
+    got = f.to_pandas()
+    np.testing.assert_array_equal(got["c"], df["a"] + 1)
+    # but a series whose column was overwritten is rejected
+    s2 = f["b"]
+    f["b"] = f["x"] * 3
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="overwritten"):
+        f["d"] = s2 + 1
+
+
+def test_setitem_raw_array_fallback(mesh8):
+    import warnings
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    f = bd.from_pandas(df)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        f["z"] = np.array([7, 8, 9])
+    assert any("falling back" in str(x.message) for x in w)
+    assert f.to_pandas()["z"].tolist() == [7, 8, 9]

@@ -32,15 +32,18 @@ __version__ = "0.1.0"
 __all__ = [
     "config", "set_config", "set_verbose_level",
     "get_mesh", "set_mesh", "use_mesh", "make_mesh", "num_shards",
-    "init_runtime", "Table", "Column", "dtypes", "jit",
+    "init_runtime", "Table", "Column", "dtypes", "jit", "wrap_python",
 ]
 
 
 def __getattr__(name):
     # Lazy imports to keep `import bodo_tpu` light and avoid cycles.
     if name == "jit":
-        from bodo_tpu.jit import jit as _jit
+        from bodo_tpu.jit_compiler import jit as _jit
         return _jit
+    if name == "wrap_python":
+        from bodo_tpu.jit_compiler import wrap_python as _wp
+        return _wp
     if name == "pandas_api":
         import bodo_tpu.pandas_api as m
         return m

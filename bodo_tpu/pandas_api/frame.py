@@ -163,6 +163,23 @@ class BodoDataFrame:
         exprs = [(columns.get(n, n), ColRef(n)) for n in self._plan.schema]
         return BodoDataFrame(L.Projection(self._plan, exprs))
 
+    def apply(self, func, axis=0, raw=False, args=(), **kwargs):
+        """axis=1 row UDFs compile to a vmapped device kernel (the
+        reference's compiled-UDF path, README-quickstart workload); anything
+        else falls back to pandas."""
+        if axis == 1 and not args and not kwargs:
+            from bodo_tpu.pandas_api.series import validate_expr_trace
+            from bodo_tpu.plan.expr import RowUDF
+            from bodo_tpu.table import dtypes as dtl
+            traced = validate_expr_trace(RowUDF(func, None),
+                                         self._plan.schema)
+            if traced is not None:
+                return BodoSeries(self._plan,
+                                  RowUDF(func, dtl.from_numpy(traced)), None)
+        warn_fallback("DataFrame.apply", "uncompilable UDF or axis=0")
+        return self.to_pandas().apply(func, axis=axis, raw=raw, args=args,
+                                      **kwargs)
+
     # ---- relational ops ----------------------------------------------------
     def merge(self, right: "BodoDataFrame", on=None, left_on=None,
               right_on=None, how: str = "inner",

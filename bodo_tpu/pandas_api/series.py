@@ -24,6 +24,24 @@ from bodo_tpu.utils.logging import warn_fallback
 _REDUCTIONS = ("sum", "mean", "min", "max", "count", "var", "std", "prod")
 
 
+def validate_expr_trace(expr: Expr, schema):
+    """Cheaply check an expression (e.g. a UDF) traces on this schema by
+    evaluating it on a 4-row zero tree. Returns the traced output numpy
+    dtype on success, None on failure."""
+    import jax.numpy as jnp
+
+    from bodo_tpu.plan.expr import eval_expr
+    try:
+        tree = {n: (jnp.zeros(4, dtype=t.numpy), None)
+                for n, t in schema.items()}
+        dicts = {n: np.array(["a"], dtype=str) for n, t in schema.items()
+                 if t is dt.STRING}
+        out, _ = eval_expr(expr, tree, dicts, schema)
+        return np.dtype(out.dtype)
+    except Exception:
+        return None
+
+
 def _ddof_op(op: str, ddof: int) -> str:
     """var/std with ddof 0/1 map to dedicated ops; others are unsupported."""
     if ddof == 1:
@@ -208,8 +226,8 @@ class BodoSeries:
             + repr(self.head(10))
 
     def map(self, arg):
-        """dict mapping compiles to a device Where-chain / code LUT; callables
-        fall back to pandas (compiled UDFs arrive with the @jit layer)."""
+        """dict mappings compile to a device Where-chain; numeric callables
+        compile to a vmapped kernel; string mappers fall back to pandas."""
         if isinstance(arg, dict) and len(arg) <= 64 and \
                 self._dtype is not dt.STRING:
             vals = list(arg.items())
@@ -218,7 +236,14 @@ class BodoSeries:
             for k, v in reversed(vals):
                 expr = Where(BinOp("==", self._expr, Lit(k)), Lit(v), expr)
             return self._wrap(expr)
-        warn_fallback("Series.map", "non-dict or string mapper")
+        if callable(arg) and self._dtype.kind in ("i", "u", "f", "b"):
+            from bodo_tpu.plan.expr import RowUDF
+            e = RowUDF(arg, None, self._expr)
+            traced = validate_expr_trace(e, self._plan.schema)
+            if traced is not None:
+                return self._wrap(RowUDF(arg, dt.from_numpy(traced),
+                                         self._expr))
+        warn_fallback("Series.map", "uncompilable or string mapper")
         return self.to_pandas().map(arg)
 
     def __getattr__(self, name):

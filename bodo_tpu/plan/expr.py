@@ -131,6 +131,78 @@ class Where(Expr):
 
 
 @_frozen
+class RowUDF(Expr):
+    """Compiled element-wise Python UDF (df.apply(axis=1) / Series.map).
+
+    The callable is traced with jax.vmap over per-row scalars — the
+    trace-to-XLA analogue of the reference compiling UDFs with a nested
+    Numba pipeline (BodoCompilerUDF, bodo/compiler.py:705). String columns
+    are withheld from the row namespace (dict codes would silently change
+    semantics); a UDF touching one raises KeyError at trace time and the
+    frontend falls back to pandas.
+    """
+    func: Any          # callable(Row) -> scalar, or callable(x) in scalar mode
+    out_dtype: Any     # DType or None (trace default float64)
+    operand: Any = None  # Expr → scalar mode (Series.map); None → row mode
+    def key(self):
+        return ("rowudf", _udf_serial(self.func),
+                self.out_dtype.name if self.out_dtype else None,
+                self.operand.key() if self.operand is not None else None)
+
+
+_UDF_COUNTER = [0]
+_UDF_SERIALS: Dict[int, Tuple] = {}  # id -> (weakref, serial)
+
+
+def _udf_serial(func) -> int:
+    """Stable serial per live callable — id() alone is unsafe as cache key
+    (CPython reuses ids after GC; same guard as relational._dict_fp)."""
+    s = getattr(func, "__bodo_tpu_udf_serial__", None)
+    if s is not None:
+        return s
+    _UDF_COUNTER[0] += 1
+    serial = _UDF_COUNTER[0]
+    try:
+        func.__bodo_tpu_udf_serial__ = serial
+    except (AttributeError, TypeError):
+        import weakref
+        ent = _UDF_SERIALS.get(id(func))
+        if ent is not None and ent[0]() is func:
+            return ent[1]
+        key = id(func)
+        try:
+            wr = weakref.ref(func, lambda _: _UDF_SERIALS.pop(key, None))
+        except TypeError:
+            wr = lambda: func  # not weakref-able: pin via closure
+        _UDF_SERIALS[key] = (wr, serial)
+    return serial
+
+
+class _RowNS:
+    """Attribute/item access over a dict of per-row scalar tracers;
+    records which columns the UDF actually reads (for null propagation)."""
+    __slots__ = ("_d", "_touched")
+
+    def __init__(self, d, touched=None):
+        object.__setattr__(self, "_d", d)
+        object.__setattr__(self, "_touched", touched)
+
+    def __getattr__(self, n):
+        try:
+            v = self._d[n]
+        except KeyError:
+            raise AttributeError(n)
+        if self._touched is not None:
+            self._touched.add(n)
+        return v
+
+    def __getitem__(self, n):
+        if self._touched is not None and n in self._d:
+            self._touched.add(n)
+        return self._d[n]
+
+
+@_frozen
 class StrPredicate(Expr):
     """String predicate evaluated on the host dictionary → device LUT.
     kind: contains | startswith | endswith | match | eq_any | lower_eq"""
@@ -170,6 +242,10 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
         return dt.DATE if e.field == "date" else dt.INT64
     if isinstance(e, (IsIn, StrPredicate)):
         return dt.BOOL
+    if isinstance(e, RowUDF):
+        if e.out_dtype is not None:
+            return e.out_dtype
+        return dt.FLOAT64
     if isinstance(e, UnOp):
         if e.op in ("isna", "notna", "~"):
             return dt.BOOL
@@ -204,6 +280,10 @@ def expr_columns(e: Expr) -> set:
         return set()
     if isinstance(e, BinOp):
         return expr_columns(e.left) | expr_columns(e.right)
+    if isinstance(e, RowUDF):
+        if e.operand is not None:
+            return expr_columns(e.operand)
+        return {"*"}  # may touch any column — disables pruning above it
     if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate)):
         return expr_columns(e.operand)
     if isinstance(e, Where):
@@ -281,6 +361,38 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
         for val in e.values:
             acc = acc | (d == val)
         return acc, v
+    if isinstance(e, RowUDF):
+        import jax
+        if e.operand is not None:  # scalar mode (Series.map)
+            d, v = eval_expr(e.operand, tree, dicts, schema)
+            out = jax.vmap(e.func)(d)
+            if e.out_dtype is not None:
+                out = out.astype(e.out_dtype.numpy)
+            return out, v
+        # row mode: withhold string/temporal columns — their physical repr
+        # (dict codes, int ticks) would silently change meaning; a UDF
+        # touching one fails the trace → frontend falls back to pandas
+        numeric = {n: d for n, (d, v) in tree.items()
+                   if schema.get(n) is not None
+                   and schema[n].kind in ("i", "u", "f", "b")}
+        # discover which columns the UDF reads (abstract pre-trace), so
+        # null masks propagate only from consumed columns
+        touched: set = set()
+        jax.eval_shape(
+            lambda row: e.func(_RowNS(row, touched)),
+            {n: jax.ShapeDtypeStruct((), d.dtype) for n, d in numeric.items()})
+
+        def one_row(row_vals):
+            return e.func(_RowNS(row_vals))
+        out = jax.vmap(one_row)(numeric)
+        if e.out_dtype is not None:
+            out = out.astype(e.out_dtype.numpy)
+        valid = None
+        for n in sorted(touched):
+            v = tree[n][1]
+            if v is not None:
+                valid = v if valid is None else (valid & v)
+        return out, valid
     if isinstance(e, StrPredicate):
         col = e.operand
         if not isinstance(col, ColRef):

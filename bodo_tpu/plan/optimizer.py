@@ -19,7 +19,8 @@ from typing import Dict, Optional, Sequence, Set
 
 from bodo_tpu.plan import logical as L
 from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DtField, Expr, IsIn,
-                                Lit, StrPredicate, UnOp, Where, expr_columns)
+                                Lit, RowUDF, StrPredicate, UnOp, Where,
+                                expr_columns)
 
 
 def optimize(node: L.Node) -> L.Node:
@@ -50,6 +51,10 @@ def _substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
         return IsIn(_substitute(e.operand, mapping), e.values)
     if isinstance(e, StrPredicate):
         return StrPredicate(e.kind, e.pattern, _substitute(e.operand, mapping))
+    if isinstance(e, RowUDF):
+        if e.operand is None:
+            raise TypeError("row-mode UDF cannot be substituted")
+        return RowUDF(e.func, e.out_dtype, _substitute(e.operand, mapping))
     if isinstance(e, Where):
         return Where(_substitute(e.cond, mapping),
                      _substitute(e.iftrue, mapping),
@@ -65,7 +70,7 @@ def push_filters(node: L.Node) -> L.Node:
             # merge adjacent filters, keep pushing
             return push_filters(L.Filter(child.child,
                                          BinOp("&", child.predicate, pred)))
-        if isinstance(child, L.Projection):
+        if isinstance(child, L.Projection) and "*" not in expr_columns(pred):
             mapping = {n: e for n, e in child.exprs}
             pushed = L.Filter(push_filters(child.child),
                               _substitute(pred, mapping))
@@ -114,10 +119,13 @@ def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
         need = set()
         for _, e in exprs:
             need |= expr_columns(e)
+        if "*" in need:  # a RowUDF may read any column
+            need = None
         return L.Projection(prune_columns(node.child, need), exprs)
     if isinstance(node, L.Filter):
-        need = None if required is None else \
-            (set(required) | expr_columns(node.predicate))
+        pcols = expr_columns(node.predicate)
+        need = None if (required is None or "*" in pcols) else \
+            (set(required) | pcols)
         return L.Filter(prune_columns(node.child, need), node.predicate)
     if isinstance(node, L.Aggregate):
         aggs = node.aggs if required is None else \

@@ -19,6 +19,21 @@ import sys
 import time
 
 
+def _probe_accelerator(timeout_s: int = 240) -> bool:
+    """Check the accelerator backend initializes, in a subprocess so a
+    hanging device tunnel can't wedge the benchmark itself."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "assert d and d[0].platform != 'cpu'"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=20_000_000)
@@ -29,11 +44,16 @@ def main():
     args = ap.parse_args()
     n_rows = 200_000 if args.quick else args.rows
 
-    if args.cpu:
+    use_cpu = args.cpu
+    if not use_cpu and not _probe_accelerator(timeout_s=240):
+        print("accelerator backend unavailable — falling back to CPU mesh",
+              file=sys.stderr)
+        use_cpu = True
+    if use_cpu:
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
             " --xla_force_host_platform_device_count=8"
     import jax
-    if args.cpu:
+    if use_cpu:
         jax.config.update("jax_platforms", "cpu")
 
     import pandas as pd  # noqa: F401

@@ -1,0 +1,20 @@
+"""Distributed ML (reference bodo/ml_support/ + bodo/ai/).
+
+The reference wraps sklearn estimators in objmode calls plus MPI
+allreduces (bodo/ml_support/sklearn_ext.py:10 pattern: per-rank
+partial_fit / fit, then allreduce of coefficients). Here estimators are
+jit-compiled JAX programs over row-sharded arrays: gradients/statistics
+reduce with psum over the mesh — no host round-trips inside the training
+loop, and the MXU does the matmuls.
+"""
+
+from bodo_tpu.ml.linear import LinearRegression, LogisticRegression, Ridge
+from bodo_tpu.ml.cluster import KMeans
+from bodo_tpu.ml.preprocessing import StandardScaler, LabelEncoder
+from bodo_tpu.ml.metrics import (accuracy_score, mean_squared_error,
+                                 r2_score)
+from bodo_tpu.ml.model_selection import train_test_split
+
+__all__ = ["LinearRegression", "LogisticRegression", "Ridge", "KMeans",
+           "StandardScaler", "LabelEncoder", "accuracy_score",
+           "mean_squared_error", "r2_score", "train_test_split"]

@@ -1,0 +1,58 @@
+"""Shared helpers: accept numpy / jax / BodoSeries / BodoDataFrame inputs
+and produce row-sharded device arrays + a padding mask."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.table.table import round_capacity
+
+
+def to_device_xy(X, y=None):
+    """Returns (X [N,D] float, y [N] or None, mask [N] bool, n_rows).
+
+    Arrays are padded to a shard-divisible capacity and row-sharded over
+    the mesh (the reference's OneD distribution for ML inputs,
+    bodo/transforms/distributed_analysis.py TwoD for matrices)."""
+    X = _to_numpy_2d(X)
+    n = X.shape[0]
+    S = mesh_mod.num_shards()
+    per = round_capacity(-(-max(n, 1) // S))
+    cap = S * per
+    Xp = np.zeros((cap, X.shape[1]), dtype=np.float64)
+    Xp[:n] = X
+    mask = np.zeros(cap, dtype=bool)
+    mask[:n] = True
+    sharding = mesh_mod.row_sharding()
+    Xd = jax.device_put(Xp, sharding)
+    md = jax.device_put(mask, sharding)
+    yd = None
+    if y is not None:
+        yv = _to_numpy_1d(y).astype(np.float64)
+        yp = np.zeros(cap, dtype=np.float64)
+        yp[:n] = yv
+        yd = jax.device_put(yp, sharding)
+    return Xd, yd, md, n
+
+
+def _to_numpy_2d(X) -> np.ndarray:
+    X = _materialize(X)
+    X = np.asarray(X, dtype=np.float64)
+    if X.ndim == 1:
+        X = X[:, None]
+    return X
+
+
+def _to_numpy_1d(y) -> np.ndarray:
+    y = _materialize(y)
+    return np.asarray(y).reshape(-1)
+
+
+def _materialize(v):
+    to_pandas = getattr(v, "to_pandas", None)
+    return to_pandas() if callable(to_pandas) else v

@@ -1,0 +1,152 @@
+"""Linear models (reference bodo/ml_support/sklearn_linear_model_ext.py).
+
+LinearRegression/Ridge solve the normal equations with a psum-reduced
+Gram matrix (X^T X and X^T y accumulate per shard, reduce over the mesh,
+solve replicated) — exact, one pass, MXU-friendly. LogisticRegression
+runs jit-compiled full-batch Newton/gradient iterations with psum'd
+gradients (the reference approximates with per-rank SGD + parameter
+averaging; a global-gradient solver is both simpler and more exact)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.ml._data import to_device_xy
+
+
+def _gram(X, y, mask):
+    w = mask.astype(X.dtype)
+    Xw = X * w[:, None]
+    G = Xw.T @ X                      # [D,D]
+    b = Xw.T @ y                      # [D]
+    return G, b
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _linreg_fit(X, y, mask, alpha, fit_intercept: bool):
+    if fit_intercept:
+        ones = jnp.where(mask, 1.0, 0.0)
+        X = jnp.concatenate([X, ones[:, None]], axis=1)
+    G, b = _gram(X, y, mask)
+    d = G.shape[0]
+    reg = alpha * jnp.eye(d)
+    if fit_intercept:
+        reg = reg.at[d - 1, d - 1].set(0.0)
+    theta = jnp.linalg.solve(G + reg, b)
+    return theta
+
+
+class LinearRegression:
+    def __init__(self, fit_intercept: bool = True):
+        self.fit_intercept = fit_intercept
+        self._alpha = 0.0
+
+    def fit(self, X, y):
+        Xd, yd, mask, n = to_device_xy(X, y)
+        theta = np.asarray(jax.device_get(
+            _linreg_fit(Xd, yd, mask, jnp.asarray(self._alpha),
+                        self.fit_intercept)))
+        if self.fit_intercept:
+            self.coef_ = theta[:-1]
+            self.intercept_ = float(theta[-1])
+        else:
+            self.coef_ = theta
+            self.intercept_ = 0.0
+        return self
+
+    def predict(self, X):
+        Xd, _, mask, n = to_device_xy(X)
+        out = np.asarray(jax.device_get(
+            Xd @ jnp.asarray(self.coef_) + self.intercept_))
+        return out[:n]
+
+    def score(self, X, y):
+        from bodo_tpu.ml.metrics import r2_score
+        return r2_score(np.asarray(y).reshape(-1), self.predict(X))
+
+
+class Ridge(LinearRegression):
+    def __init__(self, alpha: float = 1.0, fit_intercept: bool = True):
+        super().__init__(fit_intercept)
+        self._alpha = float(alpha)
+        self.alpha = alpha
+
+
+@partial(jax.jit, static_argnames=("iters", "fit_intercept"))
+def _logreg_fit(X, y, mask, lam, iters: int, fit_intercept: bool):
+    if fit_intercept:
+        ones = jnp.where(mask, 1.0, 0.0)
+        X = jnp.concatenate([X, ones[:, None]], axis=1)
+    d = X.shape[1]
+    w0 = jnp.zeros(d)
+    n = jnp.maximum(jnp.sum(mask), 1).astype(X.dtype)
+
+    def newton_step(w, _):
+        z = X @ w
+        p = jax.nn.sigmoid(z)
+        msk = mask.astype(X.dtype)
+        g = X.T @ ((p - y) * msk) / n + lam * w
+        r = p * (1 - p) * msk
+        H = (X * r[:, None]).T @ X / n + lam * jnp.eye(d)
+        w = w - jnp.linalg.solve(H, g)
+        return w, None
+
+    w, _ = jax.lax.scan(newton_step, w0, None, length=iters)
+    return w
+
+
+class LogisticRegression:
+    """Binary logistic regression via Newton iterations (global gradient,
+    exact across shards)."""
+
+    def __init__(self, C: float = 1.0, max_iter: int = 25,
+                 fit_intercept: bool = True):
+        self.C = C
+        self.max_iter = max_iter
+        self.fit_intercept = fit_intercept
+
+    def fit(self, X, y):
+        yv = np.asarray(self._mat(y)).reshape(-1)
+        self.classes_ = np.unique(yv)
+        assert len(self.classes_) == 2, "binary only (round 1)"
+        y01 = (yv == self.classes_[1]).astype(np.float64)
+        Xd, yd, mask, n = to_device_xy(X, y01)
+        lam = 1.0 / (self.C * max(n, 1))
+        w = np.asarray(jax.device_get(_logreg_fit(
+            Xd, yd, mask, jnp.asarray(lam), min(self.max_iter, 50),
+            self.fit_intercept)))
+        if self.fit_intercept:
+            self.coef_ = w[None, :-1]
+            self.intercept_ = np.array([w[-1]])
+        else:
+            self.coef_ = w[None, :]
+            self.intercept_ = np.array([0.0])
+        return self
+
+    @staticmethod
+    def _mat(v):
+        to_pandas = getattr(v, "to_pandas", None)
+        return to_pandas() if callable(to_pandas) else v
+
+    def decision_function(self, X):
+        Xd, _, mask, n = to_device_xy(X)
+        z = np.asarray(jax.device_get(
+            Xd @ jnp.asarray(self.coef_[0]) + self.intercept_[0]))
+        return z[:n]
+
+    def predict_proba(self, X):
+        z = self.decision_function(X)
+        p = 1.0 / (1.0 + np.exp(-z))
+        return np.stack([1 - p, p], axis=1)
+
+    def predict(self, X):
+        return self.classes_[(self.decision_function(X) > 0).astype(int)]
+
+    def score(self, X, y):
+        from bodo_tpu.ml.metrics import accuracy_score
+        return accuracy_score(np.asarray(self._mat(y)).reshape(-1),
+                              self.predict(X))

@@ -37,6 +37,7 @@ _PRIMITIVE = {"sum", "sumsq", "count", "size", "min", "max", "first", "last",
 # final op -> (partial ops, combine ops on partial cols)
 DECOMPOSE: Dict[str, List[str]] = {
     "sum": ["sum"],
+    "sumnull": ["sumnull"],
     "prod": ["prod"],
     "count": ["count"],
     "size": ["size"],
@@ -50,7 +51,8 @@ DECOMPOSE: Dict[str, List[str]] = {
     "var0": ["sum", "sumsq", "count"],
     "std0": ["sum", "sumsq", "count"],
 }
-COMBINE_OF = {"sum": "sum", "sumsq": "sum", "count": "sum", "size": "sum",
+COMBINE_OF = {"sum": "sum", "sumnull": "sumnull", "sumsq": "sum",
+              "count": "sum", "size": "sum",
               "min": "min", "max": "max", "first": "first", "last": "last",
               "prod": "prod"}
 
@@ -61,7 +63,7 @@ def result_dtype(op: str, dtype):
         return jnp.dtype(jnp.int64)
     if op in ("mean", "var", "std", "var0", "std0"):
         return jnp.dtype(jnp.float32) if d == jnp.float32 else jnp.dtype(jnp.float64)
-    if op in ("sum", "sumsq", "prod"):
+    if op in ("sum", "sumnull", "sumsq", "prod"):
         if jnp.issubdtype(d, jnp.floating):
             return d
         if jnp.issubdtype(d, jnp.unsignedinteger):
@@ -118,11 +120,13 @@ def _segment_agg(op: str, v_s, valid_s, seg, padmask_s, out_cap: int):
         sz = jax.ops.segment_sum(padmask_s.astype(jnp.int64), seg,
                                  num_segments=out_cap)
         return sz, None
-    if op in ("sum", "sumsq"):
+    if op in ("sum", "sumnull", "sumsq"):
         v = v_s.astype(rdt)
         if op == "sumsq":
             v = v * v
         s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
+        if op == "sumnull":  # SQL: SUM over all-null group is NULL
+            return s, cnt > 0
         return s, None  # pandas: sum over all-null = 0
     if op == "prod":
         v = v_s.astype(rdt)

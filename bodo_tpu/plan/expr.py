@@ -203,6 +203,32 @@ class _RowNS:
 
 
 @_frozen
+class DictMap(Expr):
+    """String→string transform applied to the host dictionary (substring,
+    upper, lower): the device only remaps int32 codes through a host-built
+    translation table — strings never reach the device (same trick as the
+    reference's dict-encoded string kernels, bodo/libs/dict_arr_ext.py).
+    Must sit at the top level of a projection (relational.assign_columns
+    attaches the new dictionary host-side)."""
+    kind: str          # substring | upper | lower
+    params: Tuple
+    operand: Expr      # must reference a string column
+    def key(self):
+        return ("dictmap", self.kind, self.params, self.operand.key())
+
+    def apply_host(self, s: str) -> str:
+        if self.kind == "substring":
+            start, length = self.params
+            i = start - 1  # SQL is 1-based
+            return s[i:i + length] if length is not None else s[i:]
+        if self.kind == "upper":
+            return s.upper()
+        if self.kind == "lower":
+            return s.lower()
+        raise ValueError(self.kind)
+
+
+@_frozen
 class StrPredicate(Expr):
     """String predicate evaluated on the host dictionary → device LUT.
     kind: contains | startswith | endswith | match | eq_any | lower_eq"""
@@ -242,6 +268,8 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
         return dt.DATE if e.field == "date" else dt.INT64
     if isinstance(e, (IsIn, StrPredicate)):
         return dt.BOOL
+    if isinstance(e, DictMap):
+        return dt.STRING
     if isinstance(e, RowUDF):
         if e.out_dtype is not None:
             return e.out_dtype
@@ -284,7 +312,7 @@ def expr_columns(e: Expr) -> set:
         if e.operand is not None:
             return expr_columns(e.operand)
         return {"*"}  # may touch any column — disables pruning above it
-    if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate)):
+    if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate, DictMap)):
         return expr_columns(e.operand)
     if isinstance(e, Where):
         return (expr_columns(e.cond) | expr_columns(e.iftrue)
@@ -335,6 +363,9 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
         return d.astype(e.to.numpy), v
     if isinstance(e, DtField):
         d, v = eval_expr(e.operand, tree, dicts, schema)
+        if infer_dtype(e.operand, schema) is dt.DATE:
+            # DATE stores days; field kernels expect ns ticks
+            d = d.astype(jnp.int64) * dtops.NS_PER_DAY
         return dtops.FIELDS[e.field](d), v
     if isinstance(e, UnOp):
         if e.op in ("isna", "notna"):
@@ -395,11 +426,18 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
         return out, valid
     if isinstance(e, StrPredicate):
         col = e.operand
+        transforms = []
+        while isinstance(col, DictMap):  # compose host transforms
+            transforms.append(col)
+            col = col.operand
         if not isinstance(col, ColRef):
             raise TypeError("string predicates must apply to a column")
         dic = dicts.get(col.name)
         if dic is None:
             raise TypeError(f"column {col.name} has no dictionary")
+        if transforms:
+            for tr in reversed(transforms):
+                dic = [tr.apply_host(s) for s in dic]
         lut = np.zeros(max(len(dic), 1), dtype=bool)
         pats = [p for p in e.pattern]
         for i, s in enumerate(dic):
@@ -451,6 +489,11 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
         rd, rv = eval_expr(e.right, tree, dicts, schema)
         lt = infer_dtype(e.left, schema)
         rt = infer_dtype(e.right, schema)
+        # DATE (days) vs DATETIME (ns) physical coercion
+        if lt is dt.DATE and rt is dt.DATETIME:
+            ld = ld.astype(jnp.int64) * dtops.NS_PER_DAY
+        elif lt is dt.DATETIME and rt is dt.DATE:
+            rd = rd.astype(jnp.int64) * dtops.NS_PER_DAY
         if lt is dt.STRING or rt is dt.STRING:
             raise TypeError(
                 "string comparison must be rewritten to dict codes by the "

@@ -18,8 +18,8 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence, Set
 
 from bodo_tpu.plan import logical as L
-from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DtField, Expr, IsIn,
-                                Lit, RowUDF, StrPredicate, UnOp, Where,
+from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DictMap, DtField, Expr,
+                                IsIn, Lit, RowUDF, StrPredicate, UnOp, Where,
                                 expr_columns)
 
 
@@ -55,6 +55,8 @@ def _substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
         if e.operand is None:
             raise TypeError("row-mode UDF cannot be substituted")
         return RowUDF(e.func, e.out_dtype, _substitute(e.operand, mapping))
+    if isinstance(e, DictMap):
+        return DictMap(e.kind, e.params, _substitute(e.operand, mapping))
     if isinstance(e, Where):
         return Where(_substitute(e.cond, mapping),
                      _substitute(e.iftrue, mapping),
@@ -102,6 +104,8 @@ def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
     if isinstance(node, (L.ReadParquet, L.ReadCsv)):
         if required is not None and set(node.schema) - required:
             cols = [n for n in node.schema if n in required]
+            if not cols:  # keep one column — row counts need a spine
+                cols = [next(iter(node.schema))]
             if isinstance(node, L.ReadParquet):
                 return L.ReadParquet(node.path, cols)
             return L.ReadCsv(node.path, cols, node.parse_dates,
@@ -110,12 +114,16 @@ def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
     if isinstance(node, L.FromPandas):
         if required is not None and set(node.schema) - required:
             cols = [n for n in node.schema if n in required]
+            if not cols:
+                cols = [next(iter(node.schema))]
             pruned = L.FromPandas(node.table.select(cols))
             return pruned
         return node
     if isinstance(node, L.Projection):
         exprs = node.exprs if required is None else \
             [(n, e) for n, e in node.exprs if n in required]
+        if not exprs:  # keep a spine column for row counts
+            exprs = node.exprs[:1]
         need = set()
         for _, e in exprs:
             need |= expr_columns(e)

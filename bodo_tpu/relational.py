@@ -78,30 +78,75 @@ def _sig(t: Table) -> Tuple:
 # ---------------------------------------------------------------------------
 
 def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
-    """Add/replace columns computed from expressions (df.assign analogue)."""
+    """Add/replace columns computed from expressions (df.assign analogue).
+
+    Top-level DictMap expressions (string→string transforms) are handled
+    host-side: the translation runs on the dictionary, the device only
+    remaps codes."""
+    from bodo_tpu.plan.expr import ColRef, DictMap
+    dictmaps = {n: e for n, e in new.items() if isinstance(e, DictMap)}
+    new = {n: e for n, e in new.items() if n not in dictmaps}
+    dm_cols: Dict[str, Column] = {}
+    for n, e in dictmaps.items():
+        # compose nested transforms (upper(substring(...))) down to the
+        # base column, mirroring the StrPredicate eval path
+        chain = [e]
+        base = e.operand
+        while isinstance(base, DictMap):
+            chain.append(base)
+            base = base.operand
+        if not isinstance(base, ColRef):
+            raise TypeError("DictMap must apply to a string column")
+        src = t.columns[base.name]
+        old_dict = src.dictionary if src.dictionary is not None else \
+            np.array([], dtype=str)
+        vals = list(old_dict)
+        for tr in reversed(chain):
+            vals = [tr.apply_host(s) for s in vals]
+        mapped = np.array(vals, dtype=str)
+        nd, remap = (np.unique(mapped, return_inverse=True)
+                     if len(mapped) else (mapped, np.zeros(0, np.int64)))
+        mp = jnp.asarray(remap.astype(np.int32) if len(remap)
+                         else np.zeros(1, np.int32))
+        codes = mp[jnp.clip(src.data, 0, max(len(old_dict) - 1, 0))]
+        dm_cols[n] = Column(codes, src.valid, dt.STRING, nd)
+
     schema = _schema(t)
     dicts = _dicts(t)
-    key = ("assign", _sig(t), tuple((n, e.key()) for n, e in new.items()),
-           t.distribution)
-    fn = _jit_cache.get(key)
-    if fn is None:
-        exprs = dict(new)
+    if new:
+        key = ("assign", _sig(t), tuple((n, e.key()) for n, e in new.items()),
+               t.distribution)
+        fn = _jit_cache.get(key)
+        if fn is None:
+            exprs = dict(new)
 
-        @jax.jit
-        def fn(tree):
-            out = dict(tree)
-            for name, e in exprs.items():
-                out[name] = eval_expr(e, tree, dicts, schema)
-            return out
-        _jit_cache[key] = fn
-    out_tree = fn(t.device_data())
-    dtypes = {n: infer_dtype(e, schema) for n, e in new.items()}
-    res = t.with_device_data(out_tree, dtypes=dtypes)
-    # expression outputs that are plain numerics drop any stale dictionary
-    for n in new:
-        if res.columns[n].dtype is not dt.STRING:
-            res.columns[n] = Column(res.columns[n].data, res.columns[n].valid,
-                                    res.columns[n].dtype, None)
+            @jax.jit
+            def fn(tree):
+                out = dict(tree)
+                cap = next(iter(tree.values()))[0].shape[0]
+                for name, e in exprs.items():
+                    d, v = eval_expr(e, tree, dicts, schema)
+                    if d.ndim == 0:  # literal projection → broadcast
+                        d = jnp.broadcast_to(d, (cap,))
+                    out[name] = (d, v)
+                return out
+            _jit_cache[key] = fn
+        out_tree = fn(t.device_data())
+        dtypes = {n: infer_dtype(e, schema) for n, e in new.items()}
+        res = t.with_device_data(out_tree, dtypes=dtypes)
+        # dictionary propagation: renames keep the source dictionary,
+        # numeric outputs drop stale dictionaries
+        for n, e in new.items():
+            c = res.columns[n]
+            if c.dtype is dt.STRING and isinstance(e, ColRef):
+                res.columns[n] = Column(c.data, c.valid, c.dtype,
+                                        t.columns[e.name].dictionary)
+            elif c.dtype is not dt.STRING:
+                res.columns[n] = Column(c.data, c.valid, c.dtype, None)
+    else:
+        res = t.with_columns(t.columns)
+    for n, c in dm_cols.items():
+        res.columns[n] = c
     return res
 
 
@@ -472,7 +517,8 @@ def _join_broadcast(left, right, left_on, right_on, how, suffixes) -> Table:
 # whole-column reductions
 # ---------------------------------------------------------------------------
 
-_REDUCE_PARTIALS = {"sum": ("sum",), "count": ("count",), "size": ("size",),
+_REDUCE_PARTIALS = {"sum": ("sum",), "sumnull": ("sum", "count"),
+                    "count": ("count",), "size": ("size",),
                     "min": ("min", "count"), "max": ("max", "count"),
                     "mean": ("sum", "count"),
                     "var": ("sum", "sumsq", "count"),
@@ -563,6 +609,8 @@ def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
         cnt = int(block["count"].sum()) if "count" in block else None
         if op == "sum":
             v = block["sum"].sum()
+        elif op == "sumnull":
+            v = block["sum"].sum() if cnt else np.nan
         elif op == "prod":
             v = np.prod(block["prod"])
         elif op in ("count", "size"):
@@ -605,8 +653,8 @@ def _reduce_scalar(v, op: str, src: dt.DType, cnt: Optional[int]):
         if src.kind == "b":
             return bool(v)
         return float(v)
-    if op in ("sum", "prod") and src.kind in ("i", "u", "b"):
-        return int(v)
+    if op in ("sum", "sumnull", "prod") and src.kind in ("i", "u", "b"):
+        return int(v) if not (isinstance(v, float) and np.isnan(v)) else v
     return float(v)
 
 

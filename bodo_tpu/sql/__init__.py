@@ -1,0 +1,55 @@
+"""SQL surface: BodoSQLContext analogue (reference
+BodoSQL/bodosql/context.py:111 BodoSQLContext, :504 sql())."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import pandas as pd
+
+from bodo_tpu.plan import logical as L
+from bodo_tpu.sql.parser import parse_sql
+from bodo_tpu.sql.planner import Planner
+
+__all__ = ["BodoSQLContext"]
+
+
+class BodoSQLContext:
+    """Register tables (pandas frames, lazy frames, or parquet paths) and
+    run SQL against them. Queries lower to the same logical plan /
+    executor as the dataframe frontend."""
+
+    def __init__(self, tables: Optional[Dict] = None):
+        self._tables: Dict[str, L.Node] = {}
+        for name, t in (tables or {}).items():
+            self.add_table(name, t)
+
+    def add_table(self, name: str, table) -> None:
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        if isinstance(table, BodoDataFrame):
+            self._tables[name] = table._plan
+        elif isinstance(table, pd.DataFrame):
+            self._tables[name] = L.FromPandas(table)
+        elif isinstance(table, str):
+            self._tables[name] = L.ReadParquet(table)
+        elif isinstance(table, L.Node):
+            self._tables[name] = table
+        else:
+            raise TypeError(f"cannot register table {name}: {type(table)}")
+
+    def remove_table(self, name: str) -> None:
+        del self._tables[name]
+
+    def sql(self, query: str):
+        """Plan + execute; returns a lazy BodoDataFrame."""
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        ast = parse_sql(query)
+        plan, names = Planner(self._tables).plan(ast)
+        return BodoDataFrame(plan)
+
+    def generate_plan(self, query: str):
+        """Return the optimized logical plan (EXPLAIN analogue)."""
+        from bodo_tpu.plan.optimizer import optimize
+        ast = parse_sql(query)
+        plan, _ = Planner(self._tables).plan(ast)
+        return optimize(plan)

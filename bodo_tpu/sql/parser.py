@@ -1,0 +1,618 @@
+"""SQL tokenizer + recursive-descent parser → AST.
+
+Replaces the reference's JVM Calcite parser (BodoSQL/calcite_sql/,
+RelationalAlgebraGenerator.java:31) with a self-contained Python parser
+covering the analytical SQL core: SELECT [DISTINCT], FROM with aliases,
+subqueries and CTEs (WITH), INNER/LEFT/RIGHT/CROSS JOIN ... ON, WHERE,
+GROUP BY, HAVING, ORDER BY [ASC|DESC] [NULLS LAST], LIMIT, CASE WHEN,
+BETWEEN, IN (list|subquery), EXISTS, LIKE, IS [NOT] NULL, CAST, EXTRACT,
+DATE/INTERVAL literals, and the standard operator precedence chain.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Select:
+    projections: List[Tuple[Any, Optional[str]]]  # (expr, alias)
+    from_item: Any = None
+    where: Any = None
+    group_by: List[Any] = field(default_factory=list)
+    having: Any = None
+    order_by: List[Tuple[Any, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: List[Tuple[str, "Select"]] = field(default_factory=list)
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubSelect:
+    select: Select
+    alias: str
+
+
+@dataclass
+class JoinItem:
+    left: Any
+    right: Any
+    kind: str          # inner/left/right/cross
+    on: Any = None
+
+
+@dataclass
+class Col:
+    name: str
+    qualifier: Optional[str] = None
+
+
+@dataclass
+class Num:
+    value: Any
+
+
+@dataclass
+class Str:
+    value: str
+
+
+@dataclass
+class DateLit:
+    value: str
+
+
+@dataclass
+class IntervalLit:
+    value: int
+    unit: str          # year/month/day/hour/minute/second
+
+
+@dataclass
+class BinA:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass
+class UnA:
+    op: str            # not / neg / isnull / notnull
+    operand: Any
+
+
+@dataclass
+class Func:
+    name: str
+    args: List[Any]
+    distinct: bool = False
+    star: bool = False  # count(*)
+
+
+@dataclass
+class Case:
+    whens: List[Tuple[Any, Any]]
+    else_: Any = None
+
+
+@dataclass
+class CastA:
+    operand: Any
+    to: str
+
+
+@dataclass
+class InList:
+    operand: Any
+    values: List[Any]
+    negated: bool = False
+
+
+@dataclass
+class InSelect:
+    operand: Any
+    select: Select
+    negated: bool = False
+
+
+@dataclass
+class Exists:
+    select: Select
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery:
+    select: Select
+
+
+@dataclass
+class Between:
+    operand: Any
+    lo: Any
+    hi: Any
+    negated: bool = False
+
+
+@dataclass
+class Like:
+    operand: Any
+    pattern: str
+    negated: bool = False
+
+
+@dataclass
+class Extract:
+    field: str
+    operand: Any
+
+
+@dataclass
+class SubstringA:
+    operand: Any
+    start: int
+    length: Optional[int]
+
+
+@dataclass
+class StarA:
+    qualifier: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*\n?)
+  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qid>"[^"]+")
+  | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+""", re.VERBOSE)
+
+
+def tokenize(sql: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SyntaxError(f"bad SQL at: {sql[pos:pos+30]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        if kind == "id":
+            out.append(("kw" if text.upper() in _KEYWORDS else "id", text))
+        elif kind == "qid":
+            out.append(("id", text[1:-1]))
+        elif kind == "str":
+            out.append(("str", text[1:-1].replace("''", "'")))
+        else:
+            out.append((kind, text))
+    out.append(("eof", ""))
+    return out
+
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "AS", "AND", "OR", "NOT", "IN", "IS", "NULL", "LIKE", "BETWEEN",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "ON",
+    "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "DISTINCT", "EXISTS",
+    "ASC", "DESC", "DATE", "INTERVAL", "EXTRACT", "WITH", "UNION", "ALL",
+    "SUBSTRING", "FOR", "NULLS", "FIRST", "LAST", "TRUE", "FALSE",
+}
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+    def peek(self, k: int = 0):
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def kw(self, *words) -> bool:
+        t, v = self.peek()
+        return t == "kw" and v.upper() in words
+
+    def eat_kw(self, *words) -> str:
+        if not self.kw(*words):
+            raise SyntaxError(f"expected {words}, got {self.peek()}")
+        v = self.toks[self.i][1].upper()
+        self.i += 1
+        return v
+
+    def try_kw(self, *words) -> bool:
+        if self.kw(*words):
+            self.i += 1
+            return True
+        return False
+
+    def eat_op(self, op: str):
+        t, v = self.peek()
+        if t != "op" or v != op:
+            raise SyntaxError(f"expected {op!r}, got {self.peek()}")
+        self.i += 1
+
+    def try_op(self, op: str) -> bool:
+        t, v = self.peek()
+        if t == "op" and v == op:
+            self.i += 1
+            return True
+        return False
+
+    def ident(self) -> str:
+        t, v = self.peek()
+        if t != "id":
+            raise SyntaxError(f"expected identifier, got {self.peek()}")
+        self.i += 1
+        return v
+
+    # -- grammar ----------------------------------------------------------
+    def parse(self) -> Select:
+        sel = self.select_stmt()
+        self.try_op(";")
+        t, _ = self.peek()
+        if t != "eof":
+            raise SyntaxError(f"trailing tokens at {self.peek()}")
+        return sel
+
+    def select_stmt(self) -> Select:
+        ctes = []
+        if self.try_kw("WITH"):
+            while True:
+                name = self.ident()
+                self.eat_kw("AS")
+                self.eat_op("(")
+                ctes.append((name, self.select_stmt()))
+                self.eat_op(")")
+                if not self.try_op(","):
+                    break
+        sel = self.select_core()
+        sel.ctes = ctes
+        return sel
+
+    def select_core(self) -> Select:
+        self.eat_kw("SELECT")
+        distinct = self.try_kw("DISTINCT")
+        projs = []
+        while True:
+            if self.try_op("*"):
+                projs.append((StarA(), None))
+            elif self.peek()[0] == "id" and self.peek(1)[1] == "." and \
+                    self.peek(2)[1] == "*":
+                q = self.ident()
+                self.eat_op(".")
+                self.eat_op("*")
+                projs.append((StarA(q), None))
+            else:
+                e = self.expr()
+                alias = None
+                if self.try_kw("AS"):
+                    alias = self.ident()
+                elif self.peek()[0] == "id":
+                    alias = self.ident()
+                projs.append((e, alias))
+            if not self.try_op(","):
+                break
+        sel = Select(projections=projs, distinct=distinct)
+        if self.try_kw("FROM"):
+            sel.from_item = self.from_clause()
+        if self.try_kw("WHERE"):
+            sel.where = self.expr()
+        if self.kw("GROUP"):
+            self.eat_kw("GROUP")
+            self.eat_kw("BY")
+            while True:
+                sel.group_by.append(self.expr())
+                if not self.try_op(","):
+                    break
+        if self.try_kw("HAVING"):
+            sel.having = self.expr()
+        if self.kw("ORDER"):
+            self.eat_kw("ORDER")
+            self.eat_kw("BY")
+            while True:
+                e = self.expr()
+                asc = True
+                if self.try_kw("DESC"):
+                    asc = False
+                else:
+                    self.try_kw("ASC")
+                if self.try_kw("NULLS"):
+                    self.eat_kw("FIRST", "LAST")
+                sel.order_by.append((e, asc))
+                if not self.try_op(","):
+                    break
+        if self.try_kw("LIMIT"):
+            t, v = self.peek()
+            if t != "num":
+                raise SyntaxError("LIMIT expects a number")
+            self.i += 1
+            sel.limit = int(v)
+        return sel
+
+    def from_clause(self):
+        item = self.table_item()
+        while True:
+            if self.try_op(","):
+                right = self.table_item()
+                item = JoinItem(item, right, "cross")
+            elif self.kw("JOIN", "INNER", "LEFT", "RIGHT", "CROSS", "FULL"):
+                kind = "inner"
+                if self.try_kw("INNER"):
+                    pass
+                elif self.try_kw("LEFT"):
+                    self.try_kw("OUTER")
+                    kind = "left"
+                elif self.try_kw("RIGHT"):
+                    self.try_kw("OUTER")
+                    kind = "right"
+                elif self.try_kw("CROSS"):
+                    kind = "cross"
+                elif self.try_kw("FULL"):
+                    raise NotImplementedError("FULL OUTER JOIN")
+                self.eat_kw("JOIN")
+                right = self.table_item()
+                on = None
+                if kind != "cross":
+                    self.eat_kw("ON")
+                    on = self.expr()
+                item = JoinItem(item, right, kind, on)
+            else:
+                return item
+
+    def table_item(self):
+        if self.try_op("("):
+            sub = self.select_stmt()
+            self.eat_op(")")
+            self.try_kw("AS")
+            alias = self.ident()
+            return SubSelect(sub, alias)
+        name = self.ident()
+        alias = None
+        if self.try_kw("AS"):
+            alias = self.ident()
+        elif self.peek()[0] == "id":
+            alias = self.ident()
+        return TableRef(name, alias)
+
+    # -- expressions (precedence climbing) --------------------------------
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        e = self.and_expr()
+        while self.try_kw("OR"):
+            e = BinA("|", e, self.and_expr())
+        return e
+
+    def and_expr(self):
+        e = self.not_expr()
+        while self.try_kw("AND"):
+            e = BinA("&", e, self.not_expr())
+        return e
+
+    def not_expr(self):
+        if self.try_kw("NOT"):
+            return UnA("not", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self):
+        e = self.add_expr()
+        while True:
+            t, v = self.peek()
+            if t == "op" and v in ("=", "<>", "!=", "<", "<=", ">", ">="):
+                self.i += 1
+                op = {"=": "==", "<>": "!="}.get(v, v)
+                e = BinA(op, e, self.add_expr())
+            elif self.kw("IS"):
+                self.eat_kw("IS")
+                neg = self.try_kw("NOT")
+                self.eat_kw("NULL")
+                e = UnA("notnull" if neg else "isnull", e)
+            elif self.kw("BETWEEN") or (self.kw("NOT") and
+                                        self.peek(1)[1].upper() == "BETWEEN"):
+                neg = self.try_kw("NOT")
+                self.eat_kw("BETWEEN")
+                lo = self.add_expr()
+                self.eat_kw("AND")
+                hi = self.add_expr()
+                e = Between(e, lo, hi, neg)
+            elif self.kw("IN") or (self.kw("NOT") and
+                                   self.peek(1)[1].upper() == "IN"):
+                neg = self.try_kw("NOT")
+                self.eat_kw("IN")
+                self.eat_op("(")
+                if self.kw("SELECT", "WITH"):
+                    sub = self.select_stmt()
+                    self.eat_op(")")
+                    e = InSelect(e, sub, neg)
+                else:
+                    vals = [self.expr()]
+                    while self.try_op(","):
+                        vals.append(self.expr())
+                    self.eat_op(")")
+                    e = InList(e, vals, neg)
+            elif self.kw("LIKE") or (self.kw("NOT") and
+                                     self.peek(1)[1].upper() == "LIKE"):
+                neg = self.try_kw("NOT")
+                self.eat_kw("LIKE")
+                t2, v2 = self.peek()
+                if t2 != "str":
+                    raise SyntaxError("LIKE expects a string literal")
+                self.i += 1
+                e = Like(e, v2, neg)
+            else:
+                return e
+
+    def add_expr(self):
+        e = self.mul_expr()
+        while True:
+            t, v = self.peek()
+            if t == "op" and v in ("+", "-"):
+                self.i += 1
+                e = BinA(v, e, self.mul_expr())
+            elif t == "op" and v == "||":
+                raise NotImplementedError("string concat ||")
+            else:
+                return e
+
+    def mul_expr(self):
+        e = self.unary_expr()
+        while True:
+            t, v = self.peek()
+            if t == "op" and v in ("*", "/", "%"):
+                self.i += 1
+                e = BinA(v, e, self.unary_expr())
+            else:
+                return e
+
+    def unary_expr(self):
+        t, v = self.peek()
+        if t == "op" and v == "-":
+            self.i += 1
+            return UnA("neg", self.unary_expr())
+        if t == "op" and v == "+":
+            self.i += 1
+            return self.unary_expr()
+        return self.primary()
+
+    def primary(self):
+        t, v = self.peek()
+        if t == "op" and v == "(":
+            self.i += 1
+            if self.kw("SELECT", "WITH"):
+                sub = self.select_stmt()
+                self.eat_op(")")
+                return ScalarSubquery(sub)
+            e = self.expr()
+            self.eat_op(")")
+            return e
+        if t == "num":
+            self.i += 1
+            return Num(float(v) if "." in v else int(v))
+        if t == "str":
+            self.i += 1
+            return Str(v)
+        if self.kw("TRUE"):
+            self.i += 1
+            return Num(True)
+        if self.kw("FALSE"):
+            self.i += 1
+            return Num(False)
+        if self.kw("NULL"):
+            self.i += 1
+            return Num(None)
+        if self.kw("DATE"):
+            self.i += 1
+            t2, v2 = self.peek()
+            if t2 != "str":
+                raise SyntaxError("DATE expects a string literal")
+            self.i += 1
+            return DateLit(v2)
+        if self.kw("INTERVAL"):
+            self.i += 1
+            t2, v2 = self.peek()
+            if t2 != "str":
+                raise SyntaxError("INTERVAL expects a quoted quantity")
+            self.i += 1
+            unit = self.ident().lower().rstrip("s")
+            return IntervalLit(int(v2), unit)
+        if self.kw("CASE"):
+            self.i += 1
+            whens = []
+            else_ = None
+            while self.try_kw("WHEN"):
+                c = self.expr()
+                self.eat_kw("THEN")
+                whens.append((c, self.expr()))
+            if self.try_kw("ELSE"):
+                else_ = self.expr()
+            self.eat_kw("END")
+            return Case(whens, else_)
+        if self.kw("CAST"):
+            self.i += 1
+            self.eat_op("(")
+            e = self.expr()
+            self.eat_kw("AS")
+            ty = self.ident()
+            # swallow precision args e.g. DECIMAL(12,2)
+            if self.try_op("("):
+                while not self.try_op(")"):
+                    self.i += 1
+            self.eat_op(")")
+            return CastA(e, ty.lower())
+        if self.kw("EXTRACT"):
+            self.i += 1
+            self.eat_op("(")
+            fld = self.ident().lower()
+            self.eat_kw("FROM")
+            e = self.expr()
+            self.eat_op(")")
+            return Extract(fld, e)
+        if self.kw("SUBSTRING"):
+            self.i += 1
+            self.eat_op("(")
+            e = self.expr()
+            if not self.try_kw("FROM"):
+                self.eat_op(",")
+            start = self.expr()
+            length = None
+            if self.try_kw("FOR") or self.try_op(","):
+                length = self.expr()
+            self.eat_op(")")
+            if not isinstance(start, Num) or (
+                    length is not None and not isinstance(length, Num)):
+                raise NotImplementedError("non-constant substring bounds")
+            return SubstringA(e, int(start.value),
+                              int(length.value) if length else None)
+        if self.kw("EXISTS"):
+            self.i += 1
+            self.eat_op("(")
+            sub = self.select_stmt()
+            self.eat_op(")")
+            return Exists(sub)
+        if t == "id":
+            name = self.ident()
+            if self.try_op("("):           # function call
+                if self.try_op("*"):
+                    self.eat_op(")")
+                    return Func(name.lower(), [], star=True)
+                distinct = self.try_kw("DISTINCT")
+                args = []
+                if not self.try_op(")"):
+                    args.append(self.expr())
+                    while self.try_op(","):
+                        args.append(self.expr())
+                    self.eat_op(")")
+                return Func(name.lower(), args, distinct=distinct)
+            if self.try_op("."):
+                col = self.ident()
+                return Col(col, qualifier=name)
+            return Col(name)
+        raise SyntaxError(f"unexpected token {self.peek()}")
+
+
+def parse_sql(sql: str) -> Select:
+    return Parser(sql).parse()

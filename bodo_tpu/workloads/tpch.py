@@ -1,0 +1,444 @@
+"""TPC-H workload: schema-faithful data generator + the 22 queries.
+
+Mirrors the reference's TPC-H harnesses (reference: benchmarks/tpch/,
+e2e-tests/tpch/ — per-query files q1.py..q22.py). The generator produces
+referentially consistent tables at a row-scale factor; queries are the
+standard TPC-H texts (spec is public) with scale-appropriate parameters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+           "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+           "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+           "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+           "UNITED KINGDOM", "UNITED STATES"]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATION_REGION = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0, 0, 1, 2,
+                 3, 4, 2, 3, 3, 1]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+TYPES = [f"{a} {b} {c}" for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE",
+                                  "ECONOMY", "PROMO")
+         for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED")
+         for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+CONTAINERS = [f"{a} {b}" for a in ("SM", "LG", "MED", "JUMBO", "WRAP")
+              for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN",
+                        "DRUM")]
+
+
+def gen_tpch(n_orders: int = 1500, seed: int = 0):
+    """Generate a consistent TPC-H dataset (~n_orders orders; lineitem is
+    ~4x that). Row counts scale like the spec's relative sizes."""
+    r = np.random.default_rng(seed)
+    n_cust = max(10, n_orders // 10)
+    n_part = max(20, n_orders // 5)
+    n_supp = max(5, n_orders // 100)
+
+    region = pd.DataFrame({
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS,
+        "r_comment": [f"region {i}" for i in range(5)],
+    })
+    nation = pd.DataFrame({
+        "n_nationkey": np.arange(len(NATIONS), dtype=np.int64),
+        "n_name": NATIONS,
+        "n_regionkey": np.asarray(NATION_REGION, dtype=np.int64),
+        "n_comment": [f"nation {i}" for i in range(len(NATIONS))],
+    })
+    supplier = pd.DataFrame({
+        "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_name": [f"Supplier#{i:09d}" for i in range(n_supp)],
+        "s_address": [f"addr{i}" for i in range(n_supp)],
+        "s_nationkey": r.integers(0, len(NATIONS), n_supp),
+        "s_phone": [f"{r.integers(10, 35)}-{i:07d}" for i in range(n_supp)],
+        "s_acctbal": np.round(r.uniform(-999, 9999, n_supp), 2),
+        "s_comment": r.choice(["reliable", "slow Customer Complaints",
+                               "quick", "steady"], n_supp),
+    })
+    part = pd.DataFrame({
+        "p_partkey": np.arange(n_part, dtype=np.int64),
+        "p_name": [f"{r.choice(['green','blue','red','ivory','misty'])} "
+                   f"{r.choice(['almond','tomato','salmon','olive'])} part{i}"
+                   for i in range(n_part)],
+        "p_mfgr": [f"Manufacturer#{r.integers(1, 6)}" for _ in range(n_part)],
+        "p_brand": [f"Brand#{r.integers(1, 6)}{r.integers(1, 6)}"
+                    for _ in range(n_part)],
+        "p_type": r.choice(TYPES, n_part),
+        "p_size": r.integers(1, 51, n_part),
+        "p_container": r.choice(CONTAINERS, n_part),
+        "p_retailprice": np.round(r.uniform(900, 2000, n_part), 2),
+        "p_comment": [f"part comment {i}" for i in range(n_part)],
+    })
+    n_ps = n_part * 4
+    partsupp = pd.DataFrame({
+        "ps_partkey": np.repeat(np.arange(n_part, dtype=np.int64), 4),
+        "ps_suppkey": r.integers(0, n_supp, n_ps),
+        "ps_availqty": r.integers(1, 10000, n_ps),
+        "ps_supplycost": np.round(r.uniform(1, 1000, n_ps), 2),
+        "ps_comment": [f"ps comment {i}" for i in range(n_ps)],
+    }).drop_duplicates(["ps_partkey", "ps_suppkey"]).reset_index(drop=True)
+    customer = pd.DataFrame({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_name": [f"Customer#{i:09d}" for i in range(n_cust)],
+        "c_address": [f"caddr{i}" for i in range(n_cust)],
+        "c_nationkey": r.integers(0, len(NATIONS), n_cust),
+        "c_phone": [f"{r.integers(10, 35)}-{i:07d}" for i in range(n_cust)],
+        "c_acctbal": np.round(r.uniform(-999, 9999, n_cust), 2),
+        "c_mktsegment": r.choice(SEGMENTS, n_cust),
+        "c_comment": [f"customer comment {i}" for i in range(n_cust)],
+    })
+    odate = (np.datetime64("1992-01-01") +
+             r.integers(0, 2405, n_orders).astype("timedelta64[D]"))
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(n_orders, dtype=np.int64),
+        "o_custkey": r.integers(0, n_cust, n_orders),
+        "o_orderstatus": r.choice(["O", "F", "P"], n_orders),
+        "o_totalprice": np.round(r.uniform(850, 500000, n_orders), 2),
+        "o_orderdate": pd.Series(odate.astype("datetime64[ns]")),
+        "o_orderpriority": r.choice(PRIORITIES, n_orders),
+        "o_clerk": [f"Clerk#{r.integers(1, 1000):09d}"
+                    for _ in range(n_orders)],
+        "o_shippriority": np.zeros(n_orders, dtype=np.int64),
+        "o_comment": r.choice(["fast", "slow special requests deposit",
+                               "normal", "special packages requests"],
+                              n_orders),
+    })
+    nl = r.integers(1, 8, n_orders)
+    okeys = np.repeat(orders.o_orderkey.to_numpy(), nl)
+    n_li = len(okeys)
+    ship_delay = r.integers(1, 122, n_li).astype("timedelta64[D]")
+    o_dates = np.repeat(odate, nl)
+    sdate = o_dates + ship_delay
+    cdate = sdate + r.integers(1, 31, n_li).astype("timedelta64[D]")
+    rdate = sdate + r.integers(1, 31, n_li).astype("timedelta64[D]")
+    lineitem = pd.DataFrame({
+        "l_orderkey": okeys,
+        "l_partkey": r.integers(0, n_part, n_li),
+        "l_suppkey": r.integers(0, n_supp, n_li),
+        "l_linenumber": np.concatenate(
+            [np.arange(1, k + 1) for k in nl]).astype(np.int64),
+        "l_quantity": r.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(r.uniform(900, 100000, n_li), 2),
+        "l_discount": np.round(r.uniform(0, 0.10, n_li), 2),
+        "l_tax": np.round(r.uniform(0, 0.08, n_li), 2),
+        "l_returnflag": r.choice(["R", "A", "N"], n_li),
+        "l_linestatus": r.choice(["O", "F"], n_li),
+        "l_shipdate": pd.Series(sdate.astype("datetime64[ns]")),
+        "l_commitdate": pd.Series(cdate.astype("datetime64[ns]")),
+        "l_receiptdate": pd.Series(rdate.astype("datetime64[ns]")),
+        "l_shipinstruct": r.choice(["DELIVER IN PERSON", "COLLECT COD",
+                                    "NONE", "TAKE BACK RETURN"], n_li),
+        "l_shipmode": r.choice(SHIPMODES, n_li),
+        "l_comment": [f"li {i}" for i in range(n_li)],
+    })
+    return {"region": region, "nation": nation, "supplier": supplier,
+            "part": part, "partsupp": partsupp, "customer": customer,
+            "orders": orders, "lineitem": lineitem}
+
+
+# The 22 standard TPC-H queries (spec text, standard parameters).
+QUERIES = {
+1: """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty,
+       avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+""",
+2: """
+select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address, s_phone,
+       s_comment
+from part, supplier, partsupp, nation, region
+where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+  and p_size = 15 and p_type like '%BRASS'
+  and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+  and r_name = 'EUROPE'
+  and ps_supplycost = (
+      select min(ps_supplycost)
+      from partsupp, supplier, nation, region
+      where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+        and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+        and r_name = 'EUROPE')
+order by s_acctbal desc, n_name, s_name, p_partkey
+limit 100
+""",
+3: """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+""",
+4: """
+select o_orderpriority, count(*) as order_count
+from orders
+where o_orderdate >= date '1993-07-01'
+  and o_orderdate < date '1993-07-01' + interval '3' month
+  and exists (select * from lineitem
+              where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
+group by o_orderpriority
+order by o_orderpriority
+""",
+5: """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+  and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+  and r_name = 'ASIA' and o_orderdate >= date '1994-01-01'
+  and o_orderdate < date '1994-01-01' + interval '1' year
+group by n_name
+order by revenue desc
+""",
+6: """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07 and l_quantity < 24
+""",
+7: """
+select supp_nation, cust_nation, l_year, sum(volume) as revenue
+from (select n1.n_name as supp_nation, n2.n_name as cust_nation,
+             extract(year from l_shipdate) as l_year,
+             l_extendedprice * (1 - l_discount) as volume
+      from supplier, lineitem, orders, customer, nation n1, nation n2
+      where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+        and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+        and c_nationkey = n2.n_nationkey
+        and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+             or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+        and l_shipdate between date '1995-01-01' and date '1996-12-31'
+     ) shipping
+group by supp_nation, cust_nation, l_year
+order by supp_nation, cust_nation, l_year
+""",
+8: """
+select o_year,
+       sum(case when nation = 'BRAZIL' then volume else 0 end) / sum(volume)
+         as mkt_share
+from (select extract(year from o_orderdate) as o_year,
+             l_extendedprice * (1 - l_discount) as volume,
+             n2.n_name as nation
+      from part, supplier, lineitem, orders, customer,
+           nation n1, nation n2, region
+      where p_partkey = l_partkey and s_suppkey = l_suppkey
+        and l_orderkey = o_orderkey and o_custkey = c_custkey
+        and c_nationkey = n1.n_nationkey and n1.n_regionkey = r_regionkey
+        and r_name = 'AMERICA' and s_nationkey = n2.n_nationkey
+        and o_orderdate between date '1995-01-01' and date '1996-12-31'
+        and p_type = 'ECONOMY ANODIZED STEEL'
+     ) all_nations
+group by o_year
+order by o_year
+""",
+9: """
+select nation, o_year, sum(amount) as sum_profit
+from (select n_name as nation, extract(year from o_orderdate) as o_year,
+             l_extendedprice * (1 - l_discount)
+               - ps_supplycost * l_quantity as amount
+      from part, supplier, lineitem, partsupp, orders, nation
+      where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+        and ps_partkey = l_partkey and p_partkey = l_partkey
+        and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+        and p_name like '%green%'
+     ) profit
+group by nation, o_year
+order by nation, o_year desc
+""",
+10: """
+select c_custkey, c_name, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       c_acctbal, n_name, c_address, c_phone, c_comment
+from customer, orders, lineitem, nation
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and o_orderdate >= date '1993-10-01'
+  and o_orderdate < date '1993-10-01' + interval '3' month
+  and l_returnflag = 'R' and c_nationkey = n_nationkey
+group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment
+order by revenue desc
+limit 20
+""",
+11: """
+select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+from partsupp, supplier, nation
+where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+  and n_name = 'GERMANY'
+group by ps_partkey
+having sum(ps_supplycost * ps_availqty) > (
+    select sum(ps_supplycost * ps_availqty) * 0.0001
+    from partsupp, supplier, nation
+    where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+      and n_name = 'GERMANY')
+order by value desc
+""",
+12: """
+select l_shipmode,
+       sum(case when o_orderpriority = '1-URGENT'
+                  or o_orderpriority = '2-HIGH' then 1 else 0 end)
+         as high_line_count,
+       sum(case when o_orderpriority <> '1-URGENT'
+                 and o_orderpriority <> '2-HIGH' then 1 else 0 end)
+         as low_line_count
+from orders, lineitem
+where o_orderkey = l_orderkey and l_shipmode in ('MAIL', 'SHIP')
+  and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+  and l_receiptdate >= date '1994-01-01'
+  and l_receiptdate < date '1994-01-01' + interval '1' year
+group by l_shipmode
+order by l_shipmode
+""",
+13: """
+select c_count, count(*) as custdist
+from (select c_custkey, count(o_orderkey) as c_count
+      from customer left outer join orders
+           on c_custkey = o_custkey
+           and o_comment not like '%special%requests%'
+      group by c_custkey
+     ) c_orders
+group by c_count
+order by custdist desc, c_count desc
+""",
+14: """
+select 100.00 * sum(case when p_type like 'PROMO%'
+                         then l_extendedprice * (1 - l_discount)
+                         else 0 end)
+       / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+from lineitem, part
+where l_partkey = p_partkey
+  and l_shipdate >= date '1995-09-01'
+  and l_shipdate < date '1995-09-01' + interval '1' month
+""",
+15: """
+with revenue0 as (
+    select l_suppkey as supplier_no,
+           sum(l_extendedprice * (1 - l_discount)) as total_revenue
+    from lineitem
+    where l_shipdate >= date '1996-01-01'
+      and l_shipdate < date '1996-01-01' + interval '3' month
+    group by l_suppkey)
+select s_suppkey, s_name, s_address, s_phone, total_revenue
+from supplier, revenue0
+where s_suppkey = supplier_no
+  and total_revenue = (select max(total_revenue) from revenue0)
+order by s_suppkey
+""",
+16: """
+select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt
+from partsupp, part
+where p_partkey = ps_partkey and p_brand <> 'Brand#45'
+  and p_type not like 'MEDIUM POLISHED%'
+  and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+  and ps_suppkey not in (select s_suppkey from supplier
+                         where s_comment like '%Customer%Complaints%')
+group by p_brand, p_type, p_size
+order by supplier_cnt desc, p_brand, p_type, p_size
+""",
+17: """
+select sum(l_extendedprice) / 7.0 as avg_yearly
+from lineitem, part
+where p_partkey = l_partkey and p_brand = 'Brand#23'
+  and p_container = 'MED BOX'
+  and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
+                    where l_partkey = p_partkey)
+""",
+18: """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity) as total_qty
+from customer, orders, lineitem
+where o_orderkey in (select l_orderkey from lineitem
+                     group by l_orderkey
+                     having sum(l_quantity) > 150)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100
+""",
+19: """
+select sum(l_extendedprice * (1 - l_discount)) as revenue
+from lineitem, part
+where (p_partkey = l_partkey and p_brand = 'Brand#12'
+       and p_container in ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+       and l_quantity >= 1 and l_quantity <= 11
+       and p_size between 1 and 5
+       and l_shipmode in ('AIR', 'AIR REG')
+       and l_shipinstruct = 'DELIVER IN PERSON')
+   or (p_partkey = l_partkey and p_brand = 'Brand#23'
+       and p_container in ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+       and l_quantity >= 10 and l_quantity <= 20
+       and p_size between 1 and 10
+       and l_shipmode in ('AIR', 'AIR REG')
+       and l_shipinstruct = 'DELIVER IN PERSON')
+   or (p_partkey = l_partkey and p_brand = 'Brand#34'
+       and p_container in ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG')
+       and l_quantity >= 20 and l_quantity <= 30
+       and p_size between 1 and 15
+       and l_shipmode in ('AIR', 'AIR REG')
+       and l_shipinstruct = 'DELIVER IN PERSON')
+""",
+20: """
+select s_name, s_address
+from supplier, nation
+where s_suppkey in (
+    select ps_suppkey from partsupp
+    where ps_partkey in (select p_partkey from part
+                         where p_name like 'green%')
+      and ps_availqty > (select 0.5 * sum(l_quantity) from lineitem
+                         where l_partkey = ps_partkey
+                           and l_suppkey = ps_suppkey
+                           and l_shipdate >= date '1994-01-01'
+                           and l_shipdate < date '1994-01-01'
+                                             + interval '1' year))
+  and s_nationkey = n_nationkey and n_name = 'CANADA'
+order by s_name
+""",
+21: """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+  and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+  and exists (select * from lineitem l2
+              where l2.l_orderkey = l1.l_orderkey
+                and l2.l_suppkey <> l1.l_suppkey)
+  and not exists (select * from lineitem l3
+                  where l3.l_orderkey = l1.l_orderkey
+                    and l3.l_suppkey <> l1.l_suppkey
+                    and l3.l_receiptdate > l3.l_commitdate)
+  and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+""",
+22: """
+select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+from (select substring(c_phone from 1 for 2) as cntrycode, c_acctbal
+      from customer
+      where substring(c_phone from 1 for 2) in
+            ('13', '31', '23', '29', '30', '18', '17')
+        and c_acctbal > (select avg(c_acctbal) from customer
+                         where c_acctbal > 0.00
+                           and substring(c_phone from 1 for 2) in
+                               ('13', '31', '23', '29', '30', '18', '17'))
+        and not exists (select * from orders
+                        where o_custkey = c_custkey)
+     ) custsale
+group by cntrycode
+order by cntrycode
+""",
+}

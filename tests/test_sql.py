@@ -1,0 +1,215 @@
+"""SQL frontend tests — differential vs pandas on generated data."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tables():
+    r = np.random.default_rng(11)
+    n = 600
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(n, dtype=np.int64),
+        "o_custkey": r.integers(0, 50, n),
+        "o_totalprice": np.round(r.uniform(10, 1000, n), 2),
+        "o_orderdate": pd.to_datetime("2023-01-01") +
+        pd.to_timedelta(r.integers(0, 700, n), unit="D"),
+        "o_status": r.choice(["O", "F", "P"], n),
+    })
+    customer = pd.DataFrame({
+        "c_custkey": np.arange(55, dtype=np.int64),
+        "c_name": [f"Customer#{i:05d}" for i in range(55)],
+        "c_nation": r.choice(["FRANCE", "GERMANY", "KENYA", "PERU"], 55),
+        "c_acctbal": np.round(r.uniform(-100, 5000, 55), 2),
+    })
+    return {"orders": orders, "customer": customer}
+
+
+@pytest.fixture(scope="module")
+def ctx(tables):
+    from bodo_tpu.sql import BodoSQLContext
+    return BodoSQLContext(tables)
+
+
+def test_simple_select_where(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select o_orderkey, o_totalprice * 2 as dbl
+        from orders where o_totalprice > 500 and o_status = 'O'
+    """).to_pandas()
+    o = tables["orders"]
+    exp = o[(o.o_totalprice > 500) & (o.o_status == "O")]
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(sorted(got["dbl"]),
+                               sorted(exp["o_totalprice"] * 2))
+
+
+def test_group_by_having_order(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select o_custkey, count(*) as n, sum(o_totalprice) as total,
+               avg(o_totalprice) as av
+        from orders
+        group by o_custkey
+        having count(*) > 3
+        order by total desc
+        limit 10
+    """).to_pandas()
+    o = tables["orders"]
+    exp = (o.groupby("o_custkey").agg(n=("o_orderkey", "size"),
+                                      total=("o_totalprice", "sum"),
+                                      av=("o_totalprice", "mean"))
+           .reset_index().query("n > 3")
+           .sort_values("total", ascending=False).head(10))
+    np.testing.assert_allclose(got["total"], exp["total"], rtol=1e-9)
+    np.testing.assert_array_equal(got["n"], exp["n"])
+
+
+def test_join_and_aliases(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select c.c_nation as nation, sum(o.o_totalprice) as revenue
+        from orders o join customer c on o.o_custkey = c.c_custkey
+        where c.c_acctbal > 0
+        group by c.c_nation
+        order by revenue desc
+    """).to_pandas()
+    o, c = tables["orders"], tables["customer"]
+    exp = (o.merge(c, left_on="o_custkey", right_on="c_custkey")
+           .query("c_acctbal > 0")
+           .groupby("c_nation").agg(revenue=("o_totalprice", "sum"))
+           .reset_index().sort_values("revenue", ascending=False))
+    assert list(got["nation"]) == list(exp["c_nation"])
+    np.testing.assert_allclose(got["revenue"], exp["revenue"], rtol=1e-9)
+
+
+def test_case_when_and_dates(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select sum(case when o_status = 'O' then o_totalprice else 0 end)
+                 as open_rev,
+               count(*) as n
+        from orders
+        where o_orderdate >= date '2023-06-01'
+          and o_orderdate < date '2023-06-01' + interval '6' month
+    """).to_pandas()
+    o = tables["orders"]
+    m = (o.o_orderdate >= "2023-06-01") & (o.o_orderdate < "2023-12-01")
+    exp_rev = o[m & (o.o_status == "O")].o_totalprice.sum()
+    assert np.isclose(got["open_rev"][0], exp_rev)
+    assert got["n"][0] == int(m.sum())
+
+
+def test_extract_and_year_func(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select extract(year from o_orderdate) as y, count(*) as n
+        from orders group by extract(year from o_orderdate) order by y
+    """).to_pandas()
+    exp = tables["orders"].groupby(
+        tables["orders"].o_orderdate.dt.year).size()
+    np.testing.assert_array_equal(got["n"], exp.to_numpy())
+
+
+def test_in_list_like_between(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select count(*) as n from customer
+        where c_nation in ('FRANCE', 'GERMANY')
+          and c_name like 'Customer#0000%'
+          and c_acctbal between 0 and 3000
+    """).to_pandas()
+    c = tables["customer"]
+    exp = c[c.c_nation.isin(["FRANCE", "GERMANY"])
+            & c.c_name.str.startswith("Customer#0000")
+            & c.c_acctbal.between(0, 3000)]
+    assert got["n"][0] == len(exp)
+
+
+def test_in_subquery_semi_join(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select count(*) as n from orders
+        where o_custkey in (select c_custkey from customer
+                            where c_nation = 'FRANCE')
+    """).to_pandas()
+    c, o = tables["customer"], tables["orders"]
+    keys = c[c.c_nation == "FRANCE"].c_custkey
+    assert got["n"][0] == o.o_custkey.isin(keys).sum()
+
+
+def test_not_in_subquery_anti_join(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select count(*) as n from orders
+        where o_custkey not in (select c_custkey from customer
+                                where c_nation = 'FRANCE')
+    """).to_pandas()
+    c, o = tables["customer"], tables["orders"]
+    keys = c[c.c_nation == "FRANCE"].c_custkey
+    assert got["n"][0] == (~o.o_custkey.isin(keys)).sum()
+
+
+def test_scalar_subquery(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select count(*) as n from orders
+        where o_totalprice > (select avg(o_totalprice) from orders)
+    """).to_pandas()
+    o = tables["orders"]
+    assert got["n"][0] == (o.o_totalprice > o.o_totalprice.mean()).sum()
+
+
+def test_correlated_scalar_subquery(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select count(*) as n from orders o1
+        where o_totalprice > (select avg(o_totalprice) from orders o2
+                              where o2.o_custkey = o1.o_custkey)
+    """).to_pandas()
+    o = tables["orders"]
+    avg_per = o.groupby("o_custkey").o_totalprice.transform("mean")
+    assert got["n"][0] == (o.o_totalprice > avg_per).sum()
+
+
+def test_exists_correlated(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select count(*) as n from customer c
+        where exists (select * from orders o
+                      where o.o_custkey = c.c_custkey
+                        and o.o_totalprice > 900)
+    """).to_pandas()
+    c, o = tables["customer"], tables["orders"]
+    keys = o[o.o_totalprice > 900].o_custkey.unique()
+    assert got["n"][0] == c.c_custkey.isin(keys).sum()
+
+
+def test_cte_and_subselect(ctx, tables, mesh8):
+    got = ctx.sql("""
+        with big as (select * from orders where o_totalprice > 500)
+        select nation, n from (
+            select c.c_nation as nation, count(*) as n
+            from big b join customer c on b.o_custkey = c.c_custkey
+            group by c.c_nation
+        ) t
+        order by n desc
+    """).to_pandas()
+    o, c = tables["orders"], tables["customer"]
+    exp = (o[o.o_totalprice > 500]
+           .merge(c, left_on="o_custkey", right_on="c_custkey")
+           .groupby("c_nation").size()
+           .sort_values(ascending=False))
+    np.testing.assert_array_equal(got["n"], exp.to_numpy())
+
+
+def test_distinct_and_substring(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select distinct substring(c_name from 1 for 10) as pref
+        from customer
+    """).to_pandas()
+    exp = tables["customer"].c_name.str[:10].drop_duplicates()
+    assert sorted(got["pref"]) == sorted(exp)
+
+
+def test_syntax_error(ctx, mesh8):
+    with pytest.raises(SyntaxError):
+        ctx.sql("select from where")
+
+
+def test_nested_dictmap_projection(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select distinct upper(substring(c_name from 1 for 8)) as u
+        from customer limit 3
+    """).to_pandas()
+    assert all(s == "CUSTOMER" for s in got["u"])

@@ -1,0 +1,18 @@
+"""JSON (lines) reader — analogue of the reference's JSON connector
+(bodo/io/_csv_json_reader.cpp, bodo/ir/json_ext.py:32)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import pyarrow.json as pajson
+
+from bodo_tpu.io.arrow_bridge import arrow_to_table
+from bodo_tpu.table.table import Table
+
+
+def read_json(path: str, columns: Optional[Sequence[str]] = None) -> Table:
+    at = pajson.read_json(path)
+    if columns:
+        at = at.select(list(columns))
+    return arrow_to_table(at)

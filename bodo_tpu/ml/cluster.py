@@ -1,0 +1,73 @@
+"""KMeans (reference bodo/ml_support/sklearn_cluster_ext.py — per-rank
+sklearn fit + allreduce of centers). Here: jit-compiled Lloyd iterations
+with psum'd center sums/counts over the mesh; k-means++-style seeding via
+farthest-point sampling on a data sample."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.ml._data import to_device_xy
+
+
+@partial(jax.jit, static_argnames=("k", "iters"))
+def _lloyd(X, mask, init, k: int, iters: int):
+    w = mask.astype(X.dtype)
+
+    def step(centers, _):
+        d2 = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)  # [N,k]
+        assign = jnp.argmin(d2, axis=1)
+        oh = jax.nn.one_hot(assign, k, dtype=X.dtype) * w[:, None]
+        sums = oh.T @ X                        # [k,D]
+        cnts = oh.sum(0)                       # [k]
+        new = jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts, 1)[:, None],
+                        centers)
+        return new, None
+
+    centers, _ = jax.lax.scan(step, init, None, length=iters)
+    d2 = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    assign = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * w)
+    return centers, assign, inertia
+
+
+class KMeans:
+    def __init__(self, n_clusters: int = 8, max_iter: int = 50,
+                 random_state: int = 0, n_init: int = 1):
+        self.n_clusters = n_clusters
+        self.max_iter = max_iter
+        self.random_state = random_state
+
+    def fit(self, X):
+        Xd, _, mask, n = to_device_xy(X)
+        host = np.asarray(jax.device_get(Xd))[np.asarray(jax.device_get(mask))]
+        r = np.random.default_rng(self.random_state)
+        # farthest-point seeding on a host sample (cheap, deterministic)
+        sample = host[r.choice(len(host), min(len(host), 1024),
+                               replace=False)]
+        centers = [sample[0]]
+        for _ in range(1, self.n_clusters):
+            d2 = np.min(
+                ((sample[:, None, :] - np.asarray(centers)[None]) ** 2)
+                .sum(-1), axis=1)
+            centers.append(sample[np.argmax(d2)])
+        init = jnp.asarray(np.asarray(centers))
+        c, a, inertia = _lloyd(Xd, mask, init, self.n_clusters,
+                               self.max_iter)
+        self.cluster_centers_ = np.asarray(jax.device_get(c))
+        self.labels_ = np.asarray(jax.device_get(a))[:n]
+        self.inertia_ = float(jax.device_get(inertia))
+        return self
+
+    def predict(self, X):
+        Xd, _, mask, n = to_device_xy(X)
+        d2 = ((np.asarray(jax.device_get(Xd))[:, None, :]
+               - self.cluster_centers_[None]) ** 2).sum(-1)
+        return np.argmin(d2, axis=1)[:n]
+
+    def fit_predict(self, X):
+        return self.fit(X).labels_

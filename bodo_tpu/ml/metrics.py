@@ -1,0 +1,30 @@
+"""Metrics (reference bodo/ml_support/sklearn_metrics_ext.py —
+distributed confusion/r2/mse via allreduce; here host-side over gathered
+predictions, device reductions when inputs are sharded arrays)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bodo_tpu.ml._data import _materialize
+
+
+def _np(v):
+    return np.asarray(_materialize(v)).reshape(-1)
+
+
+def accuracy_score(y_true, y_pred) -> float:
+    a, b = _np(y_true), _np(y_pred)
+    return float((a == b).mean()) if len(a) else 0.0
+
+
+def mean_squared_error(y_true, y_pred) -> float:
+    a, b = _np(y_true).astype(float), _np(y_pred).astype(float)
+    return float(((a - b) ** 2).mean()) if len(a) else 0.0
+
+
+def r2_score(y_true, y_pred) -> float:
+    a, b = _np(y_true).astype(float), _np(y_pred).astype(float)
+    ss_res = ((a - b) ** 2).sum()
+    ss_tot = ((a - a.mean()) ** 2).sum()
+    return float(1.0 - ss_res / ss_tot) if ss_tot > 0 else 0.0

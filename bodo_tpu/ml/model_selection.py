@@ -1,0 +1,28 @@
+"""train_test_split (reference bodo/ml_support/sklearn_model_selection_ext.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bodo_tpu.ml._data import _materialize
+
+
+def train_test_split(*arrays, test_size=0.25, train_size=None,
+                     random_state=None, shuffle=True):
+    mats = [np.asarray(_materialize(a)) for a in arrays]
+    n = len(mats[0])
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(random_state).shuffle(idx)
+    n_test = int(round(n * test_size)) if isinstance(test_size, float) \
+        else int(test_size)
+    test_idx, train_idx = idx[:n_test], idx[n_test:]
+    if train_size is not None:
+        k = int(round(n * train_size)) if isinstance(train_size, float) \
+            else int(train_size)
+        train_idx = train_idx[:k]
+    out = []
+    for m in mats:
+        out.append(m[train_idx])
+        out.append(m[test_idx])
+    return out

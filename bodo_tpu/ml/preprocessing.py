@@ -1,0 +1,59 @@
+"""Preprocessing (reference bodo/ml_support/sklearn_preprocessing_ext.py —
+distributed stats via allreduce)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.ml._data import _materialize, to_device_xy
+
+
+class StandardScaler:
+    def __init__(self, with_mean: bool = True, with_std: bool = True):
+        self.with_mean = with_mean
+        self.with_std = with_std
+
+    def fit(self, X):
+        Xd, _, mask, n = to_device_xy(X)
+        w = mask.astype(Xd.dtype)[:, None]
+        cnt = jnp.maximum(jnp.sum(w), 1)
+        mean = jnp.sum(Xd * w, axis=0) / cnt
+        var = jnp.sum(((Xd - mean) ** 2) * w, axis=0) / cnt
+        self.mean_ = np.asarray(jax.device_get(mean))
+        self.var_ = np.asarray(jax.device_get(var))
+        self.scale_ = np.sqrt(np.where(self.var_ > 0, self.var_, 1.0))
+        self.n_samples_seen_ = n
+        return self
+
+    def transform(self, X):
+        Xh = np.asarray(_materialize(X), dtype=np.float64)
+        if Xh.ndim == 1:
+            Xh = Xh[:, None]
+        out = Xh
+        if self.with_mean:
+            out = out - self.mean_
+        if self.with_std:
+            out = out / self.scale_
+        return out
+
+    def fit_transform(self, X):
+        return self.fit(X).transform(X)
+
+
+class LabelEncoder:
+    def fit(self, y):
+        yv = np.asarray(_materialize(y)).reshape(-1)
+        self.classes_ = np.unique(yv)
+        return self
+
+    def transform(self, y):
+        yv = np.asarray(_materialize(y)).reshape(-1)
+        return np.searchsorted(self.classes_, yv)
+
+    def fit_transform(self, y):
+        return self.fit(y).transform(y)
+
+    def inverse_transform(self, codes):
+        return self.classes_[np.asarray(codes)]

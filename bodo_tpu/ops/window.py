@@ -1,0 +1,191 @@
+"""Window kernels: cumulative ops, rolling windows, shift/diff.
+
+TPU-native replacement for the reference's parallel window machinery
+(bodo/hiframes/rolling.py halo exchange via bodo.libs.parallel_ops,
+bodo/libs/window/*.cpp, dist_cumsum via MPI_Exscan
+bodo/libs/distributed_api.py:2205). Cross-shard state rides collectives:
+cumulative offsets via exscan (all_gather + masked reduce), rolling halos
+via lax.ppermute ring shifts (SURVEY.md §5 long-context analogue — the
+ring-attention-style blockwise pass applied to windowed aggregation).
+
+All kernels are local-block functions taking (x, valid, count) plus the
+cross-shard carry; the shard_map wrapper lives in relational.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bodo_tpu.ops import kernels as K
+
+
+def _ok(x, valid, padmask):
+    return K.value_ok(x, valid, padmask)
+
+
+# ---------------------------------------------------------------------------
+# cumulative ops: local part + carry combine
+# ---------------------------------------------------------------------------
+
+_CUM_NEUTRAL = {"cumsum": 0.0, "cumprod": 1.0,
+                "cummax": -np.inf, "cummin": np.inf}
+
+
+def cum_local(op: str, x, valid, count):
+    """Returns (local result, local carry scalar). Result positions of
+    null rows are NaN (pandas semantics); padding rows are neutral."""
+    cap = x.shape[0]
+    padmask = K.row_mask(count, cap)
+    ok = _ok(x, valid, padmask)
+    xf = x.astype(jnp.float64)
+    if op == "cumsum":
+        base = jnp.where(ok, xf, 0.0)
+        loc = jnp.cumsum(base)
+        carry = loc[-1]
+    elif op == "cumprod":
+        base = jnp.where(ok, xf, 1.0)
+        loc = jnp.cumprod(base)
+        carry = loc[-1]
+    elif op == "cummax":
+        base = jnp.where(ok, xf, -jnp.inf)
+        loc = lax.cummax(base)
+        carry = loc[-1]
+    elif op == "cummin":
+        base = jnp.where(ok, xf, jnp.inf)
+        loc = lax.cummin(base)
+        carry = loc[-1]
+    else:
+        raise ValueError(op)
+    return loc, carry
+
+
+def cum_combine(op: str, loc, carry_prefix):
+    """Apply the exscan'd prefix carry from earlier shards."""
+    if op == "cumsum":
+        return loc + carry_prefix
+    if op == "cumprod":
+        return loc * carry_prefix
+    if op == "cummax":
+        return jnp.maximum(loc, carry_prefix)
+    if op == "cummin":
+        return jnp.minimum(loc, carry_prefix)
+    raise ValueError(op)
+
+
+def cum_carry_exscan(op: str, carry, axis: str):
+    """Exclusive scan of carries over shards (identity for shard 0)."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    gathered = lax.all_gather(carry, axis)          # [S]
+    mask = jnp.arange(n) < idx
+    ident = _CUM_NEUTRAL[op]
+    vals = jnp.where(mask, gathered, ident)
+    if op == "cumsum":
+        return jnp.sum(vals)
+    if op == "cumprod":
+        return jnp.prod(vals)
+    if op == "cummax":
+        return jnp.max(vals)
+    if op == "cummin":
+        return jnp.min(vals)
+    raise ValueError(op)
+
+
+def cum_finalize(op: str, combined, x, valid, count):
+    """NaN at null positions, zeros at padding."""
+    cap = x.shape[0]
+    padmask = K.row_mask(count, cap)
+    ok = _ok(x, valid, padmask)
+    return jnp.where(ok, combined, jnp.where(padmask, jnp.nan, 0.0))
+
+
+# ---------------------------------------------------------------------------
+# rolling windows (fixed window w, min_periods = w — pandas default)
+# ---------------------------------------------------------------------------
+
+def rolling_local(op: str, window: int, x, valid, count, halo_x, halo_ok,
+                  global_offset):
+    """Rolling over the local block with a (window-1)-row halo from the
+    previous shard. halo_x/halo_ok: [window-1] values/validity from the
+    end of the previous shard's real rows; global_offset: number of real
+    rows before this shard (positions < window-1 globally are NaN)."""
+    cap = x.shape[0]
+    w = window
+    padmask = K.row_mask(count, cap)
+    ok = _ok(x, valid, padmask)
+    xf = jnp.where(ok, x.astype(jnp.float64), 0.0)
+    ext = jnp.concatenate([jnp.where(halo_ok, halo_x, 0.0), xf])
+    ext_ok = jnp.concatenate([halo_ok, ok])
+
+    if op in ("sum", "mean"):
+        cs = jnp.cumsum(ext)
+        cs0 = jnp.concatenate([jnp.zeros(1), cs])
+        out = cs0[w:] - cs0[:-w]          # [cap]: sum over ext[i..i+w-1]
+    elif op in ("min", "max"):
+        # sparse-table doubling: O(log w) shifted reductions instead of an
+        # O(w) unroll (which explodes trace size for large windows)
+        ident = jnp.inf if op == "min" else -jnp.inf
+        red = jnp.minimum if op == "min" else jnp.maximum
+        level = jnp.where(ext_ok, ext, ident)
+        span = 1
+        while span * 2 <= w:
+            level = red(level, jnp.concatenate(
+                [level[span:], jnp.full((span,), ident)]))
+            span *= 2
+        # window [i, i+w) = block [i, i+span) ∪ block [i+w-span, i+w)
+        lead = jnp.concatenate([level[w - span:],
+                                jnp.full((w - span,), ident)]) \
+            if w > span else level
+        out = red(level, lead)[:cap]
+    elif op == "count":
+        cs = jnp.cumsum(ext_ok.astype(jnp.float64))
+        cs0 = jnp.concatenate([jnp.zeros(1), cs])
+        out = cs0[w:] - cs0[:-w]
+    else:
+        raise ValueError(op)
+
+    okc = jnp.cumsum(ext_ok.astype(jnp.int64))
+    okc0 = jnp.concatenate([jnp.zeros(1, jnp.int64), okc])
+    nvalid = okc0[w:] - okc0[:-w]
+    if op == "mean":
+        out = out / jnp.maximum(nvalid, 1)
+    gpos = global_offset + jnp.arange(cap)
+    full = (nvalid == w) & (gpos >= w - 1) & padmask
+    if op == "count":
+        # pandas >= 1.3: count obeys min_periods=window like other aggs
+        full_pos = (gpos >= w - 1) & padmask
+        return jnp.where(full_pos, out, jnp.where(padmask, jnp.nan, 0.0))
+    return jnp.where(full, out, jnp.where(padmask, jnp.nan, 0.0))
+
+
+def tail_rows(x, valid, count, k: int):
+    """Last k real rows of the block (for the halo send): values + ok."""
+    cap = x.shape[0]
+    idx = jnp.clip(count - k + jnp.arange(k), 0, cap - 1)
+    have = (count - k + jnp.arange(k)) >= 0
+    padmask = K.row_mask(count, cap)
+    ok = _ok(x, valid, padmask)
+    return (jnp.where(have, x.astype(jnp.float64)[idx], 0.0),
+            have & ok[idx])
+
+
+# ---------------------------------------------------------------------------
+# shift / diff
+# ---------------------------------------------------------------------------
+
+def shift_local(x, valid, count, halo_x, halo_ok, n: int):
+    """Shift by n>0 (from previous rows; halo has the last n rows of the
+    previous shard). Returns (data, ok)."""
+    cap = x.shape[0]
+    padmask = K.row_mask(count, cap)
+    ok = _ok(x, valid, padmask)
+    ext = jnp.concatenate([halo_x, x.astype(jnp.float64)])
+    ext_ok = jnp.concatenate([halo_ok, ok])
+    out = ext[:cap]
+    out_ok = ext_ok[:cap] & padmask
+    return jnp.where(out_ok, out, jnp.nan), out_ok

@@ -147,6 +147,47 @@ class BodoSeries:
         o = other._expr if isinstance(other, BodoSeries) else Lit(other)
         return self._wrap(Where(c, self._expr, o))
 
+    # ---- window / cumulative --------------------------------------------
+    def _window(self, op: str, param=None):
+        if self._dtype.kind not in ("i", "u", "f", "b"):
+            # temporal/string physical reprs would round-trip through
+            # float64 (lossy above 2^53 ns) — use genuine pandas
+            warn_fallback(f"Series.{op}", f"{self._dtype.name} dtype")
+            pds = self.to_pandas()
+            if op.startswith("rolling_"):
+                return getattr(pds.rolling(param), op[len("rolling_"):])()
+            if op in ("shift", "diff"):
+                return getattr(pds, op)(param)
+            return getattr(pds, op)()
+        name = self._name or "_val"
+        base = self._as_projection(name)
+        out = f"__w_{name}"
+        node = L.Window(base, [(name, op, param, out)])
+        return BodoSeries(node, ColRef(out), self._name)
+
+    def cumsum(self): return self._window("cumsum")
+    def cumprod(self): return self._window("cumprod")
+    def cummax(self): return self._window("cummax")
+    def cummin(self): return self._window("cummin")
+
+    def shift(self, periods: int = 1):
+        if periods < 1:
+            warn_fallback("Series.shift", "non-positive periods")
+            return self.to_pandas().shift(periods)
+        return self._window("shift", periods)
+
+    def diff(self, periods: int = 1):
+        if periods < 1:
+            warn_fallback("Series.diff", "non-positive periods")
+            return self.to_pandas().diff(periods)
+        return self._window("diff", periods)
+
+    def rolling(self, window: int, min_periods=None):
+        if min_periods is not None and min_periods != window:
+            warn_fallback("Series.rolling", "min_periods != window")
+            return self.to_pandas().rolling(window, min_periods=min_periods)
+        return _Rolling(self, window)
+
     # ---- accessors ----------------------------------------------------------
     @property
     def dt(self):
@@ -254,6 +295,23 @@ class BodoSeries:
             attr = getattr(self.to_pandas(), name)
             return attr
         raise AttributeError(name)
+
+
+class _Rolling:
+    """Series.rolling(w) — fixed windows, halo-exchange across shards."""
+
+    def __init__(self, s: BodoSeries, window: int):
+        self._s = s
+        self._w = window
+
+    def _agg(self, op):
+        return self._s._window(f"rolling_{op}", self._w)
+
+    def sum(self): return self._agg("sum")
+    def mean(self): return self._agg("mean")
+    def min(self): return self._agg("min")
+    def max(self): return self._agg("max")
+    def count(self): return self._agg("count")
 
 
 class _DtAccessor:

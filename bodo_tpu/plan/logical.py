@@ -179,6 +179,26 @@ class Reduce(Node):
         return ("reduce", self.child.key(), tuple(self.aggs))
 
 
+class Window(Node):
+    """Row-aligned window transforms (cumsum/rolling/shift/diff) —
+    specs = [(col, op, param, outname)]."""
+
+    def __init__(self, child: Node, specs):
+        self.children = [child]
+        self.specs = [tuple(s) for s in specs]
+        sch = dict(child.schema)
+        for col, op, param, out in self.specs:
+            sch[out] = dt.FLOAT64
+        self.schema = sch
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("window", self.child.key(), tuple(self.specs))
+
+
 class Join(Node):
     def __init__(self, left: Node, right: Node, left_on, right_on,
                  how: str = "inner", suffixes=("_x", "_y")):

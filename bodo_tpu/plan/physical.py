@@ -53,7 +53,11 @@ def _exec(node: L.Node) -> Table:
     if hit is not None:
         node._cached = hit
         return hit
-    t = _exec_inner(node)
+    from bodo_tpu.utils import tracing
+    with tracing.event(type(node).__name__) as ev:
+        t = _exec_inner(node)
+        if ev is not None:
+            ev["rows"] = t.nrows
     node._cached = t
     if len(_result_cache) >= _result_cache_limit:
         _result_cache.pop(next(iter(_result_cache)))
@@ -98,6 +102,8 @@ def _exec_inner(node: L.Node) -> Table:
         right = _exec(node.right)
         return R.join_tables(left, right, node.left_on, node.right_on,
                              node.how, node.suffixes)
+    if isinstance(node, L.Window):
+        return R.window_table(_exec(node.child), node.specs)
     if isinstance(node, L.Sort):
         return R.sort_table(_exec(node.child), node.by, node.ascending,
                             node.na_last)

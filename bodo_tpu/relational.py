@@ -514,6 +514,118 @@ def _join_broadcast(left, right, left_on, right_on, how, suffixes) -> Table:
 
 
 # ---------------------------------------------------------------------------
+# window / cumulative / shift
+# ---------------------------------------------------------------------------
+
+def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
+                                                 str]]) -> Table:
+    """Row-aligned window transforms: specs = [(col, op, param, outname)].
+    ops: cumsum/cumprod/cummax/cummin, rolling_{sum,mean,min,max,count}
+    (param = window), shift/diff (param = periods).
+
+    Cross-shard state: cumulative carries exscan over the mesh; rolling
+    and shift halos ride a ppermute ring shift (reference: rolling halo
+    exchange bodo/hiframes/rolling.py, dist_cumsum via MPI_Exscan)."""
+    from bodo_tpu.ops import window as W
+    specs = [(c, op, p, o) for c, op, p, o in specs]
+    # halo limitation: a rolling/shift halo only reaches one shard back;
+    # if any predecessor shard (including empty ones — they forward an
+    # all-invalid halo) holds fewer real rows than the halo needs, run on
+    # the gathered table instead. rolling(w) needs w-1 donor rows,
+    # shift/diff(n) needs n.
+    if t.distribution == ONED and len(t.counts) > 1:
+        halo_need = 0
+        for _, op, p, _ in specs:
+            if op.startswith("rolling_"):
+                halo_need = max(halo_need, int(p) - 1)
+            elif op in ("shift", "diff"):
+                halo_need = max(halo_need, int(p))
+        donor_counts = [int(c) for c in t.counts[:-1]]
+        if halo_need > 0 and donor_counts and \
+                min(donor_counts) < halo_need:
+            res = window_table(t.gather(), specs)
+            return res.shard()
+    names = t.names
+    key = ("window", _mesh_key(mesh_mod.get_mesh()), _sig(t),
+           tuple(specs), t.distribution)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        ax = config.data_axis
+
+        def body(tree, counts, sharded: bool):
+            count = counts[0] if sharded else counts
+            out = {}
+            if sharded:
+                goff = C.dist_exscan_sum(count, ax)
+            else:
+                goff = jnp.asarray(0, jnp.int64)
+            for col, op, param, oname in specs:
+                x, v = tree[col]
+                if op.startswith("cum"):
+                    loc, carry = W.cum_local(op, x, v, count)
+                    if sharded:
+                        prefix = W.cum_carry_exscan(op, carry, ax)
+                        loc = W.cum_combine(op, loc, prefix)
+                    comb = W.cum_finalize(op, loc, x, v, count)
+                    out[oname] = (comb, None)
+                elif op.startswith("rolling_"):
+                    w = int(param)
+                    hx, hok = W.tail_rows(x, v, count, w - 1) if w > 1 else \
+                        (jnp.zeros(0), jnp.zeros(0, bool))
+                    if sharded and w > 1:
+                        hx = C.ring_shift(hx, 1, ax)
+                        hok = C.ring_shift(hok, 1, ax)
+                        hok = hok & (C.rank(ax) != 0)
+                    else:  # single block: no predecessor
+                        hok = jnp.zeros_like(hok)
+                    res = W.rolling_local(op[len("rolling_"):], w, x, v,
+                                          count, hx, hok, goff)
+                    out[oname] = (res, None)
+                elif op in ("shift", "diff"):
+                    n = int(param)
+                    hx, hok = W.tail_rows(x, v, count, n)
+                    if sharded:
+                        hx = C.ring_shift(hx, 1, ax)
+                        hok = C.ring_shift(hok, 1, ax)
+                        hok = hok & (C.rank(ax) != 0)
+                    else:
+                        hok = jnp.zeros_like(hok)
+                    sh, sok = W.shift_local(x, v, count, hx, hok, n)
+                    if op == "diff":
+                        cap = x.shape[0]
+                        padmask = K.row_mask(count, cap)
+                        ok = K.value_ok(x, v, padmask) & sok
+                        sh = jnp.where(ok, x.astype(jnp.float64) - sh,
+                                       jnp.nan)
+                    out[oname] = (sh, None)
+                else:
+                    raise ValueError(f"unknown window op {op}")
+            return out
+
+        if t.distribution == ONED:
+            m = mesh_mod.get_mesh()
+
+            def sharded_fn(tree, counts):
+                return body(tree, counts, True)
+            fn = jax.jit(C.smap(sharded_fn, in_specs=(P(ax), P(ax)),
+                                out_specs=P(ax), mesh=m))
+        else:
+            def rep_fn(tree, counts):
+                return body(tree, counts, False)
+            fn = jax.jit(rep_fn)
+        _jit_cache[key] = fn
+
+    counts = t.counts_device() if t.distribution == ONED \
+        else jnp.asarray(t.nrows)
+    out_tree = fn(t.device_data(), counts)
+    res = t.with_columns(t.columns)
+    for col, op, param, oname in specs:
+        d, v = out_tree[oname]
+        res.columns[oname] = Column(d, v, dt.FLOAT64, None)
+    return res
+
+
+# ---------------------------------------------------------------------------
 # whole-column reductions
 # ---------------------------------------------------------------------------
 

@@ -40,10 +40,24 @@ class BodoSQLContext:
     def remove_table(self, name: str) -> None:
         del self._tables[name]
 
+    def _schema_sig(self) -> str:
+        return repr(sorted((n, tuple(p.schema)) for n, p in
+                           self._tables.items()))
+
     def sql(self, query: str):
         """Plan + execute; returns a lazy BodoDataFrame."""
         from bodo_tpu.pandas_api.frame import BodoDataFrame
-        ast = parse_sql(query)
+        from bodo_tpu.sql import plan_cache
+        sig = self._schema_sig()
+        ast = plan_cache.get(query, sig)
+        if ast is None:
+            ast = parse_sql(query)
+            # pickle to disk BEFORE planning — the planner rewrites AST
+            # nodes in place, so only cache-served objects need copying
+            plan_cache.put(query, sig, ast)
+        else:
+            import copy
+            ast = copy.deepcopy(ast)
         plan, names = Planner(self._tables).plan(ast)
         return BodoDataFrame(plan)
 
@@ -53,3 +67,14 @@ class BodoSQLContext:
         ast = parse_sql(query)
         plan, _ = Planner(self._tables).plan(ast)
         return optimize(plan)
+
+    def explain(self, query: str) -> str:
+        """Pretty-printed optimized plan."""
+        lines = []
+
+        def walk(n, d):
+            lines.append("  " * d + repr(n))
+            for c in n.children:
+                walk(c, d + 1)
+        walk(self.generate_plan(query), 0)
+        return "\n".join(lines)

@@ -1,0 +1,46 @@
+"""On-disk SQL plan cache keyed by query hash.
+
+Analogue of the reference's SQL plan cache (bodo/sql_plan_cache.py:1,
+BODO_SQL_PLAN_CACHE_DIR). Since our planner is milliseconds (no JVM), the
+cache stores the *parsed AST pickle* keyed by (query, catalog schema) —
+it mainly saves schema inference on remote scans and documents the
+surface; set BODO_TPU_SQL_PLAN_CACHE_DIR to enable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Optional
+
+from bodo_tpu.config import config
+
+
+def _key(query: str, schema_sig: str) -> str:
+    return hashlib.sha256((query + "\0" + schema_sig).encode()).hexdigest()
+
+
+def get(query: str, schema_sig: str):
+    d = config.sql_plan_cache_dir
+    if not d:
+        return None
+    p = os.path.join(d, _key(query, schema_sig) + ".pkl")
+    try:
+        with open(p, "rb") as f:
+            return pickle.load(f)
+    except (OSError, pickle.PickleError, EOFError):
+        return None
+
+
+def put(query: str, schema_sig: str, ast) -> None:
+    d = config.sql_plan_cache_dir
+    if not d:
+        return
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, _key(query, schema_sig) + ".pkl")
+    try:
+        with open(p, "wb") as f:
+            pickle.dump(ast, f)
+    except (OSError, pickle.PickleError):
+        pass

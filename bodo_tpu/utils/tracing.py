@@ -1,0 +1,78 @@
+"""Chrome-trace event tracing + per-operator query profile.
+
+Analogue of the reference's tracing/profiling stack
+(bodo/utils/tracing.pyx Event/dump — Chrome trace JSON;
+bodo/libs/_query_profile_collector.h per-operator TIMER/STAT metrics).
+Enabled via BODO_TPU_TRACING_LEVEL >= 1 (config.tracing_level); the plan
+executor wraps every physical operator in an event, so `dump()` yields a
+chrome://tracing-loadable timeline and `profile()` the per-operator
+aggregate table.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from bodo_tpu.config import config
+
+_events: List[dict] = []
+_agg: Dict[str, dict] = defaultdict(lambda: {"count": 0, "total_s": 0.0,
+                                             "max_s": 0.0, "rows": 0})
+_lock = threading.Lock()
+
+
+def is_tracing() -> bool:
+    return config.tracing_level >= 1
+
+
+@contextlib.contextmanager
+def event(name: str, **args):
+    """Trace one operator/phase. Cheap no-op when tracing is off."""
+    if not is_tracing():
+        yield None
+        return
+    t0 = time.perf_counter()
+    ts = time.time() * 1e6
+    info: dict = {}
+    try:
+        yield info
+    finally:
+        dur = time.perf_counter() - t0
+        with _lock:
+            _events.append({
+                "name": name, "ph": "X", "ts": ts, "dur": dur * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+                "args": {**args, **info},
+            })
+            a = _agg[name]
+            a["count"] += 1
+            a["total_s"] += dur
+            a["max_s"] = max(a["max_s"], dur)
+            a["rows"] += int(info.get("rows", 0))
+
+
+def reset() -> None:
+    with _lock:
+        _events.clear()
+        _agg.clear()
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Write chrome-trace JSON (load in chrome://tracing / Perfetto)."""
+    out = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    text = json.dumps(out)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def profile() -> Dict[str, dict]:
+    """Per-operator aggregate metrics (query-profile-collector analogue)."""
+    return {k: dict(v) for k, v in _agg.items()}

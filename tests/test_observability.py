@@ -1,0 +1,61 @@
+"""Tracing / profile / plan cache / JSON reader tests."""
+
+import json
+
+import numpy as np
+import pandas as pd
+
+
+def test_tracing_and_profile(mesh8, tmp_path):
+    import bodo_tpu
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.utils import tracing
+
+    bodo_tpu.set_config(tracing_level=1)
+    tracing.reset()
+    df = pd.DataFrame({"a": np.arange(100), "b": np.arange(100) * 0.5})
+    b = bd.from_pandas(df)
+    b[b["a"] > 10].groupby("a", as_index=False).agg(s=("b", "sum")).to_pandas()
+    bodo_tpu.set_config(tracing_level=0)
+
+    prof = tracing.profile()
+    assert "Filter" in prof and "Aggregate" in prof
+    assert prof["Filter"]["count"] >= 1
+    out = json.loads(tracing.dump(str(tmp_path / "trace.json")))
+    assert any(e["name"] == "Aggregate" for e in out["traceEvents"])
+    tracing.reset()
+
+
+def test_sql_plan_cache(mesh8, tmp_path):
+    import bodo_tpu
+    from bodo_tpu.sql import BodoSQLContext
+
+    bodo_tpu.set_config(sql_plan_cache_dir=str(tmp_path))
+    try:
+        df = pd.DataFrame({"x": [1, 2, 3], "y": [1.0, 2.0, 3.0]})
+        ctx = BodoSQLContext({"t": df})
+        q = "select sum(y) as s from t where x > 1"
+        r1 = ctx.sql(q).to_pandas()
+        files = list(tmp_path.glob("*.pkl"))
+        assert len(files) == 1
+        r2 = ctx.sql(q).to_pandas()  # second run hits the AST cache
+        assert r1["s"][0] == r2["s"][0] == 5.0
+    finally:
+        bodo_tpu.set_config(sql_plan_cache_dir="")
+
+
+def test_read_json(mesh8, tmp_path):
+    from bodo_tpu.io.json import read_json
+    p = tmp_path / "d.jsonl"
+    p.write_text('{"a": 1, "s": "x"}\n{"a": 2, "s": "y"}\n')
+    t = read_json(str(p))
+    out = t.to_pandas()
+    assert list(out["a"]) == [1, 2]
+    assert list(out["s"]) == ["x", "y"]
+
+
+def test_explain(mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    ctx = BodoSQLContext({"t": pd.DataFrame({"x": [1]})})
+    txt = ctx.explain("select x from t where x > 0")
+    assert "Filter" in txt

@@ -1,0 +1,116 @@
+"""Window/cumulative/shift tests — vs pandas, REP and sharded."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import make_df
+
+
+@pytest.fixture(params=["rep", "1d"])
+def frame(request, mesh8):
+    import bodo_tpu
+    import bodo_tpu.pandas_api as bd
+    df = make_df(500, nulls=True)
+    if request.param == "1d":
+        bodo_tpu.set_config(shard_min_rows=100)
+    else:
+        bodo_tpu.set_config(shard_min_rows=10**9)
+    yield bd.from_pandas(df), df
+    bodo_tpu.set_config(shard_min_rows=100_000)
+
+
+def test_cumsum_cummax(frame):
+    b, df = frame
+    np.testing.assert_allclose(b["b"].cumsum().to_pandas(),
+                               df["b"].cumsum(), equal_nan=True, rtol=1e-12)
+    np.testing.assert_allclose(b["b"].cummax().to_pandas(),
+                               df["b"].cummax(), equal_nan=True)
+    np.testing.assert_allclose(b["d"].cumsum().to_pandas(),
+                               df["d"].cumsum().astype(float))
+
+
+def test_rolling(frame):
+    b, df = frame
+    for op in ("sum", "mean", "min", "max"):
+        got = getattr(b["b"].rolling(5), op)().to_pandas()
+        exp = getattr(df["b"].rolling(5), op)()
+        np.testing.assert_allclose(got, exp, equal_nan=True, rtol=1e-9,
+                                   err_msg=op)
+
+
+def test_shift_diff(frame):
+    b, df = frame
+    np.testing.assert_allclose(b["b"].shift(1).to_pandas(),
+                               df["b"].shift(1), equal_nan=True)
+    np.testing.assert_allclose(b["b"].shift(3).to_pandas(),
+                               df["b"].shift(3), equal_nan=True)
+    np.testing.assert_allclose(b["b"].diff(1).to_pandas(),
+                               df["b"].diff(1), equal_nan=True)
+
+
+def test_rolling_window_larger_than_shard(mesh8):
+    """Halo-limit fallback: window spanning multiple shards gathers."""
+    import bodo_tpu
+    import bodo_tpu.pandas_api as bd
+    bodo_tpu.set_config(shard_min_rows=100, capacity_round=8)
+    try:
+        df = pd.DataFrame({"v": np.arange(200.0)})
+        b = bd.from_pandas(df)
+        got = b["v"].rolling(60).sum().to_pandas()
+        exp = df["v"].rolling(60).sum()
+        np.testing.assert_allclose(got, exp, equal_nan=True)
+    finally:
+        bodo_tpu.set_config(shard_min_rows=100_000, capacity_round=128)
+
+
+def test_window_empty_middle_shard(mesh8):
+    """Counts like [5,0,5] (filter emptied a shard) must still produce
+    pandas-correct rolling/shift across the gap (gather fallback)."""
+    import bodo_tpu
+    import bodo_tpu.pandas_api as bd
+    bodo_tpu.set_config(shard_min_rows=1, capacity_round=8)
+    try:
+        df = pd.DataFrame({"v": np.arange(64.0),
+                           "k": ([0] * 8 + [1] * 8) * 4})
+        b = bd.from_pandas(df)
+        f = b[b["k"] == 0]   # knocks out alternating half-shards
+        exp = df[df["k"] == 0].reset_index(drop=True)["v"]
+        np.testing.assert_allclose(f["v"].rolling(3).sum().to_pandas(),
+                                   exp.rolling(3).sum(), equal_nan=True)
+        np.testing.assert_allclose(f["v"].shift(2).to_pandas(),
+                                   exp.shift(2), equal_nan=True)
+    finally:
+        bodo_tpu.set_config(shard_min_rows=100_000, capacity_round=128)
+
+
+def test_rolling_count_min_periods(mesh8):
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"v": [1.0, 2.0, np.nan, 4.0, 5.0]})
+    got = bd.from_pandas(df)["v"].rolling(3).count().to_pandas()
+    exp = df["v"].rolling(3).count()
+    np.testing.assert_allclose(got, exp, equal_nan=True)
+
+
+def test_rolling_large_window_minmax(mesh8):
+    df = pd.DataFrame({"v": np.random.default_rng(2).normal(size=400)})
+    import bodo_tpu.pandas_api as bd
+    b = bd.from_pandas(df)
+    for w in (17, 100):
+        np.testing.assert_allclose(b["v"].rolling(w).max().to_pandas(),
+                                   df["v"].rolling(w).max(),
+                                   equal_nan=True, err_msg=str(w))
+        np.testing.assert_allclose(b["v"].rolling(w).min().to_pandas(),
+                                   df["v"].rolling(w).min(),
+                                   equal_nan=True, err_msg=str(w))
+
+
+def test_shift_datetime_falls_back(mesh8):
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"t": pd.date_range("2024-01-01", periods=5)})
+    import pytest as _pytest
+    with _pytest.warns(UserWarning, match="falling back"):
+        got = bd.from_pandas(df)["t"].shift(1)
+    assert isinstance(got, pd.Series)
+    assert got.dtype.kind == "M"
+    assert got.isna().tolist() == [True, False, False, False, False]

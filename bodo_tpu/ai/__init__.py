@@ -1,0 +1,18 @@
+"""AI utilities (reference bodo/ai/: torch_train + Series.ai accessor).
+
+The reference feeds distributed dataframes into torch DDP
+(bodo/ai/train.py:104 torch_train, prepare_model:144). The TPU-native
+equivalent keeps training on the same mesh the dataframes live on:
+`train()` runs a jit-compiled optax loop over row-sharded features with
+replicated parameters — XLA inserts the gradient psum (the DDP allreduce
+analogue) from the shardings.
+
+`Series.ai` (tokenize/embed/llm_generate, reference bodo/ai/series.py)
+takes pluggable callables: the reference calls remote endpoints, which a
+zero-egress environment replaces with user-provided local backends.
+"""
+
+from bodo_tpu.ai.train import train
+from bodo_tpu.ai.series import AiAccessor
+
+__all__ = ["train", "AiAccessor"]

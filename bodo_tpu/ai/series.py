@@ -1,0 +1,60 @@
+"""Series.ai accessor (reference bodo/ai/series.py:12-42 —
+tokenize/llm_generate/embed; accessor registered at
+bodo/pandas/series.py:729).
+
+Backends are pluggable callables (str -> result); batched over the
+column's host dictionary so each distinct string is processed once —
+the dict-encoding win applies to model calls too.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class AiAccessor:
+    def __init__(self, series):
+        self._s = series
+
+    def _distinct_apply(self, fn: Callable, name: str):
+        """Apply fn once per distinct string, broadcast via codes."""
+        from bodo_tpu.table import dtypes as dt
+        s = self._s
+        if s._dtype is not dt.STRING:
+            raise TypeError(f"Series.ai.{name} requires a string column")
+        pds = s.to_pandas()
+        codes, uniques = pd.factorize(pds, use_na_sentinel=True)
+        results = [fn(u) for u in uniques]
+        out = [results[c] if c >= 0 else None for c in codes]
+        return pd.Series(out, name=s.name)
+
+    def tokenize(self, tokenizer: Optional[Callable] = None):
+        """tokenizer: str -> list[int]; defaults to a whitespace/byte
+        tokenizer when none is given (remote tokenizers need a backend)."""
+        fn = tokenizer or (lambda s: list(s.encode("utf-8")))
+        return self._distinct_apply(fn, "tokenize")
+
+    def embed(self, model: Optional[Callable] = None, dim: int = 64):
+        """model: str -> np.ndarray; default is a deterministic hashed
+        bag-of-bytes embedding (offline-friendly stand-in)."""
+        if model is None:
+            def model(s: str, _dim=dim):
+                v = np.zeros(_dim)
+                for i, b in enumerate(s.encode("utf-8")):
+                    v[(b * 31 + i) % _dim] += 1.0
+                n = np.linalg.norm(v)
+                return v / n if n else v
+        return self._distinct_apply(model, "embed")
+
+    def llm_generate(self, generate: Callable = None, **kwargs):
+        """generate: str -> str. No default — generation requires a local
+        model backend (zero-egress environments cannot call endpoints)."""
+        if generate is None:
+            raise ValueError(
+                "Series.ai.llm_generate requires a `generate` callable "
+                "backend (remote endpoints are unavailable)")
+        return self._distinct_apply(lambda s: generate(s, **kwargs),
+                                    "llm_generate")

@@ -1,0 +1,79 @@
+"""Distributed training over dataframe features (torch_train analogue).
+
+One jit-compiled epoch: parameters replicated, batches row-sharded over
+the mesh; jax.grad + optax; the cross-shard gradient reduction is the
+sharding-induced psum (the reference's DDP allreduce,
+bodo/ai/train.py:42 _init_process_group → here: the mesh already exists).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.ml._data import to_device_xy
+
+
+def train(loss_fn: Callable, params, df, feature_cols: Sequence[str],
+          label_col: str, *, epochs: int = 5, batch_size: int = 1024,
+          learning_rate: float = 1e-3, optimizer=None, seed: int = 0,
+          verbose: bool = False):
+    """Train `params` with `loss_fn(params, X_batch, y_batch) -> scalar`.
+
+    df: pandas or lazy frame; features/labels become row-sharded device
+    arrays. Returns (trained params, list of per-epoch mean losses).
+    """
+    import optax
+
+    to_pandas = getattr(df, "to_pandas", None)
+    pdf = to_pandas() if callable(to_pandas) else df
+    X = pdf[list(feature_cols)].to_numpy(dtype=np.float64)
+    y = pdf[label_col].to_numpy(dtype=np.float64)
+    Xd, yd, mask, n = to_device_xy(X, y)
+    opt = optimizer or optax.adam(learning_rate)
+    opt_state = opt.init(params)
+    # permute REAL rows only — padding rows must never enter a batch
+    # (a scalar-returning loss_fn cannot be masked after the fact)
+    batch_size = min(batch_size, max(n, 1))
+    n_batches = max(1, n // batch_size)
+
+    @jax.jit
+    def epoch(params, opt_state, perm):
+        def step(carry, idx):
+            params, opt_state = carry
+            rows = jax.lax.dynamic_slice_in_dim(perm, idx * batch_size,
+                                                batch_size)
+            xb = Xd[rows]
+            yb = yd[rows]
+            mb = mask[rows].astype(xb.dtype)
+
+            def masked_loss(p):
+                per = loss_fn(p, xb, yb)
+                # loss_fn may return per-example or scalar loss
+                per = jnp.asarray(per)
+                if per.ndim == 0:
+                    return per
+                return jnp.sum(per * mb) / jnp.maximum(jnp.sum(mb), 1)
+
+            loss, g = jax.value_and_grad(masked_loss)(params)
+            updates, opt_state = opt.update(g, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(n_batches))
+        return params, opt_state, jnp.mean(losses)
+
+    r = np.random.default_rng(seed)
+    history = []
+    for e in range(epochs):
+        perm = jnp.asarray(r.permutation(n))
+        params, opt_state, loss = epoch(params, opt_state, perm)
+        history.append(float(loss))
+        if verbose:  # pragma: no cover
+            print(f"epoch {e}: loss={history[-1]:.6f}")
+    return params, history

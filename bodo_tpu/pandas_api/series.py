@@ -194,6 +194,11 @@ class BodoSeries:
         return _DtAccessor(self)
 
     @property
+    def ai(self):
+        from bodo_tpu.ai.series import AiAccessor
+        return AiAccessor(self)
+
+    @property
     def str(self):
         return _StrAccessor(self)
 

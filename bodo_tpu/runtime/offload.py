@@ -1,0 +1,120 @@
+"""Table offload: device → pooled host buffers (spillable) → device.
+
+The larger-than-HBM story (reference analogue: operator state spilling
+through OperatorBufferPool/StorageManager, bodo/libs/_operator_pool.h,
+_storage_manager.h:116): a Table's columns move into native pool buffers
+on the host, become spillable to disk when unpinned, and restore to
+device on demand. The executor can park build-side tables or partial
+results here between pipeline stages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.runtime.pool import HostBufferPool, PooledBuffer, default_pool
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.table.table import Column, Table
+
+
+@dataclass
+class _OffCol:
+    data: PooledBuffer
+    data_dtype: np.dtype
+    valid: Optional[PooledBuffer]
+    dtype: dt.DType
+    dictionary: Optional[np.ndarray]
+    capacity: int
+
+
+class OffloadedTable:
+    """Host-resident, spill-capable snapshot of a Table."""
+
+    def __init__(self, cols: Dict[str, _OffCol], nrows: int,
+                 distribution: str, pool: HostBufferPool):
+        self._cols = cols
+        self._nrows = nrows
+        self._distribution = distribution
+        self._pool = pool
+        self._closed = False
+
+    def unpin(self) -> None:
+        """Make all buffers spillable under memory pressure."""
+        if self._closed:
+            raise RuntimeError("OffloadedTable already restored/freed")
+        for c in self._cols.values():
+            c.data.unpin()
+            if c.valid is not None:
+                c.valid.unpin()
+
+    def spill(self) -> int:
+        """Force-spill all unpinned buffers; returns count spilled."""
+        n = 0
+        for c in self._cols.values():
+            n += int(c.data.spill())
+            if c.valid is not None:
+                n += int(c.valid.spill())
+        return n
+
+    def restore(self) -> Table:
+        """Pin (restoring from disk as needed) and rebuild the device
+        Table, then release the host buffers. One-shot: the offloaded
+        table is closed afterwards."""
+        if self._closed:
+            raise RuntimeError("OffloadedTable already restored/freed")
+        cols: Dict[str, Column] = {}
+        for name, c in self._cols.items():
+            if not c.data._pinned:
+                c.data.pin()
+            arr = np.array(c.data.as_array(c.data_dtype)[:c.capacity],
+                           copy=True)
+            valid = None
+            if c.valid is not None:
+                if not c.valid._pinned:
+                    c.valid.pin()
+                valid = jnp.asarray(np.array(
+                    c.valid.as_array(np.bool_)[:c.capacity], copy=True))
+            cols[name] = Column(jnp.asarray(arr), valid, c.dtype,
+                                c.dictionary)
+        t = Table(cols, self._nrows, "REP", None)
+        self.free()
+        if self._distribution == "1D":
+            t = t.shard()
+        return t
+
+    def free(self) -> None:
+        for c in self._cols.values():
+            c.data.free()
+            if c.valid is not None:
+                c.valid.free()
+        self._cols = {}
+        self._closed = True
+
+
+def offload_table(t: Table, pool: Optional[HostBufferPool] = None,
+                  unpin: bool = True) -> OffloadedTable:
+    """Move a Table's data into native host pool buffers (device memory is
+    released once JAX drops its references)."""
+    pool = pool or default_pool()
+    src = t.gather() if t.distribution == "1D" else t
+    cols: Dict[str, _OffCol] = {}
+    for name, c in src.columns.items():
+        host = np.asarray(jax.device_get(c.data))
+        buf = pool.allocate(host.nbytes)
+        buf.as_array(host.dtype)[:] = host.ravel()
+        vbuf = None
+        if c.valid is not None:
+            hv = np.asarray(jax.device_get(c.valid))
+            vbuf = pool.allocate(max(hv.nbytes, 1))
+            vbuf.as_array(np.bool_)[:len(hv)] = hv
+        cols[name] = _OffCol(buf, host.dtype, vbuf, c.dtype, c.dictionary,
+                             host.shape[0])
+    ot = OffloadedTable(cols, t.nrows, t.distribution, pool)
+    if unpin:
+        ot.unpin()
+    return ot

@@ -1,0 +1,186 @@
+"""ctypes bindings + on-demand build for the native host buffer pool."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_pool.cpp")
+_LIB_PATH = os.path.join(_HERE, "_host_pool.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+HAS_NATIVE_POOL = False  # internal; use has_native_pool()
+
+
+def has_native_pool() -> bool:
+    """True when the native pool library is (or can be) loaded."""
+    return _load() is not None
+
+
+def _build() -> Optional[str]:
+    """Compile the extension if needed (cached .so next to the source)."""
+    if os.path.exists(_LIB_PATH) and \
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        return _LIB_PATH
+    cxx = os.environ.get("CXX", "g++")
+    # build to a private temp path, then rename atomically — concurrent
+    # first-time builders (spawned workers, pytest-xdist) must never load
+    # a half-written .so
+    tmp = _LIB_PATH + f".tmp.{os.getpid()}"
+    cmd = [cxx, "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            sys.stderr.write(
+                f"[bodo_tpu] native pool build failed:\n"
+                f"{r.stderr.decode()[:500]}\n")
+            return None
+        os.replace(tmp, _LIB_PATH)
+        return _LIB_PATH
+    except (OSError, subprocess.TimeoutExpired):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load():
+    global _lib, HAS_NATIVE_POOL
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.btpu_pool_create.restype = ctypes.c_void_p
+        lib.btpu_pool_create.argtypes = [ctypes.c_uint64, ctypes.c_char_p]
+        lib.btpu_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.btpu_alloc.restype = ctypes.c_int64
+        lib.btpu_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_void_p)]
+        lib.btpu_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.btpu_free.restype = ctypes.c_int
+        lib.btpu_pin.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                 ctypes.POINTER(ctypes.c_void_p)]
+        lib.btpu_pin.restype = ctypes.c_int
+        lib.btpu_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.btpu_unpin.restype = ctypes.c_int
+        lib.btpu_spill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.btpu_spill.restype = ctypes.c_int
+        lib.btpu_stats.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64 * 6)]
+        _lib = lib
+        HAS_NATIVE_POOL = True
+        return lib
+
+
+class PooledBuffer:
+    """One pinned allocation; view it as numpy via .as_array(dtype, shape).
+    unpin() makes it spillable; pin() restores (possibly from disk)."""
+
+    def __init__(self, pool: "HostBufferPool", handle: int, nbytes: int,
+                 ptr: int):
+        self._pool = pool
+        self._handle = handle
+        self._nbytes = nbytes
+        self._ptr = ptr
+        self._pinned = True
+
+    def as_array(self, dtype=np.uint8, shape=None) -> np.ndarray:
+        """Zero-copy view of the pinned buffer.
+
+        CONTRACT: views borrow the mapping — they dangle (use-after-unmap,
+        SIGSEGV) once the buffer is unpinned+spilled or freed, and pin()
+        may restore at a different address. Re-call as_array() after every
+        pin(); never hold a view across unpin()/free()."""
+        assert self._pinned, "buffer must be pinned to view"
+        n = self._nbytes // np.dtype(dtype).itemsize
+        buf = (ctypes.c_char * self._nbytes).from_address(self._ptr)
+        arr = np.frombuffer(buf, dtype=dtype, count=n)
+        return arr.reshape(shape) if shape is not None else arr
+
+    def unpin(self) -> None:
+        self._pool._lib.btpu_unpin(self._pool._pool, self._handle)
+        self._pinned = False
+
+    def pin(self) -> None:
+        out = ctypes.c_void_p()
+        rc = self._pool._lib.btpu_pin(self._pool._pool, self._handle,
+                                      ctypes.byref(out))
+        if rc != 0:
+            raise MemoryError(f"pin failed ({rc})")
+        self._ptr = out.value
+        self._pinned = True
+
+    def spill(self) -> bool:
+        """Force-spill (must be unpinned). Returns True if spilled."""
+        return self._pool._lib.btpu_spill(self._pool._pool,
+                                          self._handle) == 0
+
+    def free(self) -> None:
+        if self._handle:
+            self._pool._lib.btpu_free(self._pool._pool, self._handle)
+            self._handle = 0
+
+
+class HostBufferPool:
+    """Python handle to the native pool (reference BufferPool surface:
+    allocate/pin/unpin/spill + stats)."""
+
+    def __init__(self, limit_bytes: int = 4 << 30,
+                 spill_dir: Optional[str] = None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native pool unavailable (no C++ toolchain)")
+        self._lib = lib
+        if spill_dir is None:
+            spill_dir = tempfile.mkdtemp(prefix="bodo_tpu_spill_")
+        os.makedirs(spill_dir, exist_ok=True)
+        self._pool = lib.btpu_pool_create(limit_bytes, spill_dir.encode())
+        self.spill_dir = spill_dir
+
+    def allocate(self, nbytes: int) -> PooledBuffer:
+        out = ctypes.c_void_p()
+        h = self._lib.btpu_alloc(self._pool, nbytes, ctypes.byref(out))
+        if h == 0:
+            raise MemoryError(f"pool allocation of {nbytes} bytes failed")
+        return PooledBuffer(self, h, nbytes, out.value)
+
+    def stats(self) -> dict:
+        arr = (ctypes.c_uint64 * 6)()
+        self._lib.btpu_stats(self._pool, ctypes.byref(arr))
+        keys = ["bytes_allocated", "bytes_in_use", "bytes_spilled",
+                "n_allocs", "n_spills", "n_restores"]
+        return dict(zip(keys, [int(x) for x in arr]))
+
+    def close(self) -> None:
+        if self._pool:
+            self._lib.btpu_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_default: Optional[HostBufferPool] = None
+
+
+def default_pool() -> HostBufferPool:
+    global _default
+    if _default is None:
+        _default = HostBufferPool()
+    return _default

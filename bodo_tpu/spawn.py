@@ -1,0 +1,106 @@
+"""Multi-host SPMD process launcher (reference bodo/spawn/ analogue).
+
+The reference lazily `MPI_Comm_spawn`s persistent workers and ships
+cloudpickled functions to them (bodo/spawn/spawner.py:134 Spawner,
+worker.py:636 worker_loop). On TPU pods the runtime launches one process
+per host and `jax.distributed.initialize` forms the cluster over a gRPC
+coordinator instead of an MPI intercomm.
+
+`run_spmd(fn, n)` is the spawner surface: it forks n local processes,
+initializes a jax.distributed CPU cluster among them (the same code path
+a real multi-host pod uses), runs `fn(process_index)` in each, and
+gathers the per-process return values — the analogue of
+`submit_func_to_workers` + per-rank result gathering (spawner.py:292,
+:383). Used for testing the multi-host path without hardware; production
+pods set JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID per
+host and call bodo_tpu.init_runtime() instead.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Callable, List
+
+import cloudpickle
+
+_WORKER_CODE = r"""
+import os, pickle, sys
+import cloudpickle
+
+def main():
+    payload_path, out_path = sys.argv[1], sys.argv[2]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=os.environ["BODO_TPU_COORD"],
+        num_processes=int(os.environ["BODO_TPU_NPROCS"]),
+        process_id=int(os.environ["BODO_TPU_PROC_ID"]),
+    )
+    with open(payload_path, "rb") as f:
+        fn = cloudpickle.load(f)
+    result = fn(jax.process_index())
+    with open(out_path, "wb") as f:
+        pickle.dump(result, f)
+
+main()
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_spmd(fn: Callable[[int], object], n_processes: int = 2,
+             timeout: float = 180.0) -> List[object]:
+    """Run `fn(process_index)` across n freshly spawned processes joined
+    into one jax.distributed cluster. Returns per-process results in rank
+    order. Exceptions in any worker surface with its stderr attached."""
+    with tempfile.TemporaryDirectory(prefix="bodo_tpu_spawn_") as d:
+        payload = os.path.join(d, "fn.pkl")
+        with open(payload, "wb") as f:
+            cloudpickle.dump(fn, f)
+        worker_py = os.path.join(d, "worker.py")
+        with open(worker_py, "w") as f:
+            f.write(_WORKER_CODE)
+        coord = f"127.0.0.1:{_free_port()}"
+        procs = []
+        outs = []
+        for i in range(n_processes):
+            out_path = os.path.join(d, f"out_{i}.pkl")
+            outs.append(out_path)
+            env = dict(os.environ)
+            env.update({
+                "BODO_TPU_COORD": coord,
+                "BODO_TPU_NPROCS": str(n_processes),
+                "BODO_TPU_PROC_ID": str(i),
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, worker_py, payload, out_path],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        results = []
+        errs = []
+        for i, p in enumerate(procs):
+            try:
+                _, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                _, err = p.communicate()
+                errs.append(f"rank {i}: timeout\n{err.decode()[-800:]}")
+                continue
+            if p.returncode != 0:
+                errs.append(f"rank {i} rc={p.returncode}:\n"
+                            f"{err.decode()[-800:]}")
+        if errs:
+            raise RuntimeError("spawn workers failed:\n" + "\n".join(errs))
+        for out_path in outs:
+            with open(out_path, "rb") as f:
+                results.append(pickle.load(f))
+        return results

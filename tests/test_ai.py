@@ -1,0 +1,48 @@
+"""bodo_tpu.ai tests: distributed trainer + Series.ai accessor."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def test_train_linear_model(mesh8, rng):
+    import jax.numpy as jnp
+
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.ai import train
+
+    n = 2000
+    df = pd.DataFrame({"x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+    df["y"] = 3.0 * df.x1 - 1.5 * df.x2 + 0.5
+
+    def loss(params, X, y):
+        pred = X @ params["w"] + params["b"]
+        return (pred - y) ** 2
+
+    params0 = {"w": jnp.zeros(2), "b": jnp.zeros(())}
+    params, hist = train(loss, params0, bd.from_pandas(df),
+                         ["x1", "x2"], "y", epochs=40, batch_size=256,
+                         learning_rate=0.05)
+    assert hist[-1] < hist[0]
+    np.testing.assert_allclose(np.asarray(params["w"]), [3.0, -1.5],
+                               atol=0.05)
+    assert abs(float(params["b"]) - 0.5) < 0.05
+
+
+def test_series_ai_accessor(mesh8):
+    import bodo_tpu.pandas_api as bd
+
+    df = pd.DataFrame({"s": ["hello", "world", "hello", None]})
+    b = bd.from_pandas(df)
+    toks = b["s"].ai.tokenize()
+    assert toks[0] == toks[2] == list("hello".encode())
+    assert toks[3] is None
+
+    emb = b["s"].ai.embed(dim=16)
+    assert len(emb[0]) == 16
+    np.testing.assert_allclose(np.linalg.norm(emb[1]), 1.0)
+
+    out = b["s"].ai.llm_generate(lambda s: s.upper())
+    assert out[0] == "HELLO"
+    with pytest.raises(ValueError, match="backend"):
+        b["s"].ai.llm_generate()

@@ -93,6 +93,11 @@ class Config:
     low_precision_agg: bool = field(
         default_factory=lambda: _env_bool("BODO_TPU_LOW_PRECISION_AGG", False)
     )
+    # Pack small-range multi-key groupby/sort keys into one int64 (big
+    # sort/shuffle win; disable to force the general lexicographic path).
+    pack_keys: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_PACK_KEYS", True)
+    )
     # SQL plan cache directory (analogue BODO_SQL_PLAN_CACHE_DIR).
     sql_plan_cache_dir: str = field(
         default_factory=lambda: _env_str("BODO_TPU_SQL_PLAN_CACHE_DIR", "")

@@ -13,7 +13,7 @@ from bodo_tpu.pandas_api.series import BodoSeries
 from bodo_tpu.plan import logical as L
 
 __all__ = ["BodoDataFrame", "BodoSeries", "read_parquet", "read_csv",
-           "from_pandas"]
+           "from_pandas", "concat"]
 
 
 def read_parquet(path, columns=None) -> BodoDataFrame:
@@ -26,3 +26,17 @@ def read_csv(path, columns=None, parse_dates=None) -> BodoDataFrame:
 
 def from_pandas(df) -> BodoDataFrame:
     return BodoDataFrame(L.FromPandas(df))
+
+
+def concat(frames, ignore_index: bool = True) -> BodoDataFrame:
+    """Row-wise concat of schema-compatible lazy frames (pd.concat
+    analogue; UNION ALL underneath)."""
+    import pandas as pd
+    plans = []
+    for f in frames:
+        if isinstance(f, pd.DataFrame):
+            f = from_pandas(f)
+        plans.append(f._plan)
+    if len(plans) == 1:
+        return BodoDataFrame(plans[0])
+    return BodoDataFrame(L.Union(plans))

@@ -179,6 +179,33 @@ class Reduce(Node):
         return ("reduce", self.child.key(), tuple(self.aggs))
 
 
+class Union(Node):
+    """UNION ALL / concat of schema-compatible inputs (reference:
+    LogicalSetOperation plan.py, streaming union op)."""
+
+    def __init__(self, children):
+        assert len(children) >= 2
+        self.children = list(children)
+        first = children[0].schema
+        for c in children[1:]:
+            if list(c.schema) != list(first):
+                raise ValueError(
+                    f"union schema mismatch: {list(first)} vs "
+                    f"{list(c.schema)}")
+            for name in first:
+                a, b = first[name], c.schema[name]
+                if a is b:
+                    continue
+                if dt.is_numeric(a) and dt.is_numeric(b):
+                    continue  # concat_tables promotes
+                raise ValueError(
+                    f"union dtype mismatch on {name}: {a.name} vs {b.name}")
+        self.schema = dict(first)
+
+    def key(self):
+        return ("union", tuple(c.key() for c in self.children))
+
+
 class Window(Node):
     """Row-aligned window transforms (cumsum/rolling/shift/diff) —
     specs = [(col, op, param, outname)]."""

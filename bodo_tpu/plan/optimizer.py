@@ -172,6 +172,9 @@ def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
         return L.Distinct(prune_columns(node.child, need), node.subset)
     if isinstance(node, L.Limit):
         return L.Limit(prune_columns(node.child, required), node.n)
+    if isinstance(node, L.Union):
+        # same required set on every arm keeps schemas aligned
+        return L.Union([prune_columns(c, required) for c in node.children])
     return _rebuild(node, [prune_columns(c, None) for c in node.children])
 
 

@@ -102,6 +102,9 @@ def _exec_inner(node: L.Node) -> Table:
         right = _exec(node.right)
         return R.join_tables(left, right, node.left_on, node.right_on,
                              node.how, node.suffixes)
+    if isinstance(node, L.Union):
+        return _maybe_shard(R.concat_tables(
+            [_exec(c) for c in node.children]))
     if isinstance(node, L.Window):
         return R.window_table(_exec(node.child), node.specs)
     if isinstance(node, L.Sort):

@@ -44,6 +44,15 @@ def _schema(t: Table) -> Dict[str, dt.DType]:
     return {n: c.dtype for n, c in t.columns.items()}
 
 
+def _as_local(t: Table) -> Optional[Table]:
+    """A 1-shard 'distributed' table is just a local table — return the
+    zero-copy REP view so single-chip runs skip shuffle/combine stages
+    entirely (the common case for the single-device benchmark)."""
+    if t.distribution == ONED and t.num_shards == 1:
+        return Table(dict(t.columns), t.nrows, REP, None)
+    return None
+
+
 def _dicts(t: Table) -> Dict[str, np.ndarray]:
     return {n: c.dictionary for n, c in t.columns.items()
             if c.dictionary is not None}
@@ -229,14 +238,134 @@ def filter_table(t: Table, predicate: Expr) -> Table:
 
 
 # ---------------------------------------------------------------------------
+# key packing (multi-key → one int64 when ranges fit)
+# ---------------------------------------------------------------------------
+
+def _key_ranges(t: Table, keys: Sequence[str]):
+    """Host-known (lo, hi) range per key column, or None when unpackable.
+    Strings use the dictionary size; bools are 0/1; ints/dates reduce
+    min/max on device (one cheap fused pass)."""
+    ranges = []
+    need_reduce = []
+    for k in keys:
+        c = t.column(k)
+        if c.dtype is dt.STRING:
+            ranges.append((0, max(len(c.dictionary) - 1, 0))
+                          if c.dictionary is not None else None)
+        elif c.dtype.kind == "b":
+            ranges.append((0, 1))
+        elif c.dtype.kind in ("i", "u") or c.dtype in (dt.DATE,):
+            ranges.append("reduce")
+            need_reduce.append(k)
+        else:  # floats/datetimes: don't pack
+            ranges.append(None)
+    if need_reduce:
+        if t.nrows == 0:
+            stats = {f"{k}__min": 0 for k in need_reduce}
+            stats.update({f"{k}__max": 0 for k in need_reduce})
+        else:
+            specs = [(k, "min", f"{k}__min") for k in need_reduce] + \
+                [(k, "max", f"{k}__max") for k in need_reduce]
+            stats = reduce_table(t, specs)
+        it = iter(need_reduce)
+        for i, r in enumerate(ranges):
+            if r == "reduce":
+                k = next(it)
+                lo = _range_int(stats[f"{k}__min"])
+                hi = _range_int(stats[f"{k}__max"])
+                ranges[i] = None if lo is None or hi is None else (lo, hi)
+    return ranges
+
+
+def _range_int(v) -> Optional[int]:
+    """Reduce-scalar → int for packing (DATE min/max comes back as a
+    datetime64/date scalar — convert to epoch days)."""
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, np.datetime64):
+        return int(v.astype("datetime64[D]").astype(np.int64))
+    import datetime
+    if isinstance(v, datetime.date) and not isinstance(v, datetime.datetime):
+        return int((np.datetime64(v, "D") - np.datetime64(0, "D"))
+                   .astype(np.int64))
+    if isinstance(v, (bool, np.bool_)):
+        return int(v)
+    return None  # floats / NaN / anything else: don't pack
+
+
+def _pack_plan(t: Table, keys: Sequence[str], max_bits: int = 62):
+    """Packing layout [(name, lo, bits, shift)] or None. One extra code
+    per field is reserved for null keys (so dropna still works)."""
+    if not config.pack_keys or len(keys) < 2:
+        return None
+    ranges = _key_ranges(t, keys)
+    fields = []
+    total = 0
+    for k, r in zip(keys, ranges):
+        if r is None:
+            return None
+        lo, hi = r
+        span = hi - lo + 2  # +1 for the null/sentinel code
+        bits = max(1, int(span - 1).bit_length())
+        fields.append((k, lo, bits))
+        total += bits
+        if total > max_bits:
+            return None
+    # first key in the TOP bits so packed ascending == lexicographic order
+    plan = []
+    shift = total
+    for k, lo, bits in fields:
+        shift -= bits
+        plan.append((k, lo, bits, shift))
+    return plan
+
+
+def _pack_keys_kernel(tree, pack, count):
+    """Packed int64 key + validity (False where any key is null)."""
+    cap = next(iter(tree.values()))[0].shape[0]
+    packed = jnp.zeros((cap,), dtype=jnp.int64)
+    valid = jnp.ones((cap,), dtype=bool)
+    for name, lo, bits, shift in pack:
+        d, v = tree[name]
+        ok = jnp.ones((cap,), dtype=bool) if v is None else v
+        if jnp.issubdtype(d.dtype, jnp.floating):  # pragma: no cover
+            ok = ok & ~jnp.isnan(d)
+        code = jnp.clip(d.astype(jnp.int64) - lo, 0, (1 << bits) - 2)
+        packed = packed | (jnp.where(ok, code, (1 << bits) - 1)
+                           << np.int64(shift))
+        valid = valid & ok
+    return packed, valid
+
+
+def _unpack_keys(packed, pack):
+    out = {}
+    for name, lo, bits, shift in pack:
+        code = (packed >> np.int64(shift)) & np.int64((1 << bits) - 1)
+        out[name] = code + lo
+    return out
+
+
+# ---------------------------------------------------------------------------
 # groupby aggregate
 # ---------------------------------------------------------------------------
 
 def groupby_agg(t: Table, keys: Sequence[str],
                 aggs: Sequence[Tuple[str, str, str]]) -> Table:
     """Group by `keys`; aggs = [(value_col, op, out_name)].
-    Output sorted by keys ascending (pandas sort=True)."""
+    Output sorted by keys ascending (pandas sort=True).
+
+    When every key has a small host-known range (ints/bools/dict codes),
+    the keys pack into one int64 — a single-operand sort replaces the
+    multi-operand lexicographic sort and the shuffle moves one key
+    column (the reference gets a similar effect from its categorical/
+    sorted-key exscan strategies, bodo/libs/groupby/)."""
     keys = list(keys)
+    local = _as_local(t)
+    if local is not None:
+        return groupby_agg(local, keys, aggs)
+    pack = _pack_plan(t, keys, 62)
+    if pack is not None:
+        return _groupby_agg_packed(t, keys, list(aggs), pack)
     specs = tuple(op for _, op, _ in aggs)
     val_names = [c for c, _, _ in aggs]
     arrays = tuple((t.column(k).data, t.column(k).valid) for k in keys) + \
@@ -272,6 +401,63 @@ def groupby_agg(t: Table, keys: Sequence[str],
     return shrink_to_fit(Table(cols, nrows, dist, counts))
 
 
+def _packed_key_table(t: Table, pack, with_valid: bool = True) -> Table:
+    """Add the packed int64 key column '__packed' to `t` (jitted).
+
+    with_valid=True attaches the any-key-null mask (groupby dropna);
+    False leaves nulls encoded only as per-field sentinel codes, which is
+    the correct lexicographic na_last behavior for sorting."""
+    key_names = [name for name, *_ in pack]
+    key = ("packkeys", _sig(t.select(key_names)), tuple(pack), with_valid)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        pk = tuple(pack)
+
+        @jax.jit
+        def fn(tree):
+            return _pack_keys_kernel(tree, pk, None)
+        _jit_cache[key] = fn
+    packed, valid = fn({n: (t.column(n).data, t.column(n).valid)
+                        for n in key_names})
+    cols = dict(t.columns)
+    cols["__packed"] = Column(packed, valid if with_valid else None,
+                              dt.INT64, None)
+    return Table(cols, t.nrows, t.distribution, t.counts)
+
+
+def _groupby_agg_packed(t: Table, keys, aggs, pack) -> Table:
+    tp = _packed_key_table(t, pack)
+    val_cols = list(dict.fromkeys(c for c, _, _ in aggs))
+    tp = tp.select(["__packed"] + val_cols)
+    out = groupby_agg(tp, ["__packed"],
+                      [(c, op, o) for c, op, o in aggs])
+    # unpack key columns from the packed values (device, elementwise)
+    key_un = ("unpack", tuple(pack), out.capacity)
+    fn = _jit_cache.get(key_un)
+    if fn is None:
+        pk = tuple(pack)
+
+        @jax.jit
+        def fn(packed):
+            return _unpack_keys(packed, pk)
+        _jit_cache[key_un] = fn
+    unpacked = fn(out.column("__packed").data)
+    cols: Dict[str, Column] = {}
+    for name, lo, bits, shift in pack:
+        src = t.column(name)
+        d = unpacked[name]
+        if src.dtype is dt.STRING:
+            d = d.astype(np.int32)
+        elif src.dtype.kind == "b":
+            d = d.astype(bool)
+        elif d.dtype != src.dtype.numpy:
+            d = d.astype(src.dtype.numpy)
+        cols[name] = Column(d, None, src.dtype, src.dictionary)
+    for _, _, oname in aggs:
+        cols[oname] = out.columns[oname]
+    return Table(cols, out.nrows, out.distribution, out.counts)
+
+
 # ---------------------------------------------------------------------------
 # sort
 # ---------------------------------------------------------------------------
@@ -279,10 +465,20 @@ def groupby_agg(t: Table, keys: Sequence[str],
 def sort_table(t: Table, by: Sequence[str], ascending=None,
                na_last: bool = True) -> Table:
     by = list(by)
+    local = _as_local(t)
+    if local is not None:
+        return sort_table(local, by, ascending, na_last)
     if ascending is None:
         ascending = [True] * len(by)
     elif isinstance(ascending, bool):
         ascending = [ascending] * len(by)
+    # packed path: all-ascending small-range keys sort by one int64
+    if all(ascending) and na_last and len(by) > 1:
+        pack = _pack_plan(t, by, 62)
+        if pack is not None:
+            tp = _packed_key_table(t, pack, with_valid=False)
+            res = sort_table(tp, ["__packed"], [True], na_last)
+            return res.select(t.names)
     others = [n for n in t.names if n not in by]
     order = by + others
     arrays = tuple((t.column(n).data, t.column(n).valid) for n in order)
@@ -345,6 +541,11 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
                 right.columns[rk] = Column(rc.data.astype(common.numpy),
                                            rc.valid, common, None)
 
+    ll, rl = _as_local(left), _as_local(right)
+    if ll is not None:
+        left = ll
+    if rl is not None:
+        right = rl
     if left.distribution == REP and right.distribution == ONED:
         left = left.shard()
     if left.distribution == ONED and right.distribution == ONED:
@@ -844,6 +1045,52 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
     tree = {n: out[i] for i, n in enumerate(korder)}
     res = t.with_device_data(tree, nrows=int(counts.sum()), counts=counts)
     return shrink_to_fit(res.select(names))
+
+
+# ---------------------------------------------------------------------------
+# concat / union all
+# ---------------------------------------------------------------------------
+
+def concat_tables(tables: Sequence[Table]) -> Table:
+    """Row-wise concatenation (UNION ALL). Inputs must share the schema;
+    string dictionaries are unified; numeric dtypes promote.
+
+    TODO(next round): shard-wise append + rebalance instead of the
+    gather-to-host path (keeps large unions device-resident)."""
+    assert tables
+    names = tables[0].names
+    parts = [t.gather() if t.distribution == ONED else t for t in tables]
+    total = sum(t.nrows for t in parts)
+    cap = round_capacity(max(total, 1))
+    cols: Dict[str, Column] = {}
+    for n in names:
+        src_cols = [t.columns[n] for t in parts]
+        if any(c.dtype is dt.STRING for c in src_cols):
+            _, src_cols = unify_dictionaries(src_cols)
+            out_dtype = dt.STRING
+            dictionary = src_cols[0].dictionary
+        else:
+            out_np = np.result_type(*[c.dtype.numpy for c in src_cols])
+            out_dtype = dt.from_numpy(out_np)
+            dictionary = None
+        datas, valids = [], []
+        any_valid = any(c.valid is not None for c in src_cols)
+        for t, c in zip(parts, src_cols):
+            datas.append(c.data[: t.nrows].astype(out_dtype.numpy)
+                         if c.data.dtype != out_dtype.numpy
+                         else c.data[: t.nrows])
+            if any_valid:
+                valids.append(c.valid[: t.nrows] if c.valid is not None
+                              else jnp.ones(t.nrows, dtype=bool))
+        data = jnp.zeros((cap,), dtype=out_dtype.numpy)
+        data = data.at[:total].set(jnp.concatenate(datas) if datas
+                                   else data[:0])
+        valid = None
+        if any_valid:
+            valid = jnp.zeros((cap,), dtype=bool)
+            valid = valid.at[:total].set(jnp.concatenate(valids))
+        cols[n] = Column(data, valid, out_dtype, dictionary)
+    return Table(cols, total, REP, None)
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,14 @@ class Select:
 
 
 @dataclass
+class UnionSel:
+    selects: List["Select"]
+    alls: List[bool] = field(default_factory=list)  # per UNION operator
+    order_by: List[Tuple[Any, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
 class TableRef:
     name: str
     alias: Optional[str] = None
@@ -269,7 +277,7 @@ class Parser:
         return v
 
     # -- grammar ----------------------------------------------------------
-    def parse(self) -> Select:
+    def parse(self):
         sel = self.select_stmt()
         self.try_op(";")
         t, _ = self.peek()
@@ -277,7 +285,7 @@ class Parser:
             raise SyntaxError(f"trailing tokens at {self.peek()}")
         return sel
 
-    def select_stmt(self) -> Select:
+    def select_stmt(self):
         ctes = []
         if self.try_kw("WITH"):
             while True:
@@ -289,6 +297,28 @@ class Parser:
                 if not self.try_op(","):
                     break
         sel = self.select_core()
+        sels = [sel]
+        alls: List[bool] = []
+        while self.kw("UNION"):
+            self.eat_kw("UNION")
+            alls.append(self.try_kw("ALL"))
+            sels.append(self.select_core())
+        if len(sels) > 1:
+            # ORDER BY / LIMIT written after the chain are consumed by the
+            # last arm's select_core — they belong to the whole union
+            for arm in sels[:-1]:
+                if arm.order_by or arm.limit is not None:
+                    raise NotImplementedError(
+                        "ORDER BY/LIMIT inside a UNION arm — wrap the arm "
+                        "in a subquery")
+            last = sels[-1]
+            u = UnionSel(sels, alls, order_by=last.order_by,
+                         limit=last.limit)
+            last.order_by = []
+            last.limit = None
+            if ctes:
+                raise NotImplementedError("WITH + UNION (wrap in subquery)")
+            return u
         sel.ctes = ctes
         return sel
 

@@ -75,8 +75,10 @@ class Planner:
         return f"{base}{self.counter[0]}"
 
     # ------------------------------------------------------------------
-    def plan(self, sel: P.Select) -> Tuple[L.Node, List[str]]:
+    def plan(self, sel) -> Tuple[L.Node, List[str]]:
         """Returns (plan, output column names)."""
+        if isinstance(sel, P.UnionSel):
+            return self._plan_union(sel)
         catalog = dict(self.catalog)
         for name, cte in sel.ctes:
             node, names = self.plan(cte)
@@ -88,6 +90,40 @@ class Planner:
             return self._plan_core(sel, outer=None)
         finally:
             self.catalog = saved
+
+    def _plan_union(self, u: "P.UnionSel") -> Tuple[L.Node, List[str]]:
+        parts = [self.plan(s) for s in u.selects]
+        names = parts[0][1]
+        aligned = []
+        for node, nm in parts:
+            if len(nm) != len(names):
+                raise ValueError("UNION arms have different column counts")
+            aligned.append(L.Projection(
+                node, [(names[i], ColRef(nm[i])) for i in range(len(names))]))
+        # left-associative fold so mixed UNION / UNION ALL dedups correctly
+        out: L.Node = aligned[0]
+        for is_all, arm in zip(u.alls, aligned[1:]):
+            out = L.Union([out, arm])
+            if not is_all:
+                out = L.Distinct(out, names)
+        # trailing ORDER BY / LIMIT apply to the whole union; keys resolve
+        # against the output columns (names or 1-based positions)
+        if u.order_by:
+            keys, asc = [], []
+            for e, a in u.order_by:
+                if isinstance(e, P.Num) and isinstance(e.value, int):
+                    keys.append(names[e.value - 1])
+                elif isinstance(e, P.Col) and e.qualifier is None and \
+                        e.name in names:
+                    keys.append(e.name)
+                else:
+                    raise NotImplementedError(
+                        "UNION ORDER BY must reference output columns")
+                asc.append(a)
+            out = L.Sort(out, keys, asc)
+        if u.limit is not None:
+            out = L.Limit(out, u.limit)
+        return out, names
 
     # ------------------------------------------------------------------
     def _from(self, item, outer: Optional[Scope]) -> Tuple[L.Node, Scope]:
@@ -104,7 +140,8 @@ class Planner:
                 scope.add(alias, c, f"{tag}__{c}")
             return plan, scope
         if isinstance(item, P.SubSelect):
-            node, names = self._plan_core(item.select, outer=None)
+            # plan() also routes UNION subselects
+            node, names = self.plan(item.select)
             tag = self._fresh()
             exprs = [(f"{tag}__{c}", ColRef(c)) for c in names]
             plan = L.Projection(node, exprs)

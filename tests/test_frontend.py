@@ -191,3 +191,12 @@ def test_filter_pushdown_through_projection(bd, mesh8):
     assert isinstance(plan.child, L.Filter)
     _cmp_frames(f.to_pandas(),
                 df.assign(e=df["a"] * 2)[df["a"] > 3].reset_index(drop=True))
+
+
+def test_concat(bd, mesh8):
+    a = pd.DataFrame({"x": [1, 2], "s": ["a", "b"]})
+    b_ = pd.DataFrame({"x": [3, 4], "s": ["c", "a"]})
+    out = bd.concat([bd.from_pandas(a), b_]).to_pandas()
+    exp = pd.concat([a, b_], ignore_index=True)
+    assert out["x"].tolist() == exp["x"].tolist()
+    assert out["s"].tolist() == exp["s"].tolist()

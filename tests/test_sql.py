@@ -213,3 +213,43 @@ def test_nested_dictmap_projection(ctx, tables, mesh8):
         from customer limit 3
     """).to_pandas()
     assert all(s == "CUSTOMER" for s in got["u"])
+
+
+def test_union_all_and_union(ctx, tables, mesh8):
+    got = ctx.sql("""
+        select o_custkey as k from orders where o_totalprice > 900
+        union all
+        select c_custkey as k from customer where c_nation = 'PERU'
+    """).to_pandas()
+    o, c = tables["orders"], tables["customer"]
+    exp_n = (o.o_totalprice > 900).sum() + (c.c_nation == "PERU").sum()
+    assert len(got) == exp_n
+    got2 = ctx.sql("""
+        select o_custkey as k from orders
+        union
+        select c_custkey as k from customer
+    """).to_pandas()
+    exp2 = len(set(o.o_custkey) | set(c.c_custkey))
+    assert len(got2) == exp2
+
+
+def test_union_order_limit_and_mixed(ctx, tables, mesh8):
+    # ORDER BY/LIMIT bind to the whole union, not the last arm
+    got = ctx.sql("""
+        select o_custkey as k from orders where o_totalprice > 990
+        union all
+        select c_custkey as k from customer where c_nation = 'PERU'
+        order by k desc limit 5
+    """).to_pandas()
+    o, c = tables["orders"], tables["customer"]
+    pool = list(o[o.o_totalprice > 990].o_custkey) + \
+        list(c[c.c_nation == "PERU"].c_custkey)
+    assert list(got["k"]) == sorted(pool, reverse=True)[:5]
+    # mixed UNION / UNION ALL folds left-associatively
+    import pandas as pd
+    ctx2 = type(ctx)({"t1": pd.DataFrame({"x": [1, 1]}),
+                      "t2": pd.DataFrame({"x": [1]}),
+                      "t3": pd.DataFrame({"x": [2, 2]})})
+    got2 = ctx2.sql("select x from t1 union select x from t2 "
+                    "union all select x from t3").to_pandas()
+    assert sorted(got2["x"]) == [1, 2, 2]

@@ -1,15 +1,17 @@
 #!/usr/bin/env python
-"""Benchmark driver: NYC-taxi-shaped filter+join+groupby workload.
-
-Prints ONE JSON line:
+"""Benchmark driver. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Baseline anchor: the reference engine reports ~3x over pandas for this
-workload on a single host (BASELINE.md: "NYC Taxi local subset — Bodo JIT
-≈3x vs pandas"). vs_baseline = our_speedup_over_pandas / 3.0, so
-vs_baseline >= 1.0 means we match the reference's single-host win.
+Suites:
+  --suite taxi (default): NYC-taxi-shaped filter+join+groupby vs pandas.
+    Baseline anchor: the reference reports ~3x over pandas on a single
+    host (BASELINE.md), so vs_baseline = our_speedup / 3.0.
+  --suite tpch: per-query hot/cold TPC-H times; metric is total hot
+    seconds over the supported queries (vs_baseline 0.0 — the reference
+    publishes no absolute in-repo numbers). Exits nonzero if any
+    supported query fails.
 
-Usage: python bench.py [--rows N] [--quick] [--cpu]
+Usage: python bench.py [--suite taxi|tpch] [--rows N] [--quick] [--cpu]
 """
 
 import argparse
@@ -34,15 +36,65 @@ def _probe_accelerator(timeout_s: int = 240) -> bool:
         return False
 
 
+def bench_tpch(args):
+    """--suite tpch: per-query hot/cold times (the reference's TPC-H
+    harness convention, benchmarks/tpch/README.md)."""
+    import bodo_tpu
+    from bodo_tpu.sql import BodoSQLContext
+    from bodo_tpu.workloads.tpch import QUERIES, UNSUPPORTED, gen_tpch
+
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh())
+    data = gen_tpch(n_orders=args.rows, seed=0)
+    ctx = BodoSQLContext(data)
+    times = {}
+    from bodo_tpu.plan.physical import _result_cache
+    for q in sorted(QUERIES):
+        if q in UNSUPPORTED:
+            continue
+        try:
+            t0 = time.perf_counter()
+            ctx.sql(QUERIES[q]).to_pandas()
+            cold = time.perf_counter() - t0
+            # hot = compiled kernels, fresh execution (not the result cache)
+            _result_cache.clear()
+            t0 = time.perf_counter()
+            ctx.sql(QUERIES[q]).to_pandas()
+            hot = time.perf_counter() - t0
+            times[q] = hot
+            print(f"Q{q:2d} cold {cold:6.2f}s hot {hot:6.2f}s",
+                  file=sys.stderr)
+        except Exception as e:  # pragma: no cover
+            print(f"Q{q:2d} ERROR {e}", file=sys.stderr)
+            times[q] = None
+    ok = [v for v in times.values() if v is not None]
+    failed = len(times) - len(ok)
+    print(json.dumps({
+        "metric": "tpch_total_hot_seconds",
+        "value": round(sum(ok), 3) if not failed else 0.0,
+        "unit": "s",
+        "vs_baseline": 0.0,  # no absolute reference numbers in-repo
+        "detail": {"orders": args.rows, "queries_ok": len(ok),
+                   "queries_failed": failed,
+                   "skipped": {str(k): v for k, v in UNSUPPORTED.items()},
+                   "per_query": {str(k): (None if v is None
+                                          else round(v, 3))
+                                 for k, v in times.items()}},
+    }))
+    return 1 if failed else 0
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=20_000_000)
+    ap.add_argument("--rows", type=int, default=None,
+                    help="taxi: trip rows (default 20M); tpch: orders "
+                         "(default 200k)")
     ap.add_argument("--quick", action="store_true",
                     help="200k rows (CI / CPU-mesh smoke run)")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU backend with an 8-device mesh")
+    ap.add_argument("--suite", choices=["taxi", "tpch"], default="taxi")
     args = ap.parse_args()
-    n_rows = 200_000 if args.quick else args.rows
+    n_rows = 200_000 if args.quick else (args.rows or 20_000_000)
 
     use_cpu = args.cpu
     if not use_cpu and not _probe_accelerator(timeout_s=240):
@@ -55,6 +107,11 @@ def main():
     import jax
     if use_cpu:
         jax.config.update("jax_platforms", "cpu")
+
+    if args.suite == "tpch":
+        if args.rows is None:
+            args.rows = 2000 if args.quick else 200_000
+        return bench_tpch(args)
 
     import pandas as pd  # noqa: F401
 

@@ -88,9 +88,7 @@ def _normalize(df: pd.DataFrame, has_order: bool) -> pd.DataFrame:
     return out.reset_index(drop=True)
 
 
-# Q21's EXISTS correlation includes a non-equality outer reference
-# (l2.l_suppkey <> l1.l_suppkey) — not yet decorrelatable.
-UNSUPPORTED = {21: "non-equality correlated EXISTS"}
+from bodo_tpu.workloads.tpch import UNSUPPORTED  # noqa: E402
 
 
 @pytest.mark.parametrize("qnum", sorted(QUERIES))

@@ -215,7 +215,7 @@ class Window(Node):
         self.specs = [tuple(s) for s in specs]
         sch = dict(child.schema)
         for col, op, param, out in self.specs:
-            sch[out] = dt.FLOAT64
+            sch[out] = dt.INT64 if op == "rowid" else dt.FLOAT64
         self.schema = sch
 
     @property

@@ -782,6 +782,11 @@ def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
                     res = W.rolling_local(op[len("rolling_"):], w, x, v,
                                           count, hx, hok, goff)
                     out[oname] = (res, None)
+                elif op == "rowid":
+                    cap = x.shape[0]
+                    padmask = K.row_mask(count, cap)
+                    rid = goff + jnp.arange(cap, dtype=jnp.int64)
+                    out[oname] = (jnp.where(padmask, rid, -1), None)
                 elif op in ("shift", "diff"):
                     n = int(param)
                     hx, hok = W.tail_rows(x, v, count, n)
@@ -822,7 +827,8 @@ def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
     res = t.with_columns(t.columns)
     for col, op, param, oname in specs:
         d, v = out_tree[oname]
-        res.columns[oname] = Column(d, v, dt.FLOAT64, None)
+        res.columns[oname] = Column(
+            d, v, dt.INT64 if op == "rowid" else dt.FLOAT64, None)
     return res
 
 

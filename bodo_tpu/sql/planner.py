@@ -598,26 +598,140 @@ class Planner:
 
     def _exists(self, plan, scope, sub: P.Select, anti: bool):
         """EXISTS with equality correlation → semi/anti join on the
-        correlated columns."""
-        sub2, corr = self._decorrelate(sub, scope)
+        correlated columns. Non-equality outer references become
+        post-join residual filters over a row-id semi join (the general
+        unnesting — covers TPC-H Q21)."""
+        sub2, corr, residuals, inner_scope = self._decorrelate(sub, scope)
         if not corr:
             raise NotImplementedError(
-                "uncorrelated or non-equality-correlated EXISTS")
+                "EXISTS without an equality correlation conjunct "
+                + ("(only non-equality outer references found)"
+                   if residuals else "(uncorrelated)"))
         inner_cols = [ic for _, ic in corr]
-        sub2.projections = [(c, f"__ex{i}") for i, c in enumerate(inner_cols)]
+        if not residuals:
+            sub2.projections = [(c, f"__ex{i}")
+                                for i, c in enumerate(inner_cols)]
+            node, names = self._plan_core(sub2, outer=None)
+            node = L.Distinct(node, names)
+            outer_cols = [oc for oc, _ in corr]
+            how = "left" if anti else "inner"
+            j = L.Join(plan, node, outer_cols, names, how)
+            if anti:
+                j = L.Filter(j, UnOp("isna", ColRef(names[0])))
+            keep = [c for c in plan.schema]
+            return L.Projection(j, [(c, ColRef(c)) for c in keep])
+
+        # general path: tag outer rows with a row id, join on equality
+        # correlations keeping multiplicity, filter residuals, then
+        # semi/anti on the surviving row ids
+        rid = self._fresh("__rid")
+        first_col = next(iter(plan.schema))
+        plan_rid = L.Window(plan, [(first_col, "rowid", None, rid)])
+        # project every residual-referenced inner column with a fresh name
+        inner_needed = []
+        for e in residuals:
+            for c in self._collect_cols(e):
+                try:
+                    if inner_scope.resolve(c.name, c.qualifier) is not None:
+                        inner_needed.append((c.qualifier, c.name))
+                except ValueError:
+                    inner_needed.append((c.qualifier, c.name))
+        inner_needed = list(dict.fromkeys(inner_needed))
+        proj = [(c, f"__ex{i}") for i, c in enumerate(inner_cols)]
+        inner_name_map = {}
+        for i, (q, n) in enumerate(inner_needed):
+            nm = f"__er{self._fresh('')}_{i}"
+            proj.append((P.Col(n, qualifier=q), nm))
+            inner_name_map[(q.lower() if q else None, n.lower())] = nm
+        sub2.projections = proj
         node, names = self._plan_core(sub2, outer=None)
-        node = L.Distinct(node, names)
+        key_names = names[:len(inner_cols)]
         outer_cols = [oc for oc, _ in corr]
-        how = "left" if anti else "inner"
-        j = L.Join(plan, node, outer_cols, names, how)
+        j = L.Join(plan_rid, node, outer_cols, key_names, "inner")
+        # residual conversion: outer cols resolve via the original scope,
+        # inner cols via the fresh projected names
+        res_scope = Scope()
+        res_scope.by_qual = dict(scope.by_qual)
+        for k, v in scope.by_col.items():
+            res_scope.by_col[k] = list(v)
+        for (q, n), nm in inner_name_map.items():
+            res_scope.add(q or "", n, nm)
+            res_scope.add("", nm, nm)  # rewritten refs resolve directly
+        pred = None
+        for e in residuals:
+            ex = self._expr(self._prefer_inner(e, inner_name_map), res_scope)
+            pred = ex if pred is None else BinOp("&", pred, ex)
+        f = L.Filter(j, pred)
+        matched = L.Distinct(
+            L.Projection(f, [(rid + "_m", ColRef(rid))]), [rid + "_m"])
         if anti:
-            j = L.Filter(j, UnOp("isna", ColRef(names[0])))
+            j2 = L.Join(plan_rid, matched, [rid], [rid + "_m"], "left")
+            out = L.Filter(j2, UnOp("isna", ColRef(rid + "_m")))
+        else:
+            out = L.Join(plan_rid, matched, [rid], [rid + "_m"], "inner")
         keep = [c for c in plan.schema]
-        return L.Projection(j, [(c, ColRef(c)) for c in keep])
+        return L.Projection(out, [(c, ColRef(c)) for c in keep])
+
+    @staticmethod
+    def _walk_ast(e, visit):
+        """Shared traversal: call visit(node) on every AST node, covering
+        scalar fields AND elements of list/tuple fields (the walker all
+        AST passes in this class must use — divergent copies are how
+        list-field bugs creep in)."""
+        visit(e)
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, tuple(_AST_TYPES)):
+                Planner._walk_ast(v, visit)
+            elif isinstance(v, (list, tuple)):
+                for y in v:
+                    if isinstance(y, tuple(_AST_TYPES)):
+                        Planner._walk_ast(y, visit)
+                    elif isinstance(y, tuple):
+                        for z in y:
+                            if isinstance(z, tuple(_AST_TYPES)):
+                                Planner._walk_ast(z, visit)
+
+    @staticmethod
+    def _collect_cols(e) -> List[P.Col]:
+        acc: List[P.Col] = []
+        Planner._walk_ast(
+            e, lambda x: acc.append(x) if isinstance(x, P.Col) else None)
+        return acc
+
+    def _prefer_inner(self, e, inner_name_map):
+        """Rewrite inner-column refs in a residual AST to their projected
+        fresh names (outer refs keep their original qualifier). Rewrites
+        Cols in scalar fields and inside list fields (Func.args, IN
+        lists, CASE arms)."""
+        import copy
+        e = copy.deepcopy(e)
+
+        def sub(col: P.Col):
+            nm = inner_name_map.get(
+                (col.qualifier.lower() if col.qualifier else None,
+                 col.name.lower()))
+            return P.Col(nm, qualifier=None) if nm is not None else col
+
+        def rewrite(x):
+            for f in getattr(x, "__dataclass_fields__", {}):
+                v = getattr(x, f)
+                if isinstance(v, P.Col):
+                    setattr(x, f, sub(v))
+                elif isinstance(v, list):
+                    setattr(x, f, [sub(y) if isinstance(y, P.Col) else y
+                                   for y in v])
+
+        root = P.UnA("not", e)  # wrapper so a top-level Col also rewrites
+        Planner._walk_ast(root, rewrite)
+        return root.operand
 
     def _decorrelate(self, sub: P.Select, outer_scope: Scope):
-        """Remove outer-equality conjuncts from the subquery WHERE.
-        Returns (new subquery AST, [(outer_flat, inner Col AST)])."""
+        """Split the subquery WHERE into: equality correlations (pulled
+        out as join keys), mixed-reference residual conjuncts (returned
+        as ASTs for post-join filtering), and purely-inner conjuncts
+        (kept in the subquery). Returns (sub', corr, residuals) where
+        corr = [(outer_flat, inner Col AST)]."""
         import copy
         sub = copy.deepcopy(sub)
         # inner scope: plan the FROM cheaply to learn inner names
@@ -625,8 +739,35 @@ class Planner:
         probe_planner.counter = self.counter
         _, inner_scope = probe_planner._from(sub.from_item, None)
 
+        def side_of(col: P.Col):
+            try:
+                if inner_scope.resolve(col.name, col.qualifier) is not None:
+                    return "inner"
+            except ValueError:
+                return "inner"  # ambiguous within inner → inner
+            try:
+                if outer_scope.resolve(col.name, col.qualifier) is not None:
+                    return "outer"
+            except ValueError:
+                return "outer"
+            return None
+
         corr: List[Tuple[str, P.Col]] = []
         kept: List = []
+        residuals: List = []
+
+        def refs(e, acc):
+            if isinstance(e, P.Col):
+                acc.append(e)
+            for f in getattr(e, "__dataclass_fields__", {}):
+                v = getattr(e, f)
+                if isinstance(v, tuple(_AST_TYPES)):
+                    refs(v, acc)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, tuple(_AST_TYPES)):
+                            refs(x, acc)
+            return acc
 
         def split(e):
             if isinstance(e, P.BinA) and e.op == "&":
@@ -636,24 +777,15 @@ class Planner:
             if isinstance(e, P.BinA) and e.op == "==" and \
                     isinstance(e.left, P.Col) and isinstance(e.right, P.Col):
                 for a, b in ((e.left, e.right), (e.right, e.left)):
-                    try:
-                        in_inner = inner_scope.resolve(a.name, a.qualifier)
-                    except ValueError:
-                        in_inner = None
-                    try:
-                        out_flat = outer_scope.resolve(b.name, b.qualifier)
-                    except ValueError:
-                        out_flat = None
-                    inner_missing_outer = None
-                    try:
-                        inner_missing_outer = inner_scope.resolve(
-                            b.name, b.qualifier)
-                    except ValueError:
-                        pass
-                    if in_inner and out_flat and inner_missing_outer is None:
-                        corr.append((out_flat, a))
+                    if side_of(a) == "inner" and side_of(b) == "outer":
+                        corr.append(
+                            (outer_scope.resolve(b.name, b.qualifier), a))
                         return
-            kept.append(e)
+            sides = {side_of(c) for c in refs(e, [])}
+            if "outer" in sides:
+                residuals.append(e)
+            else:
+                kept.append(e)
 
         if sub.where is not None:
             split(sub.where)
@@ -661,13 +793,16 @@ class Planner:
             for k in kept:
                 w = k if w is None else P.BinA("&", w, k)
             sub.where = w
-        return sub, corr
+        return sub, corr, residuals, inner_scope
 
     def _scalar_subquery(self, plan, scope, sub: P.Select):
         """Uncorrelated → execute now, return a literal. Correlated with a
         single aggregate → grouped aggregate joined on correlation keys;
         returns (None, new_plan, value_column)."""
-        sub2, corr = self._decorrelate(sub, scope)
+        sub2, corr, residuals, _ = self._decorrelate(sub, scope)
+        if residuals:
+            raise NotImplementedError(
+                "non-equality correlated scalar subquery")
         if not corr:
             node, names = self._plan_core(sub2, outer=None)
             from bodo_tpu.plan.physical import execute

@@ -142,8 +142,9 @@ def gen_tpch(n_orders: int = 1500, seed: int = 0):
 
 
 # Queries the engine cannot yet plan (kept beside QUERIES so the bench
-# and the test suite share one source of truth).
-UNSUPPORTED = {21: "non-equality correlated EXISTS"}
+# and the test suite share one source of truth). Currently empty — Q21's
+# non-equality correlated EXISTS is handled by the row-id decorrelation.
+UNSUPPORTED = {}
 
 # The 22 standard TPC-H queries (spec text, standard parameters).
 QUERIES = {

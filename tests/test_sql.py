@@ -253,3 +253,27 @@ def test_union_order_limit_and_mixed(ctx, tables, mesh8):
     got2 = ctx2.sql("select x from t1 union select x from t2 "
                     "union all select x from t3").to_pandas()
     assert sorted(got2["x"]) == [1, 2, 2]
+
+
+def test_exists_residual_variants(mesh8):
+    """General correlated-EXISTS decorrelation (the Q21 machinery)."""
+    from bodo_tpu.sql import BodoSQLContext
+    li = pd.DataFrame({"o": [1, 1, 2, 2, 3], "s": [10, 20, 10, 10, 30],
+                       "q": [5.0, 6.0, 7.0, 8.0, 9.0]})
+    c = BodoSQLContext({"li": li})
+    n = c.sql("""select count(*) as n from li l1 where exists
+        (select * from li l2 where l2.o = l1.o and l2.s <> l1.s)
+        """).to_pandas()["n"][0]
+    assert n == 2
+    n2 = c.sql("""select count(*) as n from li l1 where not exists
+        (select * from li l2 where l2.o = l1.o and l2.s <> l1.s)
+        """).to_pandas()["n"][0]
+    assert n2 == 3
+    # residual with a function call over an unqualified inner column
+    t1 = pd.DataFrame({"k": [1, 2, 3], "v": [5.0, -1.0, 2.0]})
+    t2 = pd.DataFrame({"k": [1, 2, 3], "v": [-10.0, 0.5, 1.0]})
+    c2 = BodoSQLContext({"t1": t1, "t2": t2})
+    got = c2.sql("""select k from t1 where exists
+        (select 1 from t2 where t2.k = t1.k and abs(v) > t1.v)
+        """).to_pandas()
+    assert sorted(got["k"]) == [1, 2]

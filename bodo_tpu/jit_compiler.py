@@ -115,7 +115,10 @@ def jit(fn: Optional[Callable] = None, *, distributed=None, replicated=None,
     def wrapper(*args, **kwargs):
         nonlocal jax_jitted, numeric_ok
         # pure numeric path → straight jax.jit; functions that use pandas
-        # internally fail this trace and permanently take the frame path
+        # internally fail this trace and permanently take the frame path.
+        # Only trace/type failures trigger the fallback — genuine runtime
+        # errors in user code (assertions, ZeroDivisionError, ...) propagate
+        # rather than silently re-executing via the frame path.
         if numeric_ok and _is_numeric_args(args, kwargs):
             try:
                 if jax_jitted is None:
@@ -124,7 +127,18 @@ def jit(fn: Optional[Callable] = None, *, distributed=None, replicated=None,
                 return jax.tree.map(
                     lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
                     out)
-            except Exception:
+            except (TypeError, ValueError, IndexError, AttributeError,
+                    NotImplementedError) as e:
+                # JAXTypeError (tracer leaks, concretization) subclasses
+                # TypeError and NonConcreteBooleanIndexError subclasses
+                # IndexError; ValueError/AttributeError cover shape and
+                # duck-typing failures of pandas-flavored code on arrays.
+                # Errors outside these (AssertionError, ZeroDivisionError,
+                # KeyError...) are genuine user bugs and propagate.
+                from bodo_tpu.utils.logging import warn_fallback
+                warn_fallback(getattr(fn, "__name__", "jit"),
+                              f"numeric jax.jit path failed, using the "
+                              f"dataframe path: {type(e).__name__}: {e}")
                 numeric_ok = False
                 jax_jitted = None
 

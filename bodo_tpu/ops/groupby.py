@@ -7,10 +7,17 @@ hash tables we use the XLA-friendly sort+segment-reduce recipe
 group boundaries, `jax.ops.segment_*` reductions onto the MXU/VPU.
 
 Aggregations are split into decomposable partial ops + combine + finalize
-(the same sum/count/sumsq decomposition the reference uses for its
-distributed combine step, bodo/libs/groupby/_groupby_update.cpp), which
-powers the two-phase distributed groupby: local pre-aggregation →
-hash-partition all_to_all shuffle → combine (parallel/shuffle.py).
+(the reference's decomposition strategy for its distributed combine step,
+bodo/libs/groupby/_groupby_update.cpp), which powers the two-phase
+distributed groupby: local pre-aggregation → hash-partition all_to_all
+shuffle → combine (parallel/shuffle.py).
+
+var/std use the numerically stable (count, sum, m2) moments with
+m2 = Σ(x − mean)² accumulated in float64 (two-pass locally; the
+cross-shard term is recovered from per-shard sums at combine — the same
+stable var_combine the reference implements,
+bodo/libs/groupby/_groupby_update.cpp), never the catastrophically
+cancelling E[x²] − E[x]² form.
 """
 
 from __future__ import annotations
@@ -30,11 +37,11 @@ from bodo_tpu.ops import sort_encoding as SE
 # agg spec plumbing
 # ---------------------------------------------------------------------------
 
-# primitive ops computable in one segment pass
-_PRIMITIVE = {"sum", "sumsq", "count", "size", "min", "max", "first", "last",
-              "prod", "mean", "var", "std", "var0", "std0", "nunique"}
-
 # final op -> (partial ops, combine ops on partial cols)
+# var/std partials: float64 (count, sum, m2); the combine for m2 is the
+# composite "chan_m2" (exact delta-form Chan combine) which reads the two
+# preceding columns (count, sum) — the triple MUST stay in this order.
+_VAR_PARTS = ["count", "sum64", "m2"]
 DECOMPOSE: Dict[str, List[str]] = {
     "sum": ["sum"],
     "sumnull": ["sumnull"],
@@ -46,12 +53,13 @@ DECOMPOSE: Dict[str, List[str]] = {
     "first": ["first"],
     "last": ["last"],
     "mean": ["sum", "count"],
-    "var": ["sum", "sumsq", "count"],
-    "std": ["sum", "sumsq", "count"],
-    "var0": ["sum", "sumsq", "count"],
-    "std0": ["sum", "sumsq", "count"],
+    "var": _VAR_PARTS,
+    "std": _VAR_PARTS,
+    "var0": _VAR_PARTS,
+    "std0": _VAR_PARTS,
 }
-COMBINE_OF = {"sum": "sum", "sumnull": "sumnull", "sumsq": "sum",
+COMBINE_OF = {"sum": "sum", "sumnull": "sumnull", "sum64": "sum",
+              "m2": "chan_m2",
               "count": "sum", "size": "sum",
               "min": "min", "max": "max", "first": "first", "last": "last",
               "prod": "prod"}
@@ -61,9 +69,11 @@ def result_dtype(op: str, dtype):
     d = jnp.dtype(dtype)
     if op in ("count", "size", "nunique"):
         return jnp.dtype(jnp.int64)
+    if op in ("sum64", "m2"):
+        return jnp.dtype(jnp.float64)  # stable moments always accumulate f64
     if op in ("mean", "var", "std", "var0", "std0"):
         return jnp.dtype(jnp.float32) if d == jnp.float32 else jnp.dtype(jnp.float64)
-    if op in ("sum", "sumnull", "sumsq", "prod"):
+    if op in ("sum", "sumnull", "prod"):
         if jnp.issubdtype(d, jnp.floating):
             return d
         if jnp.issubdtype(d, jnp.unsignedinteger):
@@ -76,11 +86,15 @@ def result_dtype(op: str, dtype):
 # core local kernel
 # ---------------------------------------------------------------------------
 
-def _group_segments(keys: Sequence[Tuple], count):
+def _group_segments(keys: Sequence[Tuple], count, row_valid=None):
     """Sort rows by keys; return (perm, seg_ids, new_group, padmask_s,
-    n_groups). Null-keyed rows are excluded (pandas dropna=True)."""
+    n_groups). Null-keyed rows are excluded (pandas dropna=True).
+
+    row_valid (optional bool[cap]) marks live rows directly instead of the
+    first-`count`-rows convention — used by the streaming merge where live
+    rows sit in two packed blocks (state ∪ batch partials)."""
     cap = keys[0][0].shape[0]
-    padmask = K.row_mask(count, cap)
+    padmask = K.row_mask(count, cap) if row_valid is None else row_valid
     for data, valid in keys:
         if valid is not None:
             padmask = padmask & valid
@@ -120,14 +134,20 @@ def _segment_agg(op: str, v_s, valid_s, seg, padmask_s, out_cap: int):
         sz = jax.ops.segment_sum(padmask_s.astype(jnp.int64), seg,
                                  num_segments=out_cap)
         return sz, None
-    if op in ("sum", "sumnull", "sumsq"):
+    if op in ("sum", "sumnull", "sum64"):
         v = v_s.astype(rdt)
-        if op == "sumsq":
-            v = v * v
         s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
         if op == "sumnull":  # SQL: SUM over all-null group is NULL
             return s, cnt > 0
         return s, None  # pandas: sum over all-null = 0
+    if op == "m2":
+        # stable centered second moment Σ(x − mean)², always float64
+        v = v_s.astype(jnp.float64)
+        s = jax.ops.segment_sum(jnp.where(ok, v, 0.0), seg,
+                                num_segments=out_cap)
+        mean = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+        d = jnp.where(ok, v - mean[seg], 0.0)
+        return jax.ops.segment_sum(d * d, seg, num_segments=out_cap), None
     if op == "prod":
         v = v_s.astype(rdt)
         p = jax.ops.segment_prod(jnp.where(ok, v, 1), seg, num_segments=out_cap)
@@ -162,24 +182,26 @@ def _segment_agg(op: str, v_s, valid_s, seg, padmask_s, out_cap: int):
         m = s / jnp.maximum(cnt, 1)
         return jnp.where(cnt > 0, m, jnp.nan), None
     if op in ("var", "std", "var0", "std0"):
-        v = v_s.astype(rdt)
-        s = jax.ops.segment_sum(jnp.where(ok, v, 0), seg, num_segments=out_cap)
-        s2 = jax.ops.segment_sum(jnp.where(ok, v * v, 0), seg,
-                                 num_segments=out_cap)
-        out = _var_from_moments(s, s2, cnt, ddof=0 if op.endswith("0") else 1)
+        # two-pass: mean, then Σ(x − mean)², accumulated in float64
+        v = v_s.astype(jnp.float64)
+        s = jax.ops.segment_sum(jnp.where(ok, v, 0.0), seg,
+                                num_segments=out_cap)
+        mean = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+        d = jnp.where(ok, v - mean[seg], 0.0)
+        m2 = jax.ops.segment_sum(d * d, seg, num_segments=out_cap)
+        out = _var_from_m2(m2, cnt, ddof=0 if op.endswith("0") else 1)
         if op.startswith("std"):
             out = jnp.sqrt(out)
-        return out, None
+        return out.astype(rdt), None
     if op == "nunique":
         raise NotImplementedError("nunique handled in groupby_local")
     raise ValueError(f"unknown agg op: {op}")
 
 
-def _var_from_moments(s, s2, cnt, ddof: int = 1):
-    cntf = cnt.astype(s.dtype)
-    m = s / jnp.maximum(cntf, 1)
-    num = s2 - cntf * m * m
-    var = num / jnp.maximum(cntf - ddof, 1)
+def _var_from_m2(m2, cnt, ddof: int = 1):
+    """Variance from the centered second moment M2 = Σ(x − mean)²."""
+    cntf = cnt.astype(m2.dtype)
+    var = m2 / jnp.maximum(cntf - ddof, 1)
     return jnp.where(cnt > ddof, jnp.maximum(var, 0), jnp.nan)
 
 
@@ -206,12 +228,34 @@ def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
         out_keys.append((z.at[idx_scatter].set(k_s, mode="drop"), None))
 
     out_vals = []
-    for (data, valid), op in zip(values, specs):
+    for i, ((data, valid), op) in enumerate(zip(values, specs)):
         v_s = data[perm]
         valid_s = valid[perm] if valid is not None else None
         if op == "nunique":
             out_vals.append(_nunique(keys, (data, valid), perm, seg,
                                      padmask_s, out_capacity))
+        elif op == "chan_m2":
+            # composite combine of per-shard (n, sum, m2) partial rows:
+            # M2 = Σm2ᵢ + Σnᵢ·(meanᵢ − mean)² — the exact delta-form Chan
+            # combine (reference bodo/libs/groupby/_groupby_update.cpp
+            # var_combine). Reads the two preceding value columns, which
+            # _VAR_PARTS pins to (count, sum64).
+            n_s = values[i - 2][0][perm].astype(jnp.float64)
+            s_s = values[i - 1][0][perm].astype(jnp.float64)
+            m2_s = v_s.astype(jnp.float64)
+            okr = K.value_ok(m2_s, valid_s, padmask_s)
+            n_tot = jax.ops.segment_sum(jnp.where(okr, n_s, 0.0), seg,
+                                        num_segments=out_capacity)
+            s_tot = jax.ops.segment_sum(jnp.where(okr, s_s, 0.0), seg,
+                                        num_segments=out_capacity)
+            mean = s_tot / jnp.maximum(n_tot, 1.0)
+            delta = s_s / jnp.maximum(n_s, 1.0) - mean[seg]
+            cross = jax.ops.segment_sum(
+                jnp.where(okr, n_s * delta * delta, 0.0), seg,
+                num_segments=out_capacity)
+            m2 = jax.ops.segment_sum(jnp.where(okr, m2_s, 0.0), seg,
+                                     num_segments=out_capacity)
+            out_vals.append((m2 + cross, None))
         else:
             out_vals.append(_segment_agg(op, v_s, valid_s, seg, padmask_s,
                                          out_capacity))

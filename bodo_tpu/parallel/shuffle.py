@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from bodo_tpu.config import config
 from bodo_tpu.ops import kernels as K
-from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, _var_from_moments,
+from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, _var_from_m2,
                                   groupby_local, result_dtype)
 from bodo_tpu.ops.hashing import dest_shard, hash_columns
 from bodo_tpu.parallel import collectives as C
@@ -128,11 +128,14 @@ def _finalize(op: str, cols, orig_dtype):
         m = s.astype(rdt) / jnp.maximum(cnt, 1).astype(rdt)
         return jnp.where(cnt > 0, m, jnp.nan), None
     if op in ("var", "std", "var0", "std0"):
-        (s, _), (s2, _), (cnt, _) = cols
+        # combined partials are (n, Σx, M2) — M2 already merged exactly by
+        # the chan_m2 composite combine (see ops/groupby.py groupby_local)
+        (cnt, _), (_s, _), (m2, _) = cols
         rdt = result_dtype(op, orig_dtype)
-        out = _var_from_moments(s.astype(rdt), s2.astype(rdt), cnt,
-                                ddof=0 if op.endswith("0") else 1)
-        return (jnp.sqrt(out) if op.startswith("std") else out), None
+        ddof = 0 if op.endswith("0") else 1
+        out = _var_from_m2(m2, cnt, ddof=ddof)
+        return (jnp.sqrt(out) if op.startswith("std")
+                else out).astype(rdt), None
     return cols[0]
 
 

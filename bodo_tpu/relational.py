@@ -534,6 +534,18 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
         elif lc.dtype is not rc.dtype and dt.is_numeric(lc.dtype) and \
                 dt.is_numeric(rc.dtype):
             common = dt.common_numeric(lc.dtype, rc.dtype)
+            # Refuse lossy key casts: promoting 64-bit integer keys to
+            # float64 collapses distinct keys above 2^53 into equal ones,
+            # silently producing wrong join results.
+            for side in (lc, rc):
+                if (np.dtype(side.dtype.numpy).kind in "iu"
+                        and np.dtype(side.dtype.numpy).itemsize == 8
+                        and np.dtype(common.numpy).kind == "f"):
+                    raise NotImplementedError(
+                        f"join on {lc.dtype.name} vs {rc.dtype.name} keys "
+                        f"would promote a 64-bit integer key to float64, "
+                        f"which is lossy above 2**53; cast one side "
+                        f"explicitly to a common exact type first")
             if lc.dtype is not common:
                 left.columns[lk] = Column(lc.data.astype(common.numpy),
                                           lc.valid, common, None)
@@ -840,10 +852,10 @@ _REDUCE_PARTIALS = {"sum": ("sum",), "sumnull": ("sum", "count"),
                     "count": ("count",), "size": ("size",),
                     "min": ("min", "count"), "max": ("max", "count"),
                     "mean": ("sum", "count"),
-                    "var": ("sum", "sumsq", "count"),
-                    "std": ("sum", "sumsq", "count"),
-                    "var0": ("sum", "sumsq", "count"),
-                    "std0": ("sum", "sumsq", "count"),
+                    "var": ("sum", "m2", "count"),
+                    "std": ("sum", "m2", "count"),
+                    "var0": ("sum", "m2", "count"),
+                    "std0": ("sum", "m2", "count"),
                     "prod": ("prod",)}
 
 
@@ -876,15 +888,22 @@ def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
                     outs.append(jnp.sum(ok).astype(jnp.int64))
                 elif p == "size":
                     outs.append(jnp.sum(padmask).astype(jnp.int64))
-                elif p in ("sum", "sumsq"):
+                elif p == "sum":
                     # exact in the widened source family (int64/float64)
                     acc = jnp.float64 if jnp.issubdtype(d.dtype, jnp.floating) \
                         else (jnp.uint64 if jnp.issubdtype(
                             d.dtype, jnp.unsignedinteger) else jnp.int64)
                     x = d.astype(acc)
-                    if p == "sumsq":
-                        x = x.astype(jnp.float64) ** 2
                     outs.append(jnp.sum(jnp.where(ok, x, jnp.zeros((), x.dtype))))
+                elif p == "m2":
+                    # stable centered second moment, float64 (Chan combine
+                    # on host; reference bodo/libs/groupby/_groupby_update
+                    # .cpp var_combine)
+                    x = d.astype(jnp.float64)
+                    s = jnp.sum(jnp.where(ok, x, 0.0))
+                    n = jnp.maximum(jnp.sum(ok), 1).astype(jnp.float64)
+                    dd = jnp.where(ok, x - s / n, 0.0)
+                    outs.append(jnp.sum(dd * dd))
                 elif p == "prod":
                     outs.append(jnp.prod(jnp.where(ok, d.astype(jnp.float64),
                                                    1.0)))
@@ -944,9 +963,13 @@ def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
         elif op in ("var", "std", "var0", "std0"):
             ddof = 0 if op.endswith("0") else 1
             if cnt is not None and cnt > ddof:
-                s = float(block["sum"].sum())
-                s2 = float(block["sumsq"].sum())
-                v = max((s2 - s * s / cnt) / (cnt - ddof), 0.0)
+                # exact delta-form Chan combine of per-shard moments
+                n_i = block["count"].astype(np.float64)
+                s_i = block["sum"].astype(np.float64)
+                m = s_i.sum() / cnt
+                mean_i = s_i / np.maximum(n_i, 1)
+                m2 = block["m2"].sum() + (n_i * (mean_i - m) ** 2).sum()
+                v = max(m2 / (cnt - ddof), 0.0)
                 if op.startswith("std"):
                     v = float(np.sqrt(v))
             else:

@@ -53,6 +53,10 @@ struct PoolStats {
   std::atomic<uint64_t> n_allocs{0};
   std::atomic<uint64_t> n_spills{0};
   std::atomic<uint64_t> n_restores{0};
+  // allocations that pushed bytes_in_use past the limit after spilling
+  // failed to make room (no spill dir / everything pinned) — the limit is
+  // enforced best-effort, but overcommit is observable, not silent
+  std::atomic<uint64_t> n_overcommits{0};
 };
 
 class BufferPool {
@@ -74,6 +78,9 @@ class BufferPool {
     std::lock_guard<std::mutex> g(mu_);
     if (stats_.bytes_in_use.load() + size > limit_ && !spill_dir_.empty()) {
       SpillUntil(size);  // best effort
+    }
+    if (stats_.bytes_in_use.load() + size > limit_) {
+      stats_.n_overcommits += 1;
     }
     void* p = mmap(nullptr, size, PROT_READ | PROT_WRITE,
                    MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
@@ -136,13 +143,16 @@ class BufferPool {
     return SpillFrame(id, it->second);
   }
 
-  void Stats(uint64_t* out6) {
-    out6[0] = stats_.bytes_allocated.load();
-    out6[1] = stats_.bytes_in_use.load();
-    out6[2] = stats_.bytes_spilled.load();
-    out6[3] = stats_.n_allocs.load();
-    out6[4] = stats_.n_spills.load();
-    out6[5] = stats_.n_restores.load();
+  void Stats(uint64_t* out8) {
+    out8[0] = stats_.bytes_allocated.load();
+    out8[1] = stats_.bytes_in_use.load();
+    out8[2] = stats_.bytes_spilled.load();
+    out8[3] = stats_.n_allocs.load();
+    out8[4] = stats_.n_spills.load();
+    out8[5] = stats_.n_restores.load();
+    out8[6] = stats_.n_overcommits.load();
+    uint64_t in_use = stats_.bytes_in_use.load();
+    out8[7] = in_use > limit_ ? in_use - limit_ : 0;
   }
 
  private:
@@ -264,8 +274,8 @@ int btpu_spill(void* pool, int64_t id) {
   return static_cast<BufferPool*>(pool)->Spill(id);
 }
 
-void btpu_stats(void* pool, uint64_t* out6) {
-  static_cast<BufferPool*>(pool)->Stats(out6);
+void btpu_stats(void* pool, uint64_t* out8) {
+  static_cast<BufferPool*>(pool)->Stats(out8);
 }
 
 }  // extern "C"

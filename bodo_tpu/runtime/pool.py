@@ -79,7 +79,7 @@ def _load():
         lib.btpu_spill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.btpu_spill.restype = ctypes.c_int
         lib.btpu_stats.argtypes = [ctypes.c_void_p,
-                                   ctypes.POINTER(ctypes.c_uint64 * 6)]
+                                   ctypes.POINTER(ctypes.c_uint64 * 8)]
         _lib = lib
         HAS_NATIVE_POOL = True
         return lib
@@ -158,10 +158,11 @@ class HostBufferPool:
         return PooledBuffer(self, h, nbytes, out.value)
 
     def stats(self) -> dict:
-        arr = (ctypes.c_uint64 * 6)()
+        arr = (ctypes.c_uint64 * 8)()
         self._lib.btpu_stats(self._pool, ctypes.byref(arr))
         keys = ["bytes_allocated", "bytes_in_use", "bytes_spilled",
-                "n_allocs", "n_spills", "n_restores"]
+                "n_allocs", "n_spills", "n_restores",
+                "n_overcommits", "bytes_over_limit"]
         return dict(zip(keys, [int(x) for x in arr]))
 
     def close(self) -> None:

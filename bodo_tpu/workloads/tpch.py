@@ -447,3 +447,61 @@ group by cntrycode
 order by cntrycode
 """,
 }
+
+
+# ---------------------------------------------------------------------------
+# sqlite oracle helpers (shared by tests/test_tpch.py and bench.py --suite
+# tpch): translate the standard query texts into sqlite's dialect so the
+# stdlib engine can serve as a differential baseline (the reference's
+# differential-oracle strategy, SURVEY.md §4).
+# ---------------------------------------------------------------------------
+
+import re as _re  # noqa: E402
+
+
+def fold_intervals(sql: str) -> str:
+    """date 'X' ± interval 'N' unit → folded literal (sqlite has neither)."""
+    pat = _re.compile(
+        r"date\s+'([0-9-]+)'\s*([+-])\s*interval\s+'(\d+)'\s+(\w+)")
+
+    def repl(m):
+        d = np.datetime64(m.group(1))
+        n = int(m.group(3))
+        sign = 1 if m.group(2) == "+" else -1
+        unit = m.group(4).lower().rstrip("s")
+        if unit in ("year", "month"):
+            months = n * (12 if unit == "year" else 1) * sign
+            out = (d.astype("datetime64[M]") + months).astype("datetime64[D]")
+        else:
+            days = {"day": 1}[unit] * n * sign
+            out = d + np.timedelta64(days, "D")
+        return f"date '{out}'"
+
+    prev = None
+    while prev != sql:
+        prev = sql
+        sql = pat.sub(repl, sql)
+    return sql
+
+
+def to_sqlite(sql: str) -> str:
+    sql = fold_intervals(sql)
+    sql = _re.sub(r"date\s+'([0-9-]+)'", r"'\1'", sql)
+    sql = _re.sub(r"extract\s*\(\s*year\s+from\s+([A-Za-z_0-9.]+)\s*\)",
+                  r"CAST(strftime('%Y', \1) AS INTEGER)", sql)
+    sql = _re.sub(r"substring\s*\(\s*([A-Za-z_0-9.]+)\s+from\s+(\d+)\s+"
+                  r"for\s+(\d+)\s*\)", r"substr(\1, \2, \3)", sql)
+    return sql
+
+
+def sqlite_connection(data):
+    """Load a gen_tpch() dict into an in-memory sqlite DB."""
+    import sqlite3
+    conn = sqlite3.connect(":memory:")
+    for name, df in data.items():
+        df2 = df.copy()
+        for c in df2.columns:
+            if df2[c].dtype.kind == "M":
+                df2[c] = df2[c].dt.strftime("%Y-%m-%d")
+        df2.to_sql(name, conn, index=False)
+    return conn

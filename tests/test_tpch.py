@@ -2,53 +2,12 @@
 (the reference's differential-oracle strategy, SURVEY.md §4, applied to
 its TPC-H harness benchmarks/tpch/)."""
 
-import re
-import sqlite3
-
 import numpy as np
 import pandas as pd
 import pytest
 
-from bodo_tpu.workloads.tpch import QUERIES, gen_tpch
-
-
-# ---------------------------------------------------------------------------
-# sqlite oracle
-# ---------------------------------------------------------------------------
-
-def _fold_intervals(sql: str) -> str:
-    """date 'X' ± interval 'N' unit → folded literal (sqlite has neither)."""
-    pat = re.compile(
-        r"date\s+'([0-9-]+)'\s*([+-])\s*interval\s+'(\d+)'\s+(\w+)")
-
-    def repl(m):
-        d = np.datetime64(m.group(1))
-        n = int(m.group(3))
-        sign = 1 if m.group(2) == "+" else -1
-        unit = m.group(4).lower().rstrip("s")
-        if unit in ("year", "month"):
-            months = n * (12 if unit == "year" else 1) * sign
-            out = (d.astype("datetime64[M]") + months).astype("datetime64[D]")
-        else:
-            days = {"day": 1}[unit] * n * sign
-            out = d + np.timedelta64(days, "D")
-        return f"date '{out}'"
-
-    prev = None
-    while prev != sql:
-        prev = sql
-        sql = pat.sub(repl, sql)
-    return sql
-
-
-def _to_sqlite(sql: str) -> str:
-    sql = _fold_intervals(sql)
-    sql = re.sub(r"date\s+'([0-9-]+)'", r"'\1'", sql)
-    sql = re.sub(r"extract\s*\(\s*year\s+from\s+([A-Za-z_0-9.]+)\s*\)",
-                 r"CAST(strftime('%Y', \1) AS INTEGER)", sql)
-    sql = re.sub(r"substring\s*\(\s*([A-Za-z_0-9.]+)\s+from\s+(\d+)\s+"
-                 r"for\s+(\d+)\s*\)", r"substr(\1, \2, \3)", sql)
-    return sql
+from bodo_tpu.workloads.tpch import (QUERIES, gen_tpch, sqlite_connection,
+                                     to_sqlite as _to_sqlite)
 
 
 @pytest.fixture(scope="module")
@@ -58,14 +17,7 @@ def tpch_data():
 
 @pytest.fixture(scope="module")
 def sqlite_conn(tpch_data):
-    conn = sqlite3.connect(":memory:")
-    for name, df in tpch_data.items():
-        df2 = df.copy()
-        for c in df2.columns:
-            if df2[c].dtype.kind == "M":
-                df2[c] = df2[c].dt.strftime("%Y-%m-%d")
-        df2.to_sql(name, conn, index=False)
-    return conn
+    return sqlite_connection(tpch_data)
 
 
 @pytest.fixture(scope="module")

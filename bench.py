@@ -116,7 +116,20 @@ def main():
                          "mesh only adds shuffle cost; use --cpu --mesh 8 "
                          "as a collectives correctness probe)")
     ap.add_argument("--suite", choices=["taxi", "tpch"], default="taxi")
+    ap.add_argument("--stream", action="store_true",
+                    help="use the streaming batch executor (bounded device "
+                         "memory; plan/streaming.py)")
     args = ap.parse_args()
+    if args.stream:
+        os.environ["BODO_TPU_STREAM_EXEC"] = "1"
+        if args.mesh is None:
+            # streaming v1 is single-shard; a larger mesh would silently
+            # measure the whole-table path instead
+            args.mesh = 1
+        elif args.mesh > 1:
+            print("warning: --stream only engages on a 1-device mesh; "
+                  f"--mesh {args.mesh} will run the whole-table path",
+                  file=sys.stderr)
     n_rows = 200_000 if args.quick else (args.rows or 20_000_000)
 
     use_cpu = args.cpu

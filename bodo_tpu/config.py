@@ -41,6 +41,12 @@ class Config:
     streaming_batch_size: int = field(
         default_factory=lambda: _env_int("BODO_TPU_STREAMING_BATCH_SIZE", 1 << 22)
     )
+    # Streaming batch executor: batch-at-a-time pipelines with bounded
+    # device memory (plan/streaming.py). Off by default; whole-table
+    # execution is faster when everything fits in device memory.
+    stream_exec: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_STREAM_EXEC", False)
+    )
     # Pad table capacities up to a multiple of this (TPU lane friendliness).
     capacity_round: int = field(
         default_factory=lambda: _env_int("BODO_TPU_CAPACITY_ROUND", 128)

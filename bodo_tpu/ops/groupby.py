@@ -205,20 +205,12 @@ def _var_from_m2(m2, cnt, ddof: int = 1):
     return jnp.where(cnt > ddof, jnp.maximum(var, 0), jnp.nan)
 
 
-@partial(jax.jit, static_argnames=("specs", "out_capacity", "num_keys"))
-def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
-                  num_keys: int):
-    """Local (single-shard) groupby.
-
-    arrays: tuple of (data, valid) — first `num_keys` are key columns, the
-    rest align 1:1 with `specs` (one value column per agg op; repeat the
-    column for multiple aggs on it).
-    Returns (out_keys, out_vals, n_groups); outputs sorted by key ascending
-    (pandas groupby sort=True).
-    """
+def _groupby_local_impl(arrays, count, specs: Tuple[str, ...],
+                        out_capacity: int, num_keys: int, row_valid=None):
     keys = arrays[:num_keys]
     values = arrays[num_keys:]
-    perm, seg, new_group, padmask_s, n_groups = _group_segments(keys, count)
+    perm, seg, new_group, padmask_s, n_groups = _group_segments(
+        keys, count, row_valid)
 
     out_keys = []
     idx_scatter = jnp.where(new_group, seg, out_capacity)
@@ -260,6 +252,55 @@ def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
             out_vals.append(_segment_agg(op, v_s, valid_s, seg, padmask_s,
                                          out_capacity))
     return tuple(out_keys), tuple(out_vals), n_groups
+
+
+@partial(jax.jit, static_argnames=("specs", "out_capacity", "num_keys"))
+def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
+                  num_keys: int):
+    """Local (single-shard) groupby.
+
+    arrays: tuple of (data, valid) — first `num_keys` are key columns, the
+    rest align 1:1 with `specs` (one value column per agg op; repeat the
+    column for multiple aggs on it).
+    Returns (out_keys, out_vals, n_groups); outputs sorted by key ascending
+    (pandas groupby sort=True), packed at the front of the capacity.
+    """
+    return _groupby_local_impl(arrays, count, specs, out_capacity, num_keys)
+
+
+@partial(jax.jit, static_argnames=("specs", "out_capacity", "num_keys"))
+def groupby_merge(state_arrays, batch_arrays, n_state, n_batch,
+                  specs: Tuple[str, ...], out_capacity: int, num_keys: int):
+    """Merge two packed partial-aggregate blocks (streaming accumulate).
+
+    Both inputs are groupby outputs (live rows packed at the front):
+    `state_arrays` holds the running partial state (n_state groups),
+    `batch_arrays` the latest batch's partials (n_batch groups). Columns
+    are concatenated and re-grouped under `specs` (the combine ops), so
+    the result is again a packed partial block. This is the streaming
+    groupby's accumulate step (reference analogue: the streaming groupby
+    build state update, bodo/libs/streaming/_groupby.cpp
+    GroupbyState::UpdateGroupsAndCombine)."""
+    state_cap = state_arrays[0][0].shape[0]
+    batch_cap = batch_arrays[0][0].shape[0]
+    mask = jnp.concatenate([jnp.arange(state_cap) < n_state,
+                            jnp.arange(batch_cap) < n_batch])
+
+    def cat(sv, bv):
+        s_d, s_v = sv
+        b_d, b_v = bv
+        d = jnp.concatenate([s_d, b_d.astype(s_d.dtype)])
+        if s_v is None and b_v is None:
+            v = None
+        else:
+            ones_s = jnp.ones(state_cap, bool) if s_v is None else s_v
+            ones_b = jnp.ones(batch_cap, bool) if b_v is None else b_v
+            v = jnp.concatenate([ones_s, ones_b])
+        return (d, v)
+
+    merged = tuple(cat(s, b) for s, b in zip(state_arrays, batch_arrays))
+    return _groupby_local_impl(merged, None, specs, out_capacity, num_keys,
+                               row_valid=mask)
 
 
 def _nunique(keys, value, perm, seg, padmask_s, out_cap: int):

@@ -65,7 +65,27 @@ def _exec(node: L.Node) -> Table:
     return t
 
 
+def apply_projection(t: Table, exprs) -> Table:
+    """Evaluate a Projection node's exprs on a table (shared with the
+    streaming executor's per-batch project stage)."""
+    from bodo_tpu.plan.expr import ColRef
+    new = {}
+    names = []
+    for n, e in exprs:
+        names.append(n)
+        if not (isinstance(e, ColRef) and e.name == n):
+            new[n] = e
+    t = R.assign_columns(t, new) if new else t
+    return t.select(names)
+
+
 def _exec_inner(node: L.Node) -> Table:
+    if config.stream_exec and isinstance(node, (L.Aggregate, L.Reduce,
+                                                L.Sort)):
+        from bodo_tpu.plan import streaming
+        out = streaming.try_stream_execute(node)
+        if out is not None:
+            return out
     if isinstance(node, L.ReadParquet):
         from bodo_tpu.io import read_parquet
         log(1, f"read_parquet({node.path}) columns={node.columns}")
@@ -78,16 +98,7 @@ def _exec_inner(node: L.Node) -> Table:
     if isinstance(node, L.FromPandas):
         return _maybe_shard(node.table)
     if isinstance(node, L.Projection):
-        child = _exec(node.child)
-        from bodo_tpu.plan.expr import ColRef
-        new = {}
-        names = []
-        for n, e in node.exprs:
-            names.append(n)
-            if not (isinstance(e, ColRef) and e.name == n):
-                new[n] = e
-        t = R.assign_columns(child, new) if new else child
-        return t.select(names)
+        return apply_projection(_exec(node.child), node.exprs)
     if isinstance(node, L.Filter):
         return R.filter_table(_exec(node.child), node.predicate)
     if isinstance(node, L.Aggregate):

@@ -1,0 +1,580 @@
+"""Streaming batch executor: batch-at-a-time pipelines with bounded
+device memory.
+
+TPU-native redesign of the reference's streaming execution model
+(reference: bodo/pandas/_pipeline.h:106 Pipeline, _executor.h:76 Executor,
+physical/operator.h:46 the ConsumeBatch/ProduceBatch operator protocol,
+bodo/libs/streaming/_groupby.cpp GroupbyState). The C++ pull-pipeline with
+NEED_MORE_INPUT/HAVE_MORE_OUTPUT states becomes a host-driven Python loop
+over fixed-capacity device batches:
+
+  - sources yield REP Tables padded to ONE static capacity, so every
+    per-batch kernel (filter/project/join-probe/partial-agg) compiles
+    once and is reused for the whole stream;
+  - blocking operators accumulate packed *partial* state on device
+    (groupby) or park batches in the native host buffer pool
+    (runtime/offload.py) where they are spillable to disk (sort, join
+    build sides) — device memory stays O(batch + state), not O(rows);
+  - string columns ride a *running* unified dictionary so codes stay
+    comparable across batches (the reference's dict-builder unification,
+    bodo/libs/_dict_builder.cpp); accumulated state is re-coded on the
+    rare batch that introduces new strings.
+
+Capacities that vary at runtime (filter survivors, join fan-out) are
+re-bucketed to powers of two so the compile count stays logarithmic.
+
+v1 scope: single-shard (REP) streams — the multi-device path continues to
+use the whole-table shard_map operators; streaming+shuffle overlap is the
+async-shuffle milestone.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu import relational as R
+from bodo_tpu.config import config
+from bodo_tpu.ops.groupby import groupby_local, groupby_merge, result_dtype
+from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.parallel.shuffle import _finalize, _plan_decomposition
+from bodo_tpu.plan import logical as L
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.table.table import (Column, REP, Table, round_capacity)
+from bodo_tpu.utils.logging import log
+
+
+def _bucket_cap(n: int) -> int:
+    """Round capacity to a power of two (min 128) so streaming stages see
+    a logarithmic number of distinct shapes."""
+    c = 128
+    while c < n:
+        c <<= 1
+    return c
+
+
+def _with_capacity(t: Table, cap: int) -> Table:
+    """Re-capacity a packed REP table (slice down / zero-pad up)."""
+    if cap == t.capacity:
+        return t
+    assert cap >= t.nrows, (cap, t.nrows)
+    cols: Dict[str, Column] = {}
+    for n, c in t.columns.items():
+        if cap <= c.capacity:
+            d = c.data[:cap]
+            v = c.valid[:cap] if c.valid is not None else None
+        else:
+            pad = cap - c.capacity
+            d = jnp.concatenate(
+                [c.data, jnp.zeros((pad,), dtype=c.data.dtype)])
+            v = None if c.valid is None else jnp.concatenate(
+                [c.valid, jnp.zeros((pad,), dtype=bool)])
+        cols[n] = Column(d, v, c.dtype, c.dictionary)
+    return Table(cols, t.nrows, REP, None)
+
+
+# ---------------------------------------------------------------------------
+# running-dictionary tracker
+# ---------------------------------------------------------------------------
+
+class DictTracker:
+    """Per-column running dictionaries for a stream.
+
+    Re-encodes each batch's string columns onto the running (sorted,
+    unioned) dictionary; the dictionary OBJECT stays stable while no new
+    strings appear, which keeps downstream kernel caches warm."""
+
+    def __init__(self):
+        self._dicts: Dict[str, np.ndarray] = {}
+
+    def current(self, name: str) -> Optional[np.ndarray]:
+        return self._dicts.get(name)
+
+    def absorb(self, t: Table) -> Table:
+        cols = dict(t.columns)
+        for name, c in t.columns.items():
+            if c.dictionary is None:
+                continue
+            run = self._dicts.get(name)
+            if run is None:
+                self._dicts[name] = c.dictionary
+                continue
+            if c.dictionary is run:
+                continue
+            union = np.union1d(run, c.dictionary)
+            if len(union) == len(run):
+                union = run  # no new strings: keep the stable object
+            else:
+                self._dicts[name] = union
+            cols[name] = remap_codes(c, union)
+        return Table(cols, t.nrows, REP, None)
+
+
+def remap_codes(c: Column, new_dict: np.ndarray) -> Column:
+    """Re-encode a string column's codes onto a superset dictionary."""
+    old = c.dictionary if c.dictionary is not None else np.array([], str)
+    if new_dict is old:
+        return c
+    lut = np.searchsorted(new_dict, old).astype(np.int32)
+    mp = jnp.asarray(lut if len(lut) else np.zeros(1, np.int32))
+    data = mp[jnp.clip(c.data, 0, max(len(old) - 1, 0))]
+    return Column(data, c.valid, c.dtype, new_dict)
+
+
+# ---------------------------------------------------------------------------
+# batch sources
+# ---------------------------------------------------------------------------
+
+def parquet_batches(path: str, columns: Optional[Sequence[str]],
+                    batch_rows: int) -> Iterator[Table]:
+    """Stream a parquet dataset as fixed-capacity REP Tables (the
+    reference's ArrowReader streaming read, bodo/io/arrow_reader.h:170)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from bodo_tpu.io.arrow_bridge import arrow_to_table
+    from bodo_tpu.io.parquet import _dataset_files
+
+    cap = round_capacity(batch_rows)
+    tracker = DictTracker()
+    cols = list(columns) if columns else None
+    pending: List[pa.RecordBatch] = []
+    pending_rows = 0
+
+    def flush() -> Table:
+        nonlocal pending, pending_rows
+        at = pa.Table.from_batches(pending[:])
+        pending, pending_rows = [], 0
+        return tracker.absorb(arrow_to_table(at, capacity=cap))
+
+    for f in _dataset_files(path):
+        pf = pq.ParquetFile(f)
+        for rb in pf.iter_batches(batch_size=batch_rows, columns=cols):
+            pending.append(rb)
+            pending_rows += rb.num_rows
+            while pending_rows >= batch_rows:
+                # split off exactly batch_rows
+                at = pa.Table.from_batches(pending)
+                head = at.slice(0, batch_rows)
+                tail = at.slice(batch_rows)
+                pending = tail.to_batches() if tail.num_rows else []
+                pending_rows = tail.num_rows
+                yield tracker.absorb(arrow_to_table(head, capacity=cap))
+    if pending_rows:
+        yield flush()
+
+
+def table_batches(t: Table, batch_rows: int) -> Iterator[Table]:
+    """Slice an in-memory REP table into fixed-capacity batches (static
+    Python slice bounds, so every batch shares one compiled shape)."""
+    assert t.distribution == REP
+    cap = round_capacity(batch_rows)
+    n = t.nrows
+
+    def slice_pad(a, off):
+        piece = a[off:min(off + cap, a.shape[0])]
+        if piece.shape[0] < cap:
+            piece = jnp.concatenate(
+                [piece, jnp.zeros((cap - piece.shape[0],), piece.dtype)])
+        return piece
+
+    for off in range(0, max(n, 1), batch_rows):
+        take = max(0, min(batch_rows, n - off))
+        cols: Dict[str, Column] = {}
+        for name, c in t.columns.items():
+            cols[name] = Column(
+                slice_pad(c.data, off),
+                slice_pad(c.valid, off) if c.valid is not None else None,
+                c.dtype, c.dictionary)
+        yield Table(cols, take, REP, None)
+        if n == 0:
+            break
+
+
+# ---------------------------------------------------------------------------
+# blocking operators
+# ---------------------------------------------------------------------------
+
+class GroupbyAccumulator:
+    """Streaming groupby: per-batch local partial aggregation merged into
+    a packed device state (reference: GroupbyState::UpdateGroupsAndCombine,
+    bodo/libs/streaming/_groupby.cpp). State is O(distinct groups)."""
+
+    def __init__(self, keys: Sequence[str], aggs: Sequence[Tuple]):
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        specs = tuple(op for _, op, _ in aggs)
+        self.partial_specs, self.combine_specs, self.layout = \
+            _plan_decomposition(specs)
+        # parts per agg (layout is contiguous per spec)
+        self._nparts = [len(_plan_decomposition((op,))[0])
+                        for _, op, _ in self.aggs]
+        self.state: Optional[Table] = None  # keys + __p{i} partial cols
+        self.n_state = 0
+        self._template: Optional[Table] = None  # schema source (first batch)
+
+    def _partial_names(self) -> List[str]:
+        return [f"__p{i}" for i in range(len(self.partial_specs))]
+
+    def push(self, batch: Table) -> None:
+        nk = len(self.keys)
+        if self._template is None:
+            self._template = batch
+        arrays = tuple((batch.column(k).data, batch.column(k).valid)
+                       for k in self.keys)
+        arrays += tuple(
+            (batch.column(c).data, batch.column(c).valid)
+            for (c, _, _), np_ in zip(self.aggs, self._nparts)
+            for _ in range(np_))
+        pk, pv, ng = groupby_local(arrays, jnp.asarray(batch.nrows),
+                                   self.partial_specs, batch.capacity, nk)
+        ng_b = int(ng)
+        if ng_b == 0 and self.state is not None:
+            return
+        partial = self._as_state_table(batch, pk, pv, ng_b)
+        partial = _with_capacity(partial, _bucket_cap(max(ng_b, 1)))
+
+        if self.state is None:
+            self.state = partial
+            self.n_state = ng_b
+            return
+
+        # re-code state onto any grown dictionaries before merging
+        state = self.state
+        cols = dict(state.columns)
+        changed = False
+        for name, c in state.columns.items():
+            bdict = partial.columns[name].dictionary
+            if c.dictionary is not None and bdict is not None and \
+                    c.dictionary is not bdict:
+                cols[name] = remap_codes(c, bdict)
+                changed = True
+        if changed:
+            state = Table(cols, state.nrows, REP, None)
+
+        needed = self.n_state + ng_b
+        out_cap = _bucket_cap(max(needed, state.capacity))
+        s_arrays = tuple((state.column(n).data, state.column(n).valid)
+                         for n in state.names)
+        b_arrays = tuple((partial.column(n).data, partial.column(n).valid)
+                         for n in state.names)
+        mk, mv, ng2 = groupby_merge(s_arrays, b_arrays,
+                                    jnp.asarray(self.n_state),
+                                    jnp.asarray(ng_b),
+                                    self.combine_specs, out_cap, nk)
+        self.n_state = int(ng2)
+        names = state.names
+        cols = {}
+        for name, (d, v) in zip(names[:nk], mk):
+            src = state.columns[name]
+            cols[name] = Column(d, v, src.dtype, src.dictionary)
+        for name, (d, v) in zip(names[nk:], mv):
+            src = state.columns[name]
+            cols[name] = Column(d, v, src.dtype, src.dictionary)
+        st = Table(cols, self.n_state, REP, None)
+        # shrink once occupancy drops far below capacity (keeps merge cost
+        # proportional to the true group count)
+        tight = _bucket_cap(max(self.n_state, 1))
+        if tight * 2 <= st.capacity:
+            st = _with_capacity(st, tight)
+        self.state = st
+
+    def _as_state_table(self, batch: Table, pk, pv, ng: int) -> Table:
+        cols: Dict[str, Column] = {}
+        for name, (d, v) in zip(self.keys, pk):
+            src = batch.column(name)
+            cols[name] = Column(d, v, src.dtype, src.dictionary)
+        pi = 0
+        for (cname, op, _), nparts in zip(self.aggs, self._nparts):
+            src = batch.column(cname)
+            for j in range(nparts):
+                pop = self.partial_specs[pi]
+                d, v = pv[pi]
+                if pop in ("min", "max", "first", "last"):
+                    pdt, pdic = src.dtype, src.dictionary
+                else:
+                    pdt = dt.from_numpy(result_dtype(pop, src.dtype.numpy))
+                    pdic = None
+                cols[self._partial_names()[pi]] = Column(d, v, pdt, pdic)
+                pi += 1
+        return Table(cols, ng, REP, None)
+
+    def finish(self) -> Table:
+        nk = len(self.keys)
+        # push() sets state on the first batch (even an all-padding one);
+        # a truly batch-less stream is filtered by try_stream_execute
+        assert self.state is not None
+        state = self.state
+        names = state.names
+        pcols = [state.columns[n] for n in names[nk:]]
+        finals = []
+        for i, (cname, op, oname) in enumerate(self.aggs):
+            off, n = self.layout[i]
+            cols_in = tuple((pcols[off + j].data, pcols[off + j].valid)
+                            for j in range(n))
+            src_dt = self._template.column(cname).dtype
+            d, v = _finalize(op, cols_in, jnp.dtype(src_dt.numpy))
+            rdt = src_dt if op in ("min", "max", "first", "last") \
+                else dt.from_numpy(result_dtype(op, src_dt.numpy))
+            dic = pcols[off].dictionary if rdt is dt.STRING else None
+            finals.append((oname, Column(d, v, rdt, dic)))
+        out: Dict[str, Column] = {n: state.columns[n] for n in names[:nk]}
+        for oname, col in finals:
+            out[oname] = col
+        return Table(out, self.n_state, REP, None)
+
+
+class ReduceAccumulator:
+    """Streaming whole-column reductions: per-batch device partials, Chan
+    pairwise combine on host (reference: the streaming accumulate path of
+    groupby with no keys)."""
+
+    _SUPPORTED = {"sum", "sumnull", "count", "size", "min", "max", "mean",
+                  "var", "std", "var0", "std0", "prod"}
+
+    def __init__(self, aggs: Sequence[Tuple[str, str, str]]):
+        for _, op, _ in aggs:
+            if op not in self._SUPPORTED:
+                raise NotImplementedError(op)
+        self.aggs = list(aggs)
+        self.moments: Dict[int, List] = {}   # i -> [n, s, m2]
+        self.scalars: Dict[int, object] = {}
+        self._template: Optional[Table] = None
+
+    def push(self, batch: Table) -> None:
+        if self._template is None:
+            self._template = batch
+        req = []
+        for i, (col, op, _) in enumerate(self.aggs):
+            if op in ("mean", "var", "std", "var0", "std0"):
+                req += [(col, "sum", f"s{i}"), (col, "count", f"c{i}"),
+                        (col, "var0", f"v{i}")]
+            elif op in ("sumnull", "min", "max"):
+                req += [(col, op, f"x{i}"), (col, "count", f"c{i}")]
+            else:
+                req += [(col, op, f"x{i}")]
+        out = R.reduce_table(batch, req)
+        for i, (col, op, _) in enumerate(self.aggs):
+            if op in ("mean", "var", "std", "var0", "std0"):
+                n_b = out[f"c{i}"]
+                if not n_b:
+                    continue
+                s_b = float(out[f"s{i}"])
+                m2_b = float(out[f"v{i}"]) * n_b  # var0 ⇒ m2 = var·n
+                m = self.moments.get(i)
+                if m is None:
+                    self.moments[i] = [n_b, s_b, m2_b]
+                else:
+                    n_a, s_a, m2_a = m
+                    n_ab = n_a + n_b
+                    delta = s_b / n_b - s_a / n_a
+                    m2 = m2_a + m2_b + delta * delta * n_a * n_b / n_ab
+                    self.moments[i] = [n_ab, s_a + s_b, m2]
+            else:
+                cur = self.scalars.get(i)
+                v = out[f"x{i}"]
+                if op in ("sumnull", "min", "max"):
+                    if not out[f"c{i}"]:  # all-null batch contributes nothing
+                        continue
+                if cur is None:
+                    self.scalars[i] = v
+                elif op in ("sum", "sumnull"):
+                    self.scalars[i] = cur + v
+                elif op in ("count", "size"):
+                    self.scalars[i] = cur + v
+                elif op == "min":
+                    self.scalars[i] = min(cur, v)
+                elif op == "max":
+                    self.scalars[i] = max(cur, v)
+                elif op == "prod":
+                    self.scalars[i] = cur * v
+
+    def finish(self) -> Dict:
+        res = {}
+        for i, (col, op, oname) in enumerate(self.aggs):
+            if op in ("mean", "var", "std", "var0", "std0"):
+                m = self.moments.get(i)
+                if m is None:
+                    res[oname] = np.nan
+                    continue
+                n, s, m2 = m
+                if op == "mean":
+                    res[oname] = s / n
+                else:
+                    ddof = 0 if op.endswith("0") else 1
+                    if n > ddof:
+                        v = max(m2 / (n - ddof), 0.0)
+                        res[oname] = float(np.sqrt(v)) \
+                            if op.startswith("std") else v
+                    else:
+                        res[oname] = np.nan
+            else:
+                v = self.scalars.get(i)
+                if v is None:
+                    if op in ("count", "size"):
+                        v = 0
+                    elif op == "prod":
+                        v = 1.0
+                    else:
+                        v = np.nan
+                res[oname] = v
+        return res
+
+
+class SortAccumulator:
+    """Streaming sort input: batches park in the native host pool
+    (spillable) during accumulation; the sort itself runs on the restored
+    whole table (device peak during accumulate is O(batch))."""
+
+    def __init__(self, by, ascending, na_last: bool):
+        from bodo_tpu.runtime.offload import offload_table
+        self._offload = offload_table
+        self.by, self.ascending, self.na_last = by, ascending, na_last
+        self.parts: List = []
+
+    def push(self, batch: Table) -> None:
+        if batch.nrows:
+            self.parts.append(self._offload(
+                _with_capacity(batch, _bucket_cap(max(batch.nrows, 1)))))
+
+    def finish(self) -> Table:
+        assert self.parts, "empty stream — caller must fall back"
+        tables = [p.restore() for p in self.parts]
+        self.parts = []
+        t = R.concat_tables(tables) if len(tables) > 1 else tables[0]
+        return R.sort_table(t, self.by, self.ascending, self.na_last)
+
+
+class StreamJoin:
+    """Per-batch probe against a fully-built (offloaded) build side —
+    the reference's streaming hash join with the build table parked in
+    the buffer pool (bodo/libs/streaming/_join.cpp HashJoinState)."""
+
+    def __init__(self, build: Table, left_on, right_on, how, suffixes):
+        from bodo_tpu.runtime.offload import offload_table
+        self.left_on, self.right_on = left_on, right_on
+        self.how, self.suffixes = how, suffixes
+        self._off = offload_table(build.gather()
+                                  if build.distribution != REP else build)
+        self._build: Optional[Table] = None
+
+    def __call__(self, batch: Table) -> Table:
+        if self._build is None:
+            self._build = self._off.restore()
+        out = R.join_tables(batch, self._build, self.left_on, self.right_on,
+                            self.how, self.suffixes)
+        return _with_capacity(out, _bucket_cap(max(out.nrows, 1)))
+
+
+# ---------------------------------------------------------------------------
+# plan → stream compilation
+# ---------------------------------------------------------------------------
+
+def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
+    """Compile a plan subtree into a batch iterator, or None if any node
+    is not streamable."""
+    batch_rows = config.streaming_batch_size
+
+    if isinstance(node, L.ReadParquet):
+        return parquet_batches(node.path, node.columns, batch_rows)
+    if isinstance(node, L.FromPandas):
+        if node.table.distribution != REP:
+            return None
+        return table_batches(node.table, batch_rows)
+    if isinstance(node, L.Filter):
+        inner = _build_stream(node.child)
+        if inner is None:
+            return None
+        pred = node.predicate
+
+        def gen_filter(src):
+            for b in src:
+                yield R.filter_table(b, pred)
+        return gen_filter(inner)
+    if isinstance(node, L.Projection):
+        inner = _build_stream(node.child)
+        if inner is None:
+            return None
+        from bodo_tpu.plan.physical import apply_projection
+        exprs = node.exprs
+
+        def gen_project(src):
+            for b in src:
+                yield apply_projection(b, exprs)
+        return gen_project(inner)
+    if isinstance(node, L.Join):
+        inner = _build_stream(node.left)
+        if inner is None:
+            return None
+        from bodo_tpu.plan import physical
+        build = physical._exec(node.right)
+        try:
+            join = StreamJoin(build, node.left_on, node.right_on,
+                              node.how, node.suffixes)
+        except RuntimeError as e:
+            # native host pool unavailable (no C++ toolchain): whole-table
+            # fallback is correct, just not memory-bounded
+            log(1, f"stream join disabled, falling back: {e}")
+            return None
+
+        def gen_join(src):
+            for b in src:
+                yield join(b)
+        return gen_join(inner)
+    return None
+
+
+def try_stream_execute(node: L.Node) -> Optional[Table]:
+    """Execute a plan with the streaming batch executor when its shape
+    supports it; None → caller falls back to whole-table execution."""
+    if not config.stream_exec or mesh_mod.num_shards() > 1:
+        return None
+
+    if isinstance(node, L.Aggregate):
+        src = _build_stream(node.child)
+        if src is None:
+            return None
+        try:
+            acc = GroupbyAccumulator(node.keys, node.aggs)
+        except NotImplementedError:
+            return None
+        nb = 0
+        for b in src:
+            acc.push(b)
+            nb += 1
+        if acc._template is None:
+            return None  # empty stream: no schema source — fall back
+        log(1, f"streaming groupby: {nb} batches, "
+               f"{acc.n_state} groups")
+        return acc.finish()
+
+    if isinstance(node, L.Reduce):
+        src = _build_stream(node.child)
+        if src is None:
+            return None
+        try:
+            acc = ReduceAccumulator(node.aggs)
+        except NotImplementedError:
+            return None
+        for b in src:
+            acc.push(b)
+        scalars = acc.finish()
+        import pandas as pd
+        return Table.from_pandas(
+            pd.DataFrame({k: [v] for k, v in scalars.items()}))
+
+    if isinstance(node, L.Sort):
+        src = _build_stream(node.child)
+        if src is None:
+            return None
+        acc = SortAccumulator(node.by, node.ascending, node.na_last)
+        for b in src:
+            acc.push(b)
+        if not acc.parts:
+            return None  # empty stream: fall back (handles the 0-row case)
+        return acc.finish()
+
+    return None

@@ -19,18 +19,40 @@ import numpy as np
 from bodo_tpu.table.table import Column
 
 
+# memoize unions by input-dictionary identity: kernel caches fingerprint
+# dictionaries by object id, so the same union computed per streaming batch
+# must return the SAME array object every time, or every batch misses the
+# jit cache (same join executed once per batch)
+_union_cache: dict = {}
+_UNION_CACHE_MAX = 512
+
+
+def _cached_union(dicts: List[np.ndarray]) -> np.ndarray:
+    key = tuple(id(d) for d in dicts)
+    hit = _union_cache.get(key)
+    if hit is not None and all(a is b for a, b in zip(hit[0], dicts)):
+        return hit[1]
+    union = dicts[0]
+    for d in dicts[1:]:
+        union = np.union1d(union, d)
+    # prefer an existing object when the union adds nothing
+    for d in dicts:
+        if len(d) == len(union) and np.array_equal(d, union):
+            union = d
+            break
+    if len(_union_cache) >= _UNION_CACHE_MAX:
+        _union_cache.pop(next(iter(_union_cache)))
+    _union_cache[key] = (list(dicts), union)  # hold refs so ids stay valid
+    return union
+
+
 def unify_dictionaries(cols: Sequence[Column]) -> Tuple[np.ndarray, List[Column]]:
     """Re-encode string columns onto a shared sorted dictionary.
 
     Returns (union_dictionary, new columns with remapped codes)."""
     dicts = [c.dictionary if c.dictionary is not None
              else np.array([], dtype=str) for c in cols]
-    if len(dicts) > 1:
-        union = dicts[0]
-        for d in dicts[1:]:
-            union = np.union1d(union, d)
-    else:
-        union = dicts[0]
+    union = _cached_union(dicts) if len(dicts) > 1 else dicts[0]
     out = []
     for c, d in zip(cols, dicts):
         if len(d) == len(union) and (len(d) == 0 or np.array_equal(d, union)):

@@ -1,0 +1,194 @@
+"""Streaming batch executor tests (plan/streaming.py): correctness vs
+pandas, bounded device memory as rows grow, dictionary growth across
+batches, and host-pool offload of blocking-operator state.
+
+Reference strategy analogue: the reference tests its streaming operators
+by comparing the streaming pipeline against whole-table pandas results
+(bodo/tests/test_stream_groupby.py, test_stream_join.py)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import bodo_tpu
+from bodo_tpu.config import config, set_config
+
+
+@pytest.fixture
+def stream_mode(mesh8):
+    """1-device mesh + streaming executor with small batches."""
+    import jax
+    old_mesh = bodo_tpu.parallel.mesh.get_mesh()
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(jax.devices()[:1]))
+    old = (config.stream_exec, config.streaming_batch_size)
+    set_config(stream_exec=True, streaming_batch_size=1000)
+    yield
+    set_config(stream_exec=old[0], streaming_batch_size=old[1])
+    bodo_tpu.set_mesh(old_mesh)
+
+
+def _taxi_df(n, seed=0):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "k": r.integers(0, 40, n),
+        "cat": r.choice(["aa", "bb", "cc", "dd"], n),
+        "v": r.normal(size=n),
+        "w": r.integers(0, 100, n).astype(np.int32),
+    })
+    df.loc[r.random(n) < 0.05, "v"] = np.nan
+    return df
+
+
+def _streamed_pushes(monkeypatch):
+    """Count GroupbyAccumulator.push calls to prove the streaming path ran."""
+    from bodo_tpu.plan import streaming
+    calls = []
+    orig = streaming.GroupbyAccumulator.push
+
+    def wrapper(self, b):
+        calls.append(b.nrows)
+        return orig(self, b)
+    monkeypatch.setattr(streaming.GroupbyAccumulator, "push", wrapper)
+    return calls
+
+
+def test_stream_groupby_vs_pandas(stream_mode, monkeypatch):
+    import bodo_tpu.pandas_api as bd
+    calls = _streamed_pushes(monkeypatch)
+    df = _taxi_df(10_000)
+    bdf = bd.from_pandas(df)
+    got = (bdf[bdf["w"] > 10].groupby(["k", "cat"], as_index=False)
+           .agg(sv=("v", "sum"), mv=("v", "mean"), sd=("v", "std"),
+                c=("v", "count"), mx=("w", "max"))
+           ).to_pandas().sort_values(["k", "cat"]).reset_index(drop=True)
+    exp = (df[df["w"] > 10].groupby(["k", "cat"], as_index=False)
+           .agg(sv=("v", "sum"), mv=("v", "mean"), sd=("v", "std"),
+                c=("v", "count"), mx=("w", "max"))
+           ).sort_values(["k", "cat"]).reset_index(drop=True)
+    assert len(calls) >= 9  # really batch-at-a-time
+    assert got["k"].tolist() == exp["k"].tolist()
+    assert got["cat"].tolist() == exp["cat"].tolist()
+    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-12)
+    np.testing.assert_allclose(got["sd"], exp["sd"], rtol=1e-12)
+    assert got["c"].tolist() == exp["c"].tolist()
+    assert got["mx"].tolist() == exp["mx"].tolist()
+
+
+def test_stream_parquet_join_groupby(stream_mode, tmp_path, monkeypatch):
+    import bodo_tpu.pandas_api as bd
+    calls = _streamed_pushes(monkeypatch)
+    df = _taxi_df(8_000, seed=1)
+    pq.write_table(pa.Table.from_pandas(df), str(tmp_path / "d.parquet"),
+                   row_group_size=1500)
+    right = pd.DataFrame({"k": np.arange(40), "z": np.arange(40) * 0.5})
+
+    bdf = bd.read_parquet(str(tmp_path / "d.parquet"))
+    j = bdf.merge(bd.from_pandas(right), on="k")
+    got = (j[j["v"] > -1.0].groupby(["k", "cat"], as_index=False)
+           .agg(sv=("v", "sum"), mz=("z", "mean"))
+           ).to_pandas().sort_values(["k", "cat"]).reset_index(drop=True)
+    exp = (df.merge(right, on="k").pipe(lambda d: d[d["v"] > -1.0])
+           .groupby(["k", "cat"], as_index=False)
+           .agg(sv=("v", "sum"), mz=("z", "mean"))
+           ).sort_values(["k", "cat"]).reset_index(drop=True)
+    assert len(calls) >= 7
+    assert got["k"].tolist() == exp["k"].tolist()
+    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-9)
+    np.testing.assert_allclose(got["mz"], exp["mz"], rtol=1e-9)
+
+
+def test_stream_bounded_device_memory(stream_mode, tmp_path, monkeypatch):
+    """Peak live device bytes must stay ~constant as input rows grow —
+    the larger-than-HBM execution property (VERDICT round-1 item 2)."""
+    import jax
+
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import physical, streaming
+
+    orig = streaming.GroupbyAccumulator.push
+
+    def run(n):
+        df = pd.DataFrame({"k": np.arange(n) % 64, "v": np.ones(n)})
+        pq.write_table(pa.Table.from_pandas(df),
+                       str(tmp_path / f"m{n}.parquet"), row_group_size=2000)
+        physical._result_cache.clear()
+        peak = [0]
+
+        def track(self, b):
+            orig(self, b)
+            live = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in jax.live_arrays())
+            peak[0] = max(peak[0], live)
+        monkeypatch.setattr(streaming.GroupbyAccumulator, "push", track)
+        out = (bd.read_parquet(str(tmp_path / f"m{n}.parquet"))
+               .groupby("k", as_index=False).agg(s=("v", "sum"))).to_pandas()
+        monkeypatch.setattr(streaming.GroupbyAccumulator, "push", orig)
+        assert len(out) == 64 and abs(out["s"].sum() - n) < 1e-6
+        return peak[0]
+
+    p1 = run(20_000)
+    p2 = run(80_000)
+    assert p2 < p1 * 1.6, (p1, p2)
+
+
+def test_stream_dict_growth_across_batches(stream_mode):
+    """New strings appearing mid-stream must re-code accumulated state."""
+    import bodo_tpu.pandas_api as bd
+    n = 4000  # batch size is 1000: four batches, new strings in each half
+    cat = np.where(np.arange(n) < 2000,
+                   np.array(["m", "a"])[np.arange(n) % 2],
+                   np.array(["z", "b", "q"])[np.arange(n) % 3])
+    df = pd.DataFrame({"cat": cat, "v": np.arange(n, dtype=np.float64)})
+    got = (bd.from_pandas(df).groupby("cat", as_index=False)
+           .agg(s=("v", "sum"), mn=("cat", "min"))
+           ).to_pandas().sort_values("cat").reset_index(drop=True)
+    exp = (df.groupby("cat", as_index=False)
+           .agg(s=("v", "sum"), mn=("cat", "min"))
+           ).sort_values("cat").reset_index(drop=True)
+    assert got["cat"].tolist() == exp["cat"].tolist()
+    np.testing.assert_allclose(got["s"], exp["s"], rtol=1e-12)
+    assert got["mn"].tolist() == exp["mn"].tolist()
+
+
+def test_stream_reduce(stream_mode):
+    import bodo_tpu.pandas_api as bd
+    df = _taxi_df(5_000, seed=2)
+    s = bd.from_pandas(df)["v"]
+    np.testing.assert_allclose(s.sum(), df["v"].sum(), rtol=1e-12)
+    np.testing.assert_allclose(s.mean(), df["v"].mean(), rtol=1e-12)
+    np.testing.assert_allclose(s.std(), df["v"].std(), rtol=1e-12)
+    np.testing.assert_allclose(s.min(), df["v"].min(), rtol=1e-12)
+    assert s.count() == df["v"].count()
+
+
+def test_stream_sort_offloads_via_pool(stream_mode, monkeypatch):
+    """Streaming sort parks batches in the native host pool (spillable)."""
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import streaming
+
+    offloads = []
+    orig = streaming.SortAccumulator.push
+
+    def wrapper(self, b):
+        offloads.append(b.nrows)
+        return orig(self, b)
+    monkeypatch.setattr(streaming.SortAccumulator, "push", wrapper)
+
+    df = _taxi_df(5_000, seed=3)
+    got = bd.from_pandas(df).sort_values(["k", "v"]).to_pandas()
+    exp = df.sort_values(["k", "v"], kind="stable").reset_index(drop=True)
+    assert len(offloads) >= 4  # batches went through the pool
+    assert got["k"].tolist() == exp["k"].tolist()
+    np.testing.assert_allclose(
+        got["v"].fillna(-9e9), exp["v"].fillna(-9e9), rtol=1e-12)
+
+
+def test_stream_empty_input(stream_mode):
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"k": np.array([], dtype=np.int64),
+                       "v": np.array([], dtype=np.float64)})
+    got = (bd.from_pandas(df).groupby("k", as_index=False)
+           .agg(s=("v", "sum"))).to_pandas()
+    assert len(got) == 0

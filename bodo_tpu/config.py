@@ -61,6 +61,20 @@ class Config:
     shuffle_skew_factor: float = field(
         default_factory=lambda: _env_float("BODO_TPU_SHUFFLE_SKEW", 2.0)
     )
+    # Dense (sort-free) groupby: when the exact product of key ranges is at
+    # most this many slots, rows scatter straight into dense slots and all
+    # aggregations are one segment pass (no lax.sort). ~4M slots * 8B * a
+    # few columns of transient dense arrays.
+    dense_groupby_max_slots: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_DENSE_GROUPBY_SLOTS",
+                                         1 << 22)
+    )
+    # Dense-LUT join: build sides whose key-range product is at most this
+    # many slots (and whose keys are unique) join by perfect-hash gather.
+    dense_join_max_slots: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_DENSE_JOIN_SLOTS",
+                                         1 << 22)
+    )
     # Broadcast-join threshold: build side smaller than this many rows is
     # all_gather'd instead of hash-shuffled (analogue of broadcast join,
     # reference bodo/libs/_shuffle.h:153-210).

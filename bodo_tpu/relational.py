@@ -293,12 +293,14 @@ def _range_int(v) -> Optional[int]:
     return None  # floats / NaN / anything else: don't pack
 
 
-def _pack_plan(t: Table, keys: Sequence[str], max_bits: int = 62):
+def _pack_plan(t: Table, keys: Sequence[str], max_bits: int = 62,
+               ranges=None):
     """Packing layout [(name, lo, bits, shift)] or None. One extra code
     per field is reserved for null keys (so dropna still works)."""
     if not config.pack_keys or len(keys) < 2:
         return None
-    ranges = _key_ranges(t, keys)
+    if ranges is None:
+        ranges = _key_ranges(t, keys)
     fields = []
     total = 0
     for k, r in zip(keys, ranges):
@@ -363,7 +365,26 @@ def groupby_agg(t: Table, keys: Sequence[str],
     local = _as_local(t)
     if local is not None:
         return groupby_agg(local, keys, aggs)
-    pack = _pack_plan(t, keys, 62)
+
+    # cheap host gates first: _key_ranges does a blocking device reduce
+    dense_ok = (t.distribution == REP and config.dense_groupby_max_slots > 0
+                and not any(op == "nunique" for _, op, _ in aggs))
+    want_ranges = config.pack_keys and keys and (dense_ok or len(keys) >= 2)
+    ranges = _key_ranges(t, keys) if want_ranges else None
+    if dense_ok and ranges is not None and \
+            all(r is not None for r in ranges):
+        n_slots = 1
+        for lo, hi in ranges:  # python ints: no overflow on wild ranges
+            n_slots *= int(hi) - int(lo) + 1
+            if n_slots > config.dense_groupby_max_slots:
+                break
+        # dense pays a fixed O(n_slots) cost — only worth it when the slot
+        # space isn't much larger than the input
+        if 0 < n_slots <= config.dense_groupby_max_slots and \
+                n_slots <= 2 * max(t.nrows, 1):
+            return _groupby_agg_dense(t, keys, list(aggs), ranges)
+
+    pack = _pack_plan(t, keys, 62, ranges=ranges)
     if pack is not None:
         return _groupby_agg_packed(t, keys, list(aggs), pack)
     specs = tuple(op for _, op, _ in aggs)
@@ -456,6 +477,91 @@ def _groupby_agg_packed(t: Table, keys, aggs, pack) -> Table:
     for _, _, oname in aggs:
         cols[oname] = out.columns[oname]
     return Table(cols, out.nrows, out.distribution, out.counts)
+
+
+def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
+    """Sort-free dense groupby for small key spaces.
+
+    When every key has a host-known range whose exact product K fits the
+    slot budget, rows scatter directly into K dense slots (mixed-radix
+    slot id) and every aggregation is one `segment_*` pass — no lax.sort
+    at all. Group keys are reconstructed from the slot index and compacted
+    ascending (slot order == lexicographic key order). This is the
+    reference's one-pass hash groupby specialized to a perfect hash
+    (reference: bodo/libs/groupby/_groupby.cpp hash-table path; SURVEY §7
+    'dense segment_sum when packed key space is small')."""
+    from bodo_tpu.ops.groupby import _segment_agg
+
+    sizes = tuple(int(hi) - int(lo) + 1 for lo, hi in ranges)
+    los = tuple(int(lo) for lo, _ in ranges)
+    n_slots = 1
+    for s in sizes:
+        n_slots *= s
+    specs = tuple(op for _, op, _ in aggs)
+    val_names = tuple(c for c, _, _ in aggs)
+    names = list(keys) + [c for c in val_names if c not in keys]
+    tsel = t.select(list(dict.fromkeys(names)))
+    key = ("gbdense", _sig(tsel), tuple(keys), tuple(zip(val_names, specs)),
+           sizes, los)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        kn, vn = list(keys), list(val_names)
+
+        def body(tree, count):
+            cap = tree[kn[0]][0].shape[0]
+            padmask = K.row_mask(count, cap)
+            slot = jnp.zeros((cap,), dtype=jnp.int32)
+            for name, lo, size in zip(kn, los, sizes):
+                d, v = tree[name]
+                if v is not None:
+                    padmask = padmask & v
+                if jnp.issubdtype(d.dtype, jnp.floating):
+                    padmask = padmask & ~jnp.isnan(d)
+                code = jnp.clip(d.astype(jnp.int64) - lo, 0, size - 1)
+                slot = slot * np.int32(size) + code.astype(jnp.int32)
+            present = jax.ops.segment_sum(
+                padmask.astype(jnp.int32), slot, num_segments=n_slots) > 0
+            outs = [_segment_agg(op, tree[c][0], tree[c][1], slot, padmask,
+                                 n_slots)
+                    for c, op in zip(vn, specs)]
+            # reconstruct keys from the slot index (mixed-radix decode)
+            rem = jnp.arange(n_slots, dtype=jnp.int32)
+            key_cols = [None] * len(kn)
+            for i in range(len(kn) - 1, -1, -1):
+                code = rem % np.int32(sizes[i])
+                rem = rem // np.int32(sizes[i])
+                key_cols[i] = code.astype(jnp.int64) + np.int64(los[i])
+            vflat, slots_v = _flatten_with_valids(outs)
+            packed, n_groups = K.compact(present,
+                                         tuple(key_cols) + tuple(vflat))
+            out_keys = packed[:len(kn)]
+            out_vals = _rebuild_from_flat(packed[len(kn):], slots_v)
+            return tuple(out_keys), tuple(out_vals), n_groups
+
+        fn = jax.jit(body)
+        _jit_cache[key] = fn
+
+    out_keys, out_vals, ng = fn(tsel.device_data(), jnp.asarray(t.nrows))
+    nrows = int(jax.device_get(ng))
+    cols: Dict[str, Column] = {}
+    for kname, kd in zip(keys, out_keys):
+        src = t.column(kname)
+        if src.dtype is dt.STRING:
+            kd = kd.astype(np.int32)
+        elif src.dtype.kind == "b":
+            kd = kd.astype(bool)
+        elif kd.dtype != src.dtype.numpy:
+            kd = kd.astype(src.dtype.numpy)
+        cols[kname] = Column(kd, None, src.dtype, src.dictionary)
+    from bodo_tpu.ops.groupby import result_dtype
+    for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
+        src = t.column(cname)
+        rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
+        if op in ("min", "max", "first", "last"):
+            rdt = src.dtype
+        cols[oname] = Column(vd, vv, rdt,
+                             src.dictionary if rdt is dt.STRING else None)
+    return shrink_to_fit(Table(cols, nrows, REP, None))
 
 
 # ---------------------------------------------------------------------------
@@ -560,11 +666,123 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
         right = rl
     if left.distribution == REP and right.distribution == ONED:
         left = left.shard()
+    if left.distribution == REP and right.distribution == REP:
+        out = _join_dense_try(left, right, left_on, right_on, how, suffixes)
+        if out is not None:
+            return out
     if left.distribution == ONED and right.distribution == ONED:
         return _join_sharded(left, right, left_on, right_on, how, suffixes)
     if left.distribution == ONED and right.distribution == REP:
         return _join_broadcast(left, right, left_on, right_on, how, suffixes)
     return _join_rep(left, right, left_on, right_on, how, suffixes)
+
+
+def _join_dense_try(left, right, left_on, right_on, how, suffixes
+                    ) -> Optional[Table]:
+    """Dense-LUT equi-join: when the build (right) side's keys have a
+    small host-known range and are unique, the join is a perfect-hash
+    lookup — build scatters row indices into a dense LUT, probe gathers.
+    No sort, no shuffle; output capacity == probe capacity (unique build
+    keys ⇒ ≤1 match per probe row). The dimension-table fast path of the
+    reference's hash join (bodo/libs/_hash_join.cpp build/probe) mapped
+    onto gather/scatter. Returns None when not applicable (caller falls
+    back to the union-segmentation sort join)."""
+    if how not in ("inner", "left") or right.nrows == 0 or \
+            config.dense_join_max_slots <= 0:
+        return None
+    ranges = _key_ranges(right, right_on)
+    if any(r is None for r in ranges):
+        return None
+    sizes = tuple(int(hi) - int(lo) + 1 for lo, hi in ranges)
+    los = tuple(int(lo) for lo, _ in ranges)
+    n_slots = 1
+    for s in sizes:
+        n_slots *= s
+        if n_slots > config.dense_join_max_slots:
+            return None
+    if n_slots > 16 * right.nrows + 1024:
+        return None  # too sparse: LUT cost would dominate
+
+    lorder, rorder, pa, ba = _probe_build_arrays(left, right, left_on,
+                                                 right_on)
+    nk = len(left_on)
+
+    bkey = ("densejoin_build", _sig(right.select(rorder)), sizes, los, nk)
+    bfn = _jit_cache.get(bkey)
+    if bfn is None:
+        def bbody(arrays, count):
+            cap = arrays[0][0].shape[0]
+            mask = K.row_mask(count, cap)
+            slot = jnp.zeros((cap,), dtype=jnp.int32)
+            for (d, v), lo, size in zip(arrays[:nk], los, sizes):
+                if v is not None:
+                    mask = mask & v
+                if jnp.issubdtype(d.dtype, jnp.floating):
+                    mask = mask & ~jnp.isnan(d)
+                code = jnp.clip(d.astype(jnp.int64) - lo, 0, size - 1)
+                slot = slot * np.int32(size) + code.astype(jnp.int32)
+            cnt = jax.ops.segment_sum(mask.astype(jnp.int32),
+                                      slot, num_segments=n_slots)
+            dup = jnp.any(cnt > 1)
+            idx_scatter = jnp.where(mask, slot, n_slots)
+            lut = jnp.full((n_slots,), -1, dtype=jnp.int32)
+            lut = lut.at[idx_scatter].set(
+                jnp.arange(cap, dtype=jnp.int32), mode="drop")
+            return lut, dup
+
+        bfn = jax.jit(bbody)
+        _jit_cache[bkey] = bfn
+
+    lut, dup = bfn(ba, jnp.asarray(right.nrows))
+    if bool(jax.device_get(dup)):
+        return None  # duplicate build keys: not a perfect hash
+
+    pkey = ("densejoin_probe", _sig(left.select(lorder)),
+            _sig(right.select(rorder)), sizes, los, nk, how)
+    pfn = _jit_cache.get(pkey)
+    if pfn is None:
+        def pbody(p_arrays, b_arrays, lut, pcount):
+            cap = p_arrays[0][0].shape[0]
+            mask = K.row_mask(pcount, cap)
+            slot = jnp.zeros((cap,), dtype=jnp.int32)
+            inrange = jnp.ones((cap,), dtype=bool)
+            for (d, v), lo, size in zip(p_arrays[:nk], los, sizes):
+                if v is not None:
+                    mask = mask & v
+                if jnp.issubdtype(d.dtype, jnp.floating):
+                    mask = mask & ~jnp.isnan(d)
+                    inrange = inrange & (d == jnp.floor(d))
+                code = d.astype(jnp.int64) - lo
+                inrange = inrange & (code >= 0) & (code < size)
+                slot = slot * np.int32(size) + \
+                    jnp.clip(code, 0, size - 1).astype(jnp.int32)
+            idx = jnp.where(mask & inrange, lut[slot], -1)
+            hit = idx >= 0
+            safe = jnp.maximum(idx, 0)
+            out_b = []
+            for d, v in b_arrays:
+                od = d[safe]
+                ov = hit if v is None else (hit & v[safe])
+                out_b.append((od, ov))
+            if how == "inner":
+                flat, slots = _flatten_with_valids(
+                    tuple(p_arrays) + tuple(out_b))
+                packed, cnt = K.compact(hit, tuple(flat))
+                rebuilt = _rebuild_from_flat(packed, slots)
+                np_ = len(p_arrays)
+                return (tuple(rebuilt[:np_]), tuple(rebuilt[np_:]), cnt)
+            # left join: keep every probe row; unmatched build cols invalid
+            out_p2 = tuple((d, v) for d, v in p_arrays)
+            return out_p2, tuple(out_b), pcount
+
+        pfn = jax.jit(pbody)
+        _jit_cache[pkey] = pfn
+
+    out_p, out_b, cnt = pfn(pa, ba, lut, jnp.asarray(left.nrows))
+    nrows = int(jax.device_get(cnt))
+    res = _assemble_join(left, right, left_on, right_on, lorder, rorder,
+                         out_p, out_b, nrows, None, how, suffixes)
+    return rebucket(res)
 
 
 def _probe_build_arrays(left, right, left_on, right_on):

@@ -1,0 +1,152 @@
+"""Dense (sort-free) groupby and dense-LUT join fast paths
+(relational._groupby_agg_dense / _join_dense_try): they must fire on
+eligible shapes and agree exactly with the sort-based paths and pandas.
+
+Reference analogue: the specialized hash-table fast paths of
+bodo/libs/groupby/_groupby.cpp and _hash_join.cpp."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bodo_tpu.relational as R
+from bodo_tpu import Table
+from bodo_tpu.config import config, set_config
+
+
+@pytest.fixture
+def one_dev(mesh8):
+    import jax
+
+    import bodo_tpu
+    old = bodo_tpu.parallel.mesh.get_mesh()
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(jax.devices()[:1]))
+    yield
+    bodo_tpu.set_mesh(old)
+
+
+def _df(n=5000, seed=0):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": r.integers(0, 12, n),
+        "b": r.choice(["x", "yy", "z"], n),
+        "flag": r.integers(0, 2, n).astype(bool),
+        "v": r.normal(size=n),
+        "w": r.integers(-50, 50, n).astype(np.int32),
+    })
+    df.loc[r.random(n) < 0.07, "v"] = np.nan
+    return df
+
+
+def test_dense_groupby_fires_and_matches(one_dev, monkeypatch):
+    df = _df()
+    fired = []
+    orig = R._groupby_agg_dense
+
+    def spy(*a, **k):
+        fired.append(1)
+        return orig(*a, **k)
+    monkeypatch.setattr(R, "_groupby_agg_dense", spy)
+
+    aggs = [("v", "sum", "s"), ("v", "mean", "m"), ("v", "std", "sd"),
+            ("v", "count", "c"), ("w", "min", "lo"), ("w", "max", "hi"),
+            ("b", "first", "fb")]
+    got = R.groupby_agg(Table.from_pandas(df), ["a", "b", "flag"], aggs
+                        ).to_pandas()
+    assert fired, "dense groupby did not fire on a small key space"
+    exp = df.groupby(["a", "b", "flag"], as_index=False).agg(
+        s=("v", "sum"), m=("v", "mean"), sd=("v", "std"), c=("v", "count"),
+        lo=("w", "min"), hi=("w", "max"), fb=("b", "first"))
+    got = got.sort_values(["a", "b", "flag"]).reset_index(drop=True)
+    exp = exp.sort_values(["a", "b", "flag"]).reset_index(drop=True)
+    assert got["a"].tolist() == exp["a"].tolist()
+    assert got["b"].tolist() == exp["b"].tolist()
+    np.testing.assert_allclose(got["s"], exp["s"], rtol=1e-9)
+    np.testing.assert_allclose(got["sd"].fillna(-1), exp["sd"].fillna(-1),
+                               rtol=1e-9)
+    assert got["c"].tolist() == exp["c"].tolist()
+    assert got["lo"].tolist() == exp["lo"].tolist()
+    assert got["fb"].tolist() == exp["fb"].tolist()
+
+
+def test_dense_groupby_matches_sort_path(one_dev):
+    df = _df(seed=1)
+    t = Table.from_pandas(df)
+    aggs = [("v", "sum", "s"), ("v", "var", "vv")]
+    dense = R.groupby_agg(t, ["a", "flag"], aggs).to_pandas()
+    old = config.dense_groupby_max_slots
+    set_config(dense_groupby_max_slots=0)
+    try:
+        sortp = R.groupby_agg(t, ["a", "flag"], aggs).to_pandas()
+    finally:
+        set_config(dense_groupby_max_slots=old)
+    d = dense.sort_values(["a", "flag"]).reset_index(drop=True)
+    s = sortp.sort_values(["a", "flag"]).reset_index(drop=True)
+    assert d["a"].tolist() == s["a"].tolist()
+    np.testing.assert_allclose(d["s"], s["s"], rtol=1e-12)
+    np.testing.assert_allclose(d["vv"], s["vv"], rtol=1e-12)
+
+
+def test_dense_join_fires_and_matches(one_dev, monkeypatch):
+    r = np.random.default_rng(2)
+    n = 4000
+    left = pd.DataFrame({"k": r.integers(0, 100, n),
+                         "v": r.normal(size=n)})
+    right = pd.DataFrame({"k": np.arange(100),
+                          "name": [f"n{i}" for i in range(100)],
+                          "z": np.arange(100) * 1.5})
+    fired = []
+    orig = R._join_dense_try
+
+    def spy(*a, **k):
+        out = orig(*a, **k)
+        if out is not None:
+            fired.append(1)
+        return out
+    monkeypatch.setattr(R, "_join_dense_try", spy)
+
+    for how in ("inner", "left"):
+        got = R.join_tables(Table.from_pandas(left),
+                            Table.from_pandas(right.iloc[:80]),
+                            ["k"], ["k"], how).to_pandas()
+        exp = left.merge(right.iloc[:80], on="k", how=how)
+        assert len(got) == len(exp), how
+        g = got.sort_values(["k", "v"]).reset_index(drop=True)
+        e = exp.sort_values(["k", "v"]).reset_index(drop=True)
+        assert g["k"].tolist() == e["k"].tolist()
+        np.testing.assert_allclose(g["v"], e["v"], rtol=1e-12)
+        if how == "inner":
+            assert g["name"].tolist() == e["name"].tolist()
+        else:
+            assert g["name"].fillna("<NA>").tolist() == \
+                e["name"].fillna("<NA>").tolist()
+    assert len(fired) == 2
+
+
+def test_dense_join_duplicate_build_keys_falls_back(one_dev):
+    left = pd.DataFrame({"k": [1, 2, 3, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+    right = pd.DataFrame({"k": [2, 2, 3], "w": [10.0, 20.0, 30.0]})
+    got = R.join_tables(Table.from_pandas(left), Table.from_pandas(right),
+                        ["k"], ["k"], "inner").to_pandas()
+    exp = left.merge(right, on="k", how="inner")
+    assert len(got) == len(exp)
+    assert sorted(got["w"].tolist()) == sorted(exp["w"].tolist())
+
+
+def test_dense_join_multikey_and_null_keys(one_dev):
+    r = np.random.default_rng(3)
+    left = pd.DataFrame({
+        "a": r.integers(0, 10, 500),
+        "b": r.integers(0, 5, 500),
+        "v": np.arange(500.0),
+    })
+    right = pd.DataFrame([(a, b, a * 10 + b)
+                          for a in range(10) for b in range(5)],
+                         columns=["a", "b", "code"])
+    got = R.join_tables(Table.from_pandas(left), Table.from_pandas(right),
+                        ["a", "b"], ["a", "b"], "inner").to_pandas()
+    exp = left.merge(right, on=["a", "b"], how="inner")
+    assert len(got) == len(exp)
+    g = got.sort_values("v").reset_index(drop=True)
+    e = exp.sort_values("v").reset_index(drop=True)
+    assert g["code"].tolist() == e["code"].tolist()

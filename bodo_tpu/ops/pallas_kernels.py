@@ -1,0 +1,138 @@
+"""Pallas TPU kernels for relational hot paths.
+
+The first kernel family targets the dense groupby accumulate: on TPU,
+XLA lowers `segment_sum` with random slot ids to scatter-adds, which
+serialize on the VPU. For small slot spaces the MXU is the right unit —
+aggregation by one-hot matmul: a [BLK, K] one-hot of the slot codes
+contracted against the value block accumulates all columns of a block in
+one 128x128-systolic pass (the standard TPU histogram/segment-reduce
+recipe). This is the TPU-native replacement for the reference's
+hash-table accumulate loop (bodo/libs/groupby/_groupby.cpp update step).
+
+Kernels run on TPU only (gated by `use_pallas()`); every caller keeps an
+XLA `segment_sum` fallback, and correctness is tested on CPU through
+`interpret=True`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# row block per grid step: onehot f32 [BLK, K<=MAX_SLOTS] must fit VMEM
+_BLK = 512
+MAX_MATMUL_SLOTS = 4096
+
+# test hook: run kernels through the pallas interpreter on CPU
+FORCE_INTERPRET = False
+
+
+# set when a kernel fails to compile/run on the actual backend: callers
+# permanently fall back to the XLA path for the rest of the process
+_runtime_disabled = False
+
+
+def disable_runtime(reason: str) -> None:
+    global _runtime_disabled
+    _runtime_disabled = True
+    import sys
+    print(f"[bodo_tpu] pallas kernels disabled: {reason}", file=sys.stderr)
+
+
+def use_pallas() -> bool:
+    """Pallas kernels engage only on real TPU backends."""
+    if _runtime_disabled:
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_slots", "n_cols", "interpret"))
+def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
+                       interpret: bool = False):
+    """Sum `vals` ([N, n_cols] f32, pre-masked) into `n_slots` groups via
+    one-hot MXU contraction. codes: int32 [N] in [0, n_slots); rows to be
+    ignored must carry zeroed vals (any code). Returns [n_slots, n_cols]
+    f32 sums."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = codes.shape[0]
+    k_pad = _round_up(max(n_slots, 128), 128)
+    c_pad = _round_up(max(n_cols, 8), 8)
+    n_pad = _round_up(max(n, _BLK), _BLK)
+    if n_pad != n:
+        codes = jnp.concatenate(
+            [codes, jnp.zeros((n_pad - n,), codes.dtype)])
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((n_pad - n, vals.shape[1]), vals.dtype)])
+    if c_pad != vals.shape[1]:
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((vals.shape[0], c_pad - vals.shape[1]),
+                             vals.dtype)], axis=1)
+
+    def kernel(codes_ref, vals_ref, out_ref, acc_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        codes_blk = codes_ref[:]                      # [BLK]
+        onehot = (codes_blk[:, None] ==
+                  jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+                  ).astype(jnp.float32)               # [BLK, K]
+        # [C, BLK] @ [BLK, K] -> [C, K] on the MXU
+        acc_ref[:] += jax.lax.dot_general(
+            vals_ref[:].T, onehot,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(step == pl.num_programs(0) - 1)
+        def _flush():
+            out_ref[:] = acc_ref[:]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLK,),
+        in_specs=[
+            pl.BlockSpec((_BLK,), lambda i: (i,)),
+            pl.BlockSpec((_BLK, c_pad), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((c_pad, k_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((c_pad, k_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((c_pad, k_pad), jnp.float32)],
+        interpret=interpret,
+    )(codes, vals)
+    return out[:n_cols, :n_slots].T                   # [n_slots, n_cols]
+
+
+def dense_accumulate(codes, cols: Sequence, ok_masks: Sequence,
+                     n_slots: int, interpret: Optional[bool] = None):
+    """Sum each (column, mask) pair into dense slots.
+
+    TPU (or interpret=True): one fused MXU one-hot matmul over all
+    columns. Elsewhere: per-column XLA segment_sum (scatter). Returns a
+    list of f32/f64 [n_slots] arrays aligned with `cols`."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    if (use_pallas() or interp) and n_slots <= MAX_MATMUL_SLOTS:
+        vals = jnp.stack(
+            [jnp.where(ok, c, 0).astype(jnp.float32)
+             for c, ok in zip(cols, ok_masks)], axis=1)
+        sums = matmul_groupby_sum(codes, vals, n_slots, len(cols),
+                                  interpret=interp)
+        return [sums[:, i] for i in range(len(cols))]
+    return [jax.ops.segment_sum(jnp.where(ok, c, 0).astype(jnp.float64),
+                                codes, num_segments=n_slots)
+            for c, ok in zip(cols, ok_masks)]

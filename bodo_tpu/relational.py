@@ -369,7 +369,8 @@ def groupby_agg(t: Table, keys: Sequence[str],
     # cheap host gates first: _key_ranges does a blocking device reduce
     dense_ok = (t.distribution == REP and config.dense_groupby_max_slots > 0
                 and not any(op == "nunique" for _, op, _ in aggs))
-    want_ranges = config.pack_keys and keys and (dense_ok or len(keys) >= 2)
+    want_ranges = bool(keys) and (
+        dense_ok or (config.pack_keys and len(keys) >= 2))
     ranges = _key_ranges(t, keys) if want_ranges else None
     if dense_ok and ranges is not None and \
             all(r is not None for r in ranges):
@@ -479,6 +480,29 @@ def _groupby_agg_packed(t: Table, keys, aggs, pack) -> Table:
     return Table(cols, out.nrows, out.distribution, out.counts)
 
 
+def _dense_slots(key_arrays, los, sizes, mask, strict_range: bool = False):
+    """Mixed-radix dense slot ids shared by the dense groupby and the
+    dense-LUT join build/probe. Returns (slot int32[cap], live mask):
+    null/NaN keys drop out of `mask`; with strict_range, rows whose key
+    falls outside [lo, lo+size) (or is a non-integral float) drop too —
+    the probe-side policy, where out-of-range just means no match."""
+    cap = key_arrays[0][0].shape[0]
+    slot = jnp.zeros((cap,), dtype=jnp.int32)
+    for (d, v), lo, size in zip(key_arrays, los, sizes):
+        if v is not None:
+            mask = mask & v
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            mask = mask & ~jnp.isnan(d)
+            if strict_range:
+                mask = mask & (d == jnp.floor(d))
+        code = d.astype(jnp.int64) - lo
+        if strict_range:
+            mask = mask & (code >= 0) & (code < size)
+        slot = slot * np.int32(size) + \
+            jnp.clip(code, 0, size - 1).astype(jnp.int32)
+    return slot, mask
+
+
 def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
     """Sort-free dense groupby for small key spaces.
 
@@ -501,29 +525,76 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
     val_names = tuple(c for c, _, _ in aggs)
     names = list(keys) + [c for c in val_names if c not in keys]
     tsel = t.select(list(dict.fromkeys(names)))
+    # MXU one-hot matmul accumulate (TPU): sums/counts/means into a small
+    # slot space go through the systolic array instead of scatter-adds
+    from bodo_tpu.ops import pallas_kernels as PK
+    # f32 accumulation limits: sums/means only over float32-or-narrower
+    # float columns (int sums must stay exact in int64), counts only while
+    # the row capacity stays within f32's exact-integer range (2^24)
+    def _mxu_ok(c, op):
+        d = t.column(c).data.dtype
+        if op in ("count", "size"):
+            return t.capacity <= (1 << 24)
+        return jnp.issubdtype(d, jnp.floating) and d.itemsize <= 4
+    use_mxu = ((PK.use_pallas() or PK.FORCE_INTERPRET)
+               and n_slots <= PK.MAX_MATMUL_SLOTS
+               and t.capacity <= (1 << 24)  # `present` is also a count
+               and all(op in ("sum", "count", "size", "mean")
+                       for op in specs)
+               and all(_mxu_ok(c, op)
+                       for c, op in zip(val_names, specs)))
     key = ("gbdense", _sig(tsel), tuple(keys), tuple(zip(val_names, specs)),
-           sizes, los)
+           sizes, los, use_mxu)
     fn = _jit_cache.get(key)
     if fn is None:
         kn, vn = list(keys), list(val_names)
 
         def body(tree, count):
             cap = tree[kn[0]][0].shape[0]
-            padmask = K.row_mask(count, cap)
-            slot = jnp.zeros((cap,), dtype=jnp.int32)
-            for name, lo, size in zip(kn, los, sizes):
-                d, v = tree[name]
-                if v is not None:
-                    padmask = padmask & v
-                if jnp.issubdtype(d.dtype, jnp.floating):
-                    padmask = padmask & ~jnp.isnan(d)
-                code = jnp.clip(d.astype(jnp.int64) - lo, 0, size - 1)
-                slot = slot * np.int32(size) + code.astype(jnp.int32)
-            present = jax.ops.segment_sum(
-                padmask.astype(jnp.int32), slot, num_segments=n_slots) > 0
-            outs = [_segment_agg(op, tree[c][0], tree[c][1], slot, padmask,
-                                 n_slots)
-                    for c, op in zip(vn, specs)]
+            slot, padmask = _dense_slots([tree[n] for n in kn], los, sizes,
+                                         K.row_mask(count, cap))
+            if use_mxu:
+                # one fused one-hot matmul: [present | per-spec columns]
+                mcols, moks = [padmask.astype(jnp.float32)], [padmask]
+                plan = []
+                for c, op in zip(vn, specs):
+                    d, v = tree[c]
+                    ok = K.value_ok(d, v, padmask)
+                    if op == "size":
+                        plan.append(("size", 0, None))  # == present column
+                        continue
+                    cnt_idx = len(mcols)
+                    mcols.append(jnp.ones((cap,), jnp.float32))
+                    moks.append(ok)
+                    if op == "count":
+                        plan.append(("count", cnt_idx, None))
+                    elif op in ("sum", "mean"):
+                        s_idx = len(mcols)
+                        mcols.append(d.astype(jnp.float32))
+                        moks.append(ok)
+                        plan.append((op, cnt_idx, s_idx))
+                from bodo_tpu.ops import pallas_kernels as PK_
+                sums = PK_.dense_accumulate(slot, mcols, moks, n_slots)
+                present = sums[0] > 0
+                outs = []
+                for op, cnt_idx, s_idx in plan:
+                    if op == "size":
+                        outs.append((sums[0].astype(jnp.int64), None))
+                    elif op == "count":
+                        outs.append((sums[cnt_idx].astype(jnp.int64), None))
+                    elif op == "sum":
+                        outs.append((sums[s_idx], None))
+                    else:  # mean
+                        cnt = sums[cnt_idx]
+                        m = sums[s_idx] / jnp.maximum(cnt, 1.0)
+                        outs.append((jnp.where(cnt > 0, m, jnp.nan), None))
+            else:
+                present = jax.ops.segment_sum(
+                    padmask.astype(jnp.int32), slot,
+                    num_segments=n_slots) > 0
+                outs = [_segment_agg(op, tree[c][0], tree[c][1], slot,
+                                     padmask, n_slots)
+                        for c, op in zip(vn, specs)]
             # reconstruct keys from the slot index (mixed-radix decode)
             rem = jnp.arange(n_slots, dtype=jnp.int32)
             key_cols = [None] * len(kn)
@@ -541,8 +612,19 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
         fn = jax.jit(body)
         _jit_cache[key] = fn
 
-    out_keys, out_vals, ng = fn(tsel.device_data(), jnp.asarray(t.nrows))
-    nrows = int(jax.device_get(ng))
+    try:
+        out_keys, out_vals, ng = fn(tsel.device_data(),
+                                    jnp.asarray(t.nrows))
+        nrows_arr = jax.device_get(ng)  # async-dispatch errors surface here
+    except Exception:
+        if not use_mxu:
+            raise
+        # pallas kernel failed on this backend: fall back to XLA scatter
+        # for the rest of the process (use_pallas() is now False)
+        PK.disable_runtime("dense groupby matmul kernel failed to compile")
+        _jit_cache.pop(key, None)
+        return _groupby_agg_dense(t, keys, aggs, ranges)
+    nrows = int(nrows_arr)
     cols: Dict[str, Column] = {}
     for kname, kd in zip(keys, out_keys):
         src = t.column(kname)
@@ -559,6 +641,8 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
         rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
         if op in ("min", "max", "first", "last"):
             rdt = src.dtype
+        if vd.dtype != rdt.numpy:  # MXU path accumulates in f32
+            vd = vd.astype(rdt.numpy)
         cols[oname] = Column(vd, vv, rdt,
                              src.dictionary if rdt is dt.STRING else None)
     return shrink_to_fit(Table(cols, nrows, REP, None))
@@ -712,15 +796,8 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes
     if bfn is None:
         def bbody(arrays, count):
             cap = arrays[0][0].shape[0]
-            mask = K.row_mask(count, cap)
-            slot = jnp.zeros((cap,), dtype=jnp.int32)
-            for (d, v), lo, size in zip(arrays[:nk], los, sizes):
-                if v is not None:
-                    mask = mask & v
-                if jnp.issubdtype(d.dtype, jnp.floating):
-                    mask = mask & ~jnp.isnan(d)
-                code = jnp.clip(d.astype(jnp.int64) - lo, 0, size - 1)
-                slot = slot * np.int32(size) + code.astype(jnp.int32)
+            slot, mask = _dense_slots(arrays[:nk], los, sizes,
+                                      K.row_mask(count, cap))
             cnt = jax.ops.segment_sum(mask.astype(jnp.int32),
                                       slot, num_segments=n_slots)
             dup = jnp.any(cnt > 1)
@@ -743,20 +820,10 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes
     if pfn is None:
         def pbody(p_arrays, b_arrays, lut, pcount):
             cap = p_arrays[0][0].shape[0]
-            mask = K.row_mask(pcount, cap)
-            slot = jnp.zeros((cap,), dtype=jnp.int32)
-            inrange = jnp.ones((cap,), dtype=bool)
-            for (d, v), lo, size in zip(p_arrays[:nk], los, sizes):
-                if v is not None:
-                    mask = mask & v
-                if jnp.issubdtype(d.dtype, jnp.floating):
-                    mask = mask & ~jnp.isnan(d)
-                    inrange = inrange & (d == jnp.floor(d))
-                code = d.astype(jnp.int64) - lo
-                inrange = inrange & (code >= 0) & (code < size)
-                slot = slot * np.int32(size) + \
-                    jnp.clip(code, 0, size - 1).astype(jnp.int32)
-            idx = jnp.where(mask & inrange, lut[slot], -1)
+            slot, live = _dense_slots(p_arrays[:nk], los, sizes,
+                                      K.row_mask(pcount, cap),
+                                      strict_range=True)
+            idx = jnp.where(live, lut[slot], -1)
             hit = idx >= 0
             safe = jnp.maximum(idx, 0)
             out_b = []

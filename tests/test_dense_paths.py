@@ -150,3 +150,52 @@ def test_dense_join_multikey_and_null_keys(one_dev):
     g = got.sort_values("v").reset_index(drop=True)
     e = exp.sort_values("v").reset_index(drop=True)
     assert g["code"].tolist() == e["code"].tolist()
+
+
+def test_mxu_matmul_groupby_interpret(one_dev):
+    """The pallas one-hot MXU accumulate (interpret mode) must agree with
+    the scatter path for sum/count/mean/size."""
+    from bodo_tpu.ops import pallas_kernels as PK
+    r = np.random.default_rng(5)
+    n = 6000
+    df = pd.DataFrame({
+        "a": r.integers(0, 9, n), "b": r.integers(0, 7, n),
+        "v": r.normal(size=n).astype(np.float32),
+        "c": r.integers(0, 100, n).astype(np.int32),
+    })
+    df.loc[r.random(n) < 0.1, "v"] = np.nan
+    aggs = [("v", "sum", "s"), ("v", "mean", "m"), ("v", "count", "cnt"),
+            ("c", "size", "sz")]
+    old = PK.FORCE_INTERPRET
+    PK.FORCE_INTERPRET = True
+    try:
+        got = R.groupby_agg(Table.from_pandas(df), ["a", "b"], aggs
+                            ).to_pandas()
+    finally:
+        PK.FORCE_INTERPRET = old
+    exp = df.groupby(["a", "b"], as_index=False).agg(
+        s=("v", "sum"), m=("v", "mean"), cnt=("v", "count"),
+        sz=("c", "size"))
+    g = got.sort_values(["a", "b"]).reset_index(drop=True)
+    e = exp.sort_values(["a", "b"]).reset_index(drop=True)
+    assert g["a"].tolist() == e["a"].tolist()
+    np.testing.assert_allclose(g["s"], e["s"], rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(g["m"], e["m"], rtol=1e-3, atol=1e-4)
+    assert g["cnt"].tolist() == e["cnt"].tolist()
+    assert g["sz"].tolist() == e["sz"].tolist()
+
+
+def test_pallas_dense_accumulate_unit():
+    import jax.numpy as jnp
+
+    from bodo_tpu.ops.pallas_kernels import dense_accumulate
+    r = np.random.default_rng(6)
+    n, K = 3000, 250
+    codes = jnp.asarray(r.integers(0, K, n).astype(np.int32))
+    v = jnp.asarray(r.normal(size=n).astype(np.float32))
+    ok = jnp.asarray(r.random(n) > 0.2)
+    out = dense_accumulate(codes, [v], [ok], K, interpret=True)[0]
+    exp = np.zeros(K)
+    np.add.at(exp, np.asarray(codes)[np.asarray(ok)],
+              np.asarray(v)[np.asarray(ok)])
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-4, atol=1e-4)

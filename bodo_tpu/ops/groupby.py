@@ -71,7 +71,8 @@ def result_dtype(op: str, dtype):
         return jnp.dtype(jnp.int64)
     if op in ("sum64", "m2"):
         return jnp.dtype(jnp.float64)  # stable moments always accumulate f64
-    if op in ("mean", "var", "std", "var0", "std0"):
+    if op in ("mean", "var", "std", "var0", "std0", "median") or \
+            op.startswith(("quantile_", "q:")):
         return jnp.dtype(jnp.float32) if d == jnp.float32 else jnp.dtype(jnp.float64)
     if op in ("sum", "sumnull", "prod"):
         if jnp.issubdtype(d, jnp.floating):
@@ -226,6 +227,10 @@ def _groupby_local_impl(arrays, count, specs: Tuple[str, ...],
         if op == "nunique":
             out_vals.append(_nunique(keys, (data, valid), perm, seg,
                                      padmask_s, out_capacity))
+        elif op.startswith("q:"):  # quantile/median: "q:<float>"
+            out_vals.append(_quantile_seg((data, valid), perm, seg,
+                                          padmask_s, out_capacity,
+                                          float(op[2:])))
         elif op == "chan_m2":
             # composite combine of per-shard (n, sum, m2) partial rows:
             # M2 = Σm2ᵢ + Σnᵢ·(meanᵢ − mean)² — the exact delta-form Chan
@@ -301,6 +306,38 @@ def groupby_merge(state_arrays, batch_arrays, n_state, n_batch,
     merged = tuple(cat(s, b) for s, b in zip(state_arrays, batch_arrays))
     return _groupby_local_impl(merged, None, specs, out_capacity, num_keys,
                                row_valid=mask)
+
+
+def _quantile_seg(value, perm, seg, padmask_s, out_cap: int, q: float):
+    """Per-group linear-interpolated quantile (pandas interpolation=
+    'linear'; reference analogue bodo/libs/_quantile_alg.cpp): re-sort by
+    (group, value) with the raw value as payload, then pick/interpolate
+    at (cnt−1)·q per segment."""
+    data, valid = value
+    cap = data.shape[0]
+    v_s = data[perm]
+    valid_s = valid[perm] if valid is not None else None
+    ok = K.value_ok(v_s, valid_s, padmask_s)
+    enc_v = SE.encode_value(v_s)
+    seg_key = jnp.where(ok, seg, cap).astype(jnp.int64)
+    s_seg, _, s_val = lax.sort(
+        (seg_key.view(jnp.uint64), enc_v, v_s.astype(jnp.float64)),
+        num_keys=2, is_stable=False)
+    pos = jnp.arange(cap)
+    okrow = s_seg < jnp.uint64(cap)
+    seg_i = jnp.minimum(s_seg, jnp.uint64(out_cap)).astype(jnp.int64)
+    start = jax.ops.segment_min(jnp.where(okrow, pos, cap), seg_i,
+                                num_segments=out_cap + 1)[:out_cap]
+    cnt = jax.ops.segment_sum(okrow.astype(jnp.int64), seg_i,
+                              num_segments=out_cap + 1)[:out_cap]
+    qpos = (cnt - 1).astype(jnp.float64) * q
+    lo = jnp.floor(qpos).astype(jnp.int64)
+    hi = jnp.ceil(qpos).astype(jnp.int64)
+    frac = qpos - lo.astype(jnp.float64)
+    v_lo = s_val[jnp.clip(start + lo, 0, cap - 1)]
+    v_hi = s_val[jnp.clip(start + hi, 0, cap - 1)]
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(cnt > 0, out, jnp.nan), None
 
 
 def _nunique(keys, value, perm, seg, padmask_s, out_cap: int):

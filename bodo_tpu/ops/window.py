@@ -14,6 +14,7 @@ cross-shard carry; the shard_map wrapper lives in relational.py.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -189,3 +190,110 @@ def shift_local(x, valid, count, halo_x, halo_ok, n: int):
     out = ext[:cap]
     out_ok = ext_ok[:cap] & padmask
     return jnp.where(out_ok, out, jnp.nan), out_ok
+
+
+# ---------------------------------------------------------------------------
+# partitioned ranking windows: ROW_NUMBER / RANK / DENSE_RANK / NTILE /
+# CUMCOUNT over (PARTITION BY keys ORDER BY order_cols)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("specs", "num_keys", "ascending",
+                                   "na_last"))
+def rank_window_local(key_arrays, order_arrays, count,
+                      specs: Tuple[Tuple[str, int], ...], num_keys: int,
+                      ascending: Tuple[bool, ...] = (),
+                      na_last: bool = True):
+    """Ranking window functions in one sorted pass.
+
+    TPU-native replacement for the reference's window-function family
+    (bodo/libs/window/_window_aggfuncs.cpp, _window_calculator.cpp):
+    stable sort by (partition keys, order cols), segment boundaries from
+    key changes, then each rank flavor is an elementwise/scan expression
+    over segment-relative positions; results scatter back to the input
+    row order. specs: (op, param) with op in row_number/rank/dense_rank/
+    ntile/cumcount; param is ntile's bucket count.
+
+    Null partition keys form their own partition (SQL semantics: NULLs
+    group together in PARTITION BY). Returns int64 outputs aligned with
+    input rows (0 on padding rows).
+    """
+    from bodo_tpu.ops import kernels as K
+    from bodo_tpu.ops import sort_encoding as SE
+
+    cap = key_arrays[0][0].shape[0] if key_arrays else \
+        order_arrays[0][0].shape[0]
+    padmask = K.row_mask(count, cap)
+
+    operands: list = []
+    for d, v in key_arrays:
+        # partition nulls group together: use the null rank slot but keep
+        # them, padding rows still sort last
+        operands.extend(SE.key_operands(d, v, padmask=padmask))
+    if not ascending:
+        ascending = tuple(True for _ in order_arrays)
+    for (d, v), asc in zip(order_arrays, ascending):
+        operands.extend(SE.key_operands(d, v, ascending=asc,
+                                        na_last=na_last, padmask=padmask))
+    nko = len(operands)
+    operands.append(jnp.arange(cap))
+    sorted_ops = lax.sort(tuple(operands), num_keys=nko, is_stable=True)
+    perm = sorted_ops[-1]
+    padmask_s = padmask[perm]
+    pos = jnp.arange(cap)
+
+    def _changes(arrays):
+        """Adjacent-difference flags on null-canonicalized values: a null
+        (mask or NaN) compares equal to another null, never to a value —
+        raw NaN != NaN would split every null row into its own group."""
+        chg = jnp.zeros(cap, dtype=bool)
+        for d, v in arrays:
+            null = SE.null_flag(d, v)
+            ds = d[perm]
+            if null is not None:
+                ns = null[perm]
+                ds = jnp.where(ns, jnp.zeros((), d.dtype), ds)
+                chg = chg | (ns != jnp.roll(ns, 1))
+            chg = chg | (ds != jnp.roll(ds, 1))
+        return chg
+
+    # partition boundaries: any key column changes (nulls = one group)
+    newpart = (_changes(key_arrays) & padmask_s) | (pos == 0)
+    seg = jnp.maximum(jnp.cumsum(newpart) - 1, 0)
+    # order-value change points (for rank/dense_rank ties)
+    newval = newpart | (_changes(order_arrays) & padmask_s)
+
+    n_segs = cap  # upper bound; segment ops sized to cap
+    seg_start = jax.ops.segment_min(jnp.where(padmask_s, pos, cap), seg,
+                                    num_segments=n_segs)
+    seg_cnt = jax.ops.segment_sum(padmask_s.astype(jnp.int64), seg,
+                                  num_segments=n_segs)
+    row_no = pos - seg_start[seg] + 1                     # 1-based
+    dense = jnp.cumsum(newval & padmask_s)
+    dense_rank = dense - jax.ops.segment_min(
+        jnp.where(padmask_s, dense, cap + 1), seg, num_segments=n_segs
+    )[seg] + 1
+    # rank: row_number of the first row with an equal order value
+    first_eq = jnp.where(newval, pos, 0)
+    first_eq = jax.lax.cummax(first_eq)                   # last change point
+    rank = first_eq - seg_start[seg] + 1
+
+    outs_sorted = []
+    for op, param in specs:
+        if op == "row_number":
+            o = row_no
+        elif op == "cumcount":
+            o = row_no - 1
+        elif op == "rank":
+            o = rank
+        elif op == "dense_rank":
+            o = dense_rank
+        elif op == "ntile":
+            n = jnp.int64(param)
+            o = ((row_no - 1) * n) // jnp.maximum(seg_cnt[seg], 1) + 1
+        else:
+            raise ValueError(f"unknown rank window op: {op}")
+        outs_sorted.append(jnp.where(padmask_s, o, 0).astype(jnp.int64))
+
+    # scatter back to input row order
+    inv = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(pos)
+    return tuple(o[inv] for o in outs_sorted)

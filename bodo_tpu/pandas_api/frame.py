@@ -70,7 +70,11 @@ class BodoDataFrame:
             pdf[name] = value
             plan = L.FromPandas(pdf)
         else:
-            plan = self._assign_plan({name: value})
+            plan = None
+            if isinstance(value, BodoSeries):
+                plan = self._try_absorb_window(name, value)
+            if plan is None:
+                plan = self._assign_plan({name: value})
         hist = object.__getattribute__(self, "_history")
         for dirty in hist.values():
             dirty.add(name)
@@ -96,6 +100,38 @@ class BodoDataFrame:
                 return wrapped
             return attr
         raise AttributeError(name)
+
+    def _try_absorb_window(self, name: str, s) -> "L.Node | None":
+        """df[name] = df[col].cumsum()/shift(...): the series' plan is a
+        Window wrapped around (a projection of) this frame's plan — a
+        row-aligned derivation, so it can be rebuilt on the full frame
+        instead of rejecting it as foreign (pandas aligns by index; the
+        engine's analogue is row alignment through row-preserving nodes)."""
+        vp = s._plan
+        if not isinstance(vp, L.Window) or len(vp.specs) != 1:
+            return None
+        wcol, op, param, out = vp.specs[0]
+        if not (isinstance(s._expr, ColRef) and s._expr.name == out):
+            return None
+        child = vp.child
+        if child is self._plan:
+            inner = ColRef(wcol)
+        elif isinstance(child, L.Projection) and child.child is self._plan:
+            inner = dict(child.exprs).get(wcol)
+            if inner is None:
+                return None
+        else:
+            return None
+        tmp = f"__win_in_{name}"
+        keep = [(n, ColRef(n)) for n in self._plan.schema]
+        p2 = L.Projection(self._plan, keep + [(tmp, inner)])
+        wout = f"__w_{tmp}"
+        w2 = L.Window(p2, [(tmp, op, param, wout)])
+        exprs = [(n, ColRef(wout) if n == name else ColRef(n))
+                 for n in self._plan.schema]
+        if name not in self._plan.schema:
+            exprs.append((name, ColRef(wout)))
+        return L.Projection(w2, exprs)
 
     def _expr_of(self, value) -> Expr:
         if isinstance(value, BodoSeries):
@@ -145,6 +181,53 @@ class BodoDataFrame:
             plan = L.Projection(plan, exprs)
             allowed.add(id(plan))
         return BodoDataFrame(plan)
+
+    def melt(self, id_vars=None, value_vars=None, var_name="variable",
+             value_name="value") -> "BodoDataFrame":
+        """Unpivot columns to rows: one constant-dictionary `variable`
+        column per source column, concatenated on device (reference:
+        bodo/hiframes/pd_dataframe_ext.py melt overload)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bodo_tpu import relational as R
+        from bodo_tpu.plan.physical import execute
+        from bodo_tpu.table import dtypes as dt
+        from bodo_tpu.table.table import Column, Table
+        id_vars = [id_vars] if isinstance(id_vars, str) else \
+            list(id_vars or [])
+        schema = self._plan.schema
+        value_vars = [value_vars] if isinstance(value_vars, str) else \
+            list(value_vars or [c for c in schema if c not in id_vars])
+        t = execute(self._plan)
+        pieces = []
+        for v in value_vars:
+            cols = {c: t.columns[c] for c in id_vars}
+            cols[var_name] = Column(
+                jnp.zeros((t.capacity,), jnp.int32), None, dt.STRING,
+                np.array([v], dtype=str))
+            cols[value_name] = t.columns[v]
+            pieces.append(Table(cols, t.nrows, t.distribution, t.counts))
+        out = R.concat_tables(pieces)
+        return BodoDataFrame(L.FromPandas(out))
+
+    def pivot_table(self, values=None, index=None, columns=None,
+                    aggfunc="mean"):
+        """Device-side groupby on (index, columns), host-side reshape of
+        the (small) aggregated result — returns plain pandas (pivoted
+        frames carry a meaningful index, which Tables don't model)."""
+        from bodo_tpu.plan.physical import execute
+        if index is None or columns is None or not isinstance(values, str):
+            raise NotImplementedError(
+                "pivot_table needs explicit string/list index and columns "
+                "and a single string values column")
+        idx = [index] if isinstance(index, str) else list(index)
+        col = [columns] if isinstance(columns, str) else list(columns)
+        node = L.Aggregate(self._plan, idx + col,
+                           [(values, aggfunc, "__v")])
+        pdf = BodoDataFrame(node).to_pandas()
+        return pdf.pivot(index=idx, columns=col, values="__v") \
+            .rename_axis(columns=None if len(col) == 1 else col)
 
     def drop(self, columns=None, **kw) -> "BodoDataFrame":
         if columns is None:

@@ -101,6 +101,54 @@ class BodoGroupBy:
     def last(self): return self._simple("last")
     def nunique(self): return self._simple("nunique")
     def prod(self): return self._simple("prod")
+    def median(self): return self._simple("median")
+
+    def quantile(self, q=0.5):
+        if not isinstance(q, (int, float)):
+            warn_fallback("groupby.quantile", "list of quantiles")
+            gb = self._df.to_pandas().groupby(self._keys,
+                                              as_index=self._as_index)
+            if self._selection:
+                gb = gb[self._selection[0] if len(self._selection) == 1
+                        else self._selection]
+            return gb.quantile(q)
+        return self._simple(f"quantile_{float(q)}")
+
+    # ---- transform-shaped (row-aligned) window functions ------------------
+    _RANK_METHODS = {"first": "row_number", "min": "rank",
+                     "dense": "dense_rank"}
+
+    def rank(self, method: str = "min", ascending: bool = True):
+        """Within-group rank of the selected column (SQL semantics for
+        nulls: they rank together rather than producing NaN)."""
+        if method not in self._RANK_METHODS or not self._single:
+            warn_fallback("groupby.rank", f"method={method!r} or "
+                          "multi-column selection")
+            gb = self._df.to_pandas().groupby(self._keys)
+            if self._selection:
+                gb = gb[self._selection[0] if len(self._selection) == 1
+                        else self._selection]
+            return gb.rank(method=method, ascending=ascending)
+        col = self._selection[0]
+        return self._rank_window(self._RANK_METHODS[method], 0, [col],
+                                 ascending)
+
+    def cumcount(self):
+        return self._rank_window("cumcount", 0, [], True)
+
+    def ntile(self, n: int):
+        """SQL NTILE(n) over the group in original row order."""
+        return self._rank_window("ntile", int(n), [], True)
+
+    def _rank_window(self, op: str, param: int, order_by, ascending: bool):
+        from bodo_tpu.plan.expr import ColRef
+
+        from bodo_tpu.pandas_api.series import BodoSeries
+        out = f"__{op}"
+        node = L.RankWindow(self._df._plan, self._keys, order_by,
+                            [ascending] * len(order_by),
+                            [(op, param, out)])
+        return BodoSeries(node, ColRef(out), op)
 
     def size(self):
         res = self._run([(self._keys[0], "size", "size")])

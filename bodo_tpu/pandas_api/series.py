@@ -126,6 +126,8 @@ class BodoSeries:
     def __or__(self, o): return self._bin("|", o)
     def __invert__(self): return self._wrap(UnOp("~", self._expr))
     def __neg__(self): return self._wrap(UnOp("neg", self._expr))
+    def __abs__(self): return self._wrap(UnOp("abs", self._expr))
+    def abs(self): return self._wrap(UnOp("abs", self._expr))
     __hash__ = None  # type: ignore[assignment]
 
     def isin(self, values):
@@ -222,6 +224,26 @@ class BodoSeries:
 
     def std(self, ddof: int = 1):
         return self._reduce(_ddof_op("std", ddof))
+
+    def median(self):
+        return self._reduce("median")
+
+    def quantile(self, q=0.5):
+        if not isinstance(q, (int, float)):
+            warn_fallback("Series.quantile", "list of quantiles")
+            return self.to_pandas().quantile(q)
+        return self._reduce(f"quantile_{float(q)}")
+
+    def sort_values(self, ascending: bool = True) -> "BodoSeries":
+        name = self._name or "_val"
+        node = L.Sort(self._as_projection(name), [name], [bool(ascending)])
+        return BodoSeries(node, ColRef(name), self._name)
+
+    def nlargest(self, n: int = 5) -> pd.Series:
+        return self.sort_values(ascending=False).head(n)
+
+    def nsmallest(self, n: int = 5) -> pd.Series:
+        return self.sort_values(ascending=True).head(n)
 
     def nunique(self):
         name = self._name or "_val"
@@ -356,6 +378,77 @@ class _StrAccessor:
 
     def match(self, pat):
         return self._s._wrap(StrPredicate("match", (pat,), self._s._expr))
+
+    # ---- dictionary transforms (host LUT, device code remap) -------------
+    def _map(self, kind, *params):
+        from bodo_tpu.plan.expr import DictMap
+        return self._s._wrap(DictMap(kind, tuple(params), self._s._expr))
+
+    def upper(self): return self._map("upper")
+    def lower(self): return self._map("lower")
+    def title(self): return self._map("title")
+    def capitalize(self): return self._map("capitalize")
+    def strip(self, to_strip=None):
+        return self._map("strip", *(() if to_strip is None else (to_strip,)))
+    def lstrip(self, to_strip=None):
+        return self._map("lstrip",
+                         *(() if to_strip is None else (to_strip,)))
+    def rstrip(self, to_strip=None):
+        return self._map("rstrip",
+                         *(() if to_strip is None else (to_strip,)))
+    def replace(self, old, new, regex: bool = False):
+        if regex:
+            warn_fallback("Series.str.replace", "regex=True")
+            return self._s.to_pandas().str.replace(old, new, regex=True)
+        return self._map("replace", old, new)
+    def slice(self, start=None, stop=None):
+        return self._map("slice", start, stop)
+    def zfill(self, width: int): return self._map("zfill", width)
+
+    def len(self):
+        from bodo_tpu.plan.expr import StrLen
+        return self._s._wrap(StrLen(self._s._expr))
+
+    def split(self, pat=None, n: int = -1, expand: bool = False):
+        """Split on the host dictionary: each output part is a new
+        dict-encoded column sharing the original codes (reference:
+        bodo/libs/dict_arr_ext.py str_split). expand=True only — the
+        list-of-strings form needs the nested-list array type."""
+        if not expand:
+            warn_fallback("Series.str.split", "expand=False (list result)")
+            return self._s.to_pandas().str.split(pat, n=n)
+        import numpy as np
+
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        from bodo_tpu.plan import logical as L
+        from bodo_tpu.plan.physical import execute
+        from bodo_tpu.table.table import Column, Table
+        name = self._s._name or "_val"
+        t = execute(self._s._as_projection(name))
+        src = t.column(name)
+        dic = src.dictionary if src.dictionary is not None else \
+            np.array([], dtype=str)
+        parts = [s.split(pat) if n <= 0 else s.split(pat, n) for s in dic]
+        width = max((len(p) for p in parts), default=0)
+        import jax.numpy as jnp
+        cols = {}
+        for i in range(width):
+            vals = np.array([p[i] if i < len(p) else "" for p in parts],
+                            dtype=str)
+            uniq, inv = (np.unique(vals, return_inverse=True)
+                         if len(vals) else (np.array([], dtype=str),
+                                            np.zeros(0, np.int64)))
+            lut = jnp.asarray(inv.astype(np.int32) if len(inv)
+                              else np.zeros(1, np.int32))
+            has = np.array([i < len(p) for p in parts], dtype=bool)
+            hlut = jnp.asarray(has if len(has) else np.zeros(1, bool))
+            codes = jnp.clip(src.data, 0, max(len(dic) - 1, 0))
+            valid = hlut[codes]
+            if src.valid is not None:
+                valid = valid & src.valid
+            cols[str(i)] = Column(lut[codes], valid, src.dtype, uniq)
+        out = Table(cols, t.nrows, t.distribution, t.counts)
+        return BodoDataFrame(L.FromPandas(out))
 
     def __getattr__(self, name):
         if hasattr(pd.Series.str, name):

@@ -57,6 +57,7 @@ class Expr:
     def __ror__(self, o): return self._bin("|", o, True)
     def __invert__(self): return UnOp("~", self)
     def __neg__(self): return UnOp("neg", self)
+    def __abs__(self): return UnOp("abs", self)
     def key(self):
         """Structural cache key (expressions can't be dict keys directly:
         __eq__ is overloaded as the comparison *builder*)."""
@@ -210,7 +211,7 @@ class DictMap(Expr):
     reference's dict-encoded string kernels, bodo/libs/dict_arr_ext.py).
     Must sit at the top level of a projection (relational.assign_columns
     attaches the new dictionary host-side)."""
-    kind: str          # substring | upper | lower
+    kind: str          # substring | upper | lower | strip | replace | ...
     params: Tuple
     operand: Expr      # must reference a string column
     def key(self):
@@ -221,11 +222,40 @@ class DictMap(Expr):
             start, length = self.params
             i = start - 1  # SQL is 1-based
             return s[i:i + length] if length is not None else s[i:]
+        if self.kind == "slice":  # pandas .str.slice — 0-based, stop excl
+            start, stop = self.params
+            return s[start:stop]
         if self.kind == "upper":
             return s.upper()
         if self.kind == "lower":
             return s.lower()
+        if self.kind == "strip":
+            return s.strip(*self.params)
+        if self.kind == "lstrip":
+            return s.lstrip(*self.params)
+        if self.kind == "rstrip":
+            return s.rstrip(*self.params)
+        if self.kind == "replace":
+            old, new = self.params
+            return s.replace(old, new)
+        if self.kind == "title":
+            return s.title()
+        if self.kind == "capitalize":
+            return s.capitalize()
+        if self.kind == "zfill":
+            return s.zfill(self.params[0])
         raise ValueError(self.kind)
+
+
+@_frozen
+class StrLen(Expr):
+    """Per-row string length via a host dictionary LUT → device int32
+    gather (same dict-encoded trick as StrPredicate; reference:
+    bodo/libs/dict_arr_ext.py str_len kernel)."""
+    operand: Expr
+
+    def key(self):
+        return ("strlen", self.operand.key())
 
 
 @_frozen
@@ -270,6 +300,8 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
         return dt.BOOL
     if isinstance(e, DictMap):
         return dt.STRING
+    if isinstance(e, StrLen):
+        return dt.INT64
     if isinstance(e, RowUDF):
         if e.out_dtype is not None:
             return e.out_dtype
@@ -312,7 +344,8 @@ def expr_columns(e: Expr) -> set:
         if e.operand is not None:
             return expr_columns(e.operand)
         return {"*"}  # may touch any column — disables pruning above it
-    if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate, DictMap)):
+    if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate, DictMap,
+                      StrLen)):
         return expr_columns(e.operand)
     if isinstance(e, Where):
         return (expr_columns(e.cond) | expr_columns(e.iftrue)
@@ -381,6 +414,8 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
             return jnp.logical_not(d), v
         if e.op == "neg":
             return jnp.negative(d), v
+        if e.op == "abs":
+            return jnp.abs(d), v
         raise ValueError(f"unknown unop {e.op}")
     if isinstance(e, IsIn):
         d, v = eval_expr(e.operand, tree, dicts, schema)
@@ -424,6 +459,24 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
             if v is not None:
                 valid = v if valid is None else (valid & v)
         return out, valid
+    if isinstance(e, StrLen):
+        col = e.operand
+        transforms = []
+        while isinstance(col, DictMap):
+            transforms.append(col)
+            col = col.operand
+        if not isinstance(col, ColRef):
+            raise TypeError("str.len must apply to a string column")
+        dic = dicts.get(col.name)
+        if dic is None:
+            raise TypeError(f"column {col.name} has no dictionary")
+        vals = list(dic)
+        for tr in reversed(transforms):
+            vals = [tr.apply_host(s) for s in vals]
+        lut = jnp.asarray(np.array([len(s) for s in vals] or [0],
+                                   dtype=np.int64))
+        d, v = eval_expr(col, tree, dicts, schema)
+        return lut[jnp.clip(d, 0, len(vals) - 1 if vals else 0)], v
     if isinstance(e, StrPredicate):
         col = e.operand
         transforms = []

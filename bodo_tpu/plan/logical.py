@@ -226,6 +226,33 @@ class Window(Node):
         return ("window", self.child.key(), tuple(self.specs))
 
 
+class RankWindow(Node):
+    """Partitioned ranking windows: specs = [(op, param, out)] with op in
+    row_number/rank/dense_rank/ntile/cumcount (SQL OVER(PARTITION BY ...
+    ORDER BY ...); pandas groupby.rank/cumcount)."""
+
+    def __init__(self, child: Node, partition_by, order_by, ascending,
+                 specs):
+        self.children = [child]
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.ascending = list(ascending)
+        self.specs = [tuple(s) for s in specs]
+        sch = dict(child.schema)
+        for op, param, out in self.specs:
+            sch[out] = dt.INT64
+        self.schema = sch
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("rankwin", self.child.key(), tuple(self.partition_by),
+                tuple(self.order_by), tuple(self.ascending),
+                tuple(self.specs))
+
+
 class Join(Node):
     def __init__(self, left: Node, right: Node, left_on, right_on,
                  how: str = "inner", suffixes=("_x", "_y")):

@@ -19,8 +19,8 @@ from typing import Dict, Optional, Sequence, Set
 
 from bodo_tpu.plan import logical as L
 from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DictMap, DtField, Expr,
-                                IsIn, Lit, RowUDF, StrPredicate, UnOp, Where,
-                                expr_columns)
+                                IsIn, Lit, RowUDF, StrLen, StrPredicate,
+                                UnOp, Where, expr_columns)
 
 
 def optimize(node: L.Node) -> L.Node:
@@ -57,6 +57,8 @@ def _substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
         return RowUDF(e.func, e.out_dtype, _substitute(e.operand, mapping))
     if isinstance(e, DictMap):
         return DictMap(e.kind, e.params, _substitute(e.operand, mapping))
+    if isinstance(e, StrLen):
+        return StrLen(_substitute(e.operand, mapping))
     if isinstance(e, Where):
         return Where(_substitute(e.cond, mapping),
                      _substitute(e.iftrue, mapping),

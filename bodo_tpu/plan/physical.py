@@ -118,6 +118,9 @@ def _exec_inner(node: L.Node) -> Table:
             [_exec(c) for c in node.children]))
     if isinstance(node, L.Window):
         return R.window_table(_exec(node.child), node.specs)
+    if isinstance(node, L.RankWindow):
+        return R.rank_window(_exec(node.child), node.partition_by,
+                             node.order_by, node.specs, node.ascending)
     if isinstance(node, L.Sort):
         return R.sort_table(_exec(node.child), node.by, node.ascending,
                             node.na_last)

@@ -362,13 +362,30 @@ def groupby_agg(t: Table, keys: Sequence[str],
     column (the reference gets a similar effect from its categorical/
     sorted-key exscan strategies, bodo/libs/groupby/)."""
     keys = list(keys)
+    # normalize op aliases: median/quantile_<q> → the "q:<q>" kernel op
+    def _norm(op: str) -> str:
+        if op == "median":
+            return "q:0.5"
+        if op.startswith("quantile_"):
+            return f"q:{float(op[len('quantile_'):])}"
+        return op
+    aggs = [(c, _norm(op), o) for c, op, o in aggs]
+
     local = _as_local(t)
     if local is not None:
         return groupby_agg(local, keys, aggs)
 
+    # non-decomposable aggs (nunique, quantiles) can't two-phase combine:
+    # co-locate whole groups with one hash shuffle, then finish locally
+    from bodo_tpu.ops.groupby import DECOMPOSE
+    if t.distribution == ONED and any(
+            op not in DECOMPOSE for _, op, _ in aggs):
+        return _groupby_agg_colocated(t, keys, aggs)
+
     # cheap host gates first: _key_ranges does a blocking device reduce
     dense_ok = (t.distribution == REP and config.dense_groupby_max_slots > 0
-                and not any(op == "nunique" for _, op, _ in aggs))
+                and not any(op == "nunique" or op.startswith("q:")
+                            for _, op, _ in aggs))
     want_ranges = bool(keys) and (
         dense_ok or (config.pack_keys and len(keys) >= 2))
     ranges = _key_ranges(t, keys) if want_ranges else None
@@ -418,6 +435,8 @@ def groupby_agg(t: Table, keys: Sequence[str],
         rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
         if op in ("min", "max", "first", "last"):
             rdt = src.dtype
+        if vd.dtype != rdt.numpy:  # e.g. quantiles accumulate in f64
+            vd = vd.astype(rdt.numpy)
         cols[oname] = Column(vd, vv, rdt,
                              src.dictionary if rdt is dt.STRING else None)
     return shrink_to_fit(Table(cols, nrows, dist, counts))
@@ -646,6 +665,54 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
         cols[oname] = Column(vd, vv, rdt,
                              src.dictionary if rdt is dt.STRING else None)
     return shrink_to_fit(Table(cols, nrows, REP, None))
+
+
+def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
+    """Distributed groupby for non-decomposable aggs (nunique, quantile,
+    median): one hash shuffle co-locates every group on a single shard,
+    then each shard finishes its groups with the full local kernel — the
+    reference's shuffle-then-update strategy for nunique/median
+    (bodo/libs/groupby/_groupby.cpp shuffle path)."""
+    t = shrink_to_fit(shuffle_by_key(t, keys))
+    specs = tuple(op for _, op, _ in aggs)
+    val_names = [c for c, _, _ in aggs]
+    m = mesh_mod.get_mesh()
+    key = ("gbcoloc", _mesh_key(m), _sig(t), tuple(keys), tuple(specs),
+           tuple(val_names))
+    fn = _jit_cache.get(key)
+    if fn is None:
+        kn = list(keys)
+        ax = config.data_axis
+
+        def sharded(tree, counts):
+            cap = tree[kn[0]][0].shape[0]
+            arrays = tuple(tree[k] for k in kn) + \
+                tuple(tree[c] for c in val_names)
+            pk, pv, ng = groupby_local(arrays, counts[0], specs, cap,
+                                       len(kn))
+            return (pk, pv), ng[None]
+
+        fn = jax.jit(C.smap(sharded, in_specs=(P(ax), P(ax)),
+                            out_specs=(P(ax), P(ax)), mesh=m))
+        _jit_cache[key] = fn
+
+    (out_keys, out_vals), ngs = fn(t.device_data(), t.counts_device())
+    counts = np.asarray(jax.device_get(ngs)).reshape(-1).astype(np.int64)
+    cols: Dict[str, Column] = {}
+    for kname, (kd, kv) in zip(keys, out_keys):
+        src = t.column(kname)
+        cols[kname] = Column(kd, kv, src.dtype, src.dictionary)
+    from bodo_tpu.ops.groupby import result_dtype
+    for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
+        src = t.column(cname)
+        rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
+        if op in ("min", "max", "first", "last"):
+            rdt = src.dtype
+        if vd.dtype != rdt.numpy:  # e.g. quantiles accumulate in f64
+            vd = vd.astype(rdt.numpy)
+        cols[oname] = Column(vd, vv, rdt,
+                             src.dictionary if rdt is dt.STRING else None)
+    return shrink_to_fit(Table(cols, int(counts.sum()), ONED, counts))
 
 
 # ---------------------------------------------------------------------------
@@ -1129,6 +1196,82 @@ def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
     return res
 
 
+def rank_window(t: Table, partition_by: Sequence[str],
+                order_by: Sequence[str],
+                specs: Sequence[Tuple[str, int, str]],
+                ascending=None, na_last: bool = True) -> Table:
+    """Partitioned ranking windows: specs = [(op, param, outname)] with op
+    in row_number/rank/dense_rank/ntile/cumcount (reference:
+    bodo/libs/window/_window_aggfuncs.cpp family).
+
+    Distributed strategy: hash-shuffle rows so each partition is wholly
+    on one shard, rank locally, then restore the original row order via a
+    rowid sample-sort (keeps pandas transform alignment)."""
+    partition_by = list(partition_by)
+    order_by = list(order_by)
+    if ascending is None:
+        ascending = [True] * len(order_by)
+    elif isinstance(ascending, bool):
+        ascending = [ascending] * len(order_by)
+
+    local = _as_local(t)
+    if local is not None:
+        t = local
+    if t.distribution == ONED:
+        if not partition_by:
+            # global ranking needs a total order — gather (rare path)
+            return rank_window(t.gather(), partition_by, order_by, specs,
+                               ascending, na_last).shard()
+        keep = t.names
+        t2 = window_table(t, [(t.names[0], "rowid", None, "__rid")])
+        t2 = shuffle_by_key(t2, partition_by)
+        out = _rank_window_exec(t2, partition_by, order_by, specs,
+                                tuple(ascending), na_last)
+        out = sort_table(out, ["__rid"])
+        return out.select(keep + [o for _, _, o in specs])
+    return _rank_window_exec(t, partition_by, order_by, specs,
+                             tuple(ascending), na_last)
+
+
+def _rank_window_exec(t: Table, partition_by, order_by, specs,
+                      ascending: Tuple[bool, ...], na_last: bool) -> Table:
+    from bodo_tpu.ops.window import rank_window_local
+
+    kspecs = tuple((op, int(param or 0)) for op, param, _ in specs)
+    key = ("rankwin", _mesh_key(mesh_mod.get_mesh()), _sig(t),
+           tuple(partition_by), tuple(order_by), kspecs, ascending,
+           na_last, t.distribution)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        pk, ob = list(partition_by), list(order_by)
+
+        def body(tree, count):
+            ka = tuple(tree[n] for n in pk)
+            oa = tuple(tree[n] for n in ob)
+            return rank_window_local(ka, oa, count, kspecs, len(pk),
+                                     ascending, na_last)
+
+        if t.distribution == ONED:
+            m = mesh_mod.get_mesh()
+            ax = config.data_axis
+
+            def sharded(tree, counts):
+                return body(tree, counts[0])
+            fn = jax.jit(C.smap(sharded, in_specs=(P(ax), P(ax)),
+                                out_specs=P(ax), mesh=m))
+        else:
+            fn = jax.jit(body)
+        _jit_cache[key] = fn
+
+    counts = t.counts_device() if t.distribution == ONED \
+        else jnp.asarray(t.nrows)
+    outs = fn(t.device_data(), counts)
+    res = t.with_columns(t.columns)
+    for (op, param, oname), d in zip(specs, outs):
+        res.columns[oname] = Column(d, None, dt.INT64, None)
+    return res
+
+
 # ---------------------------------------------------------------------------
 # whole-column reductions
 # ---------------------------------------------------------------------------
@@ -1149,8 +1292,21 @@ def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
 
     Per-shard partials are one fused jitted pass (masked reductions on the
     VPU); the tiny [S, n_partials] result combines on host — the same
-    partial/combine decomposition as the distributed groupby.
+    partial/combine decomposition as the distributed groupby. Order
+    statistics (median/quantile) take a sort-based path instead
+    (reference: bodo/libs/_quantile_alg.cpp).
     """
+    qaggs = [(c, op, o) for c, op, o in aggs
+             if op == "median" or op.startswith("quantile_")]
+    if qaggs:
+        aggs = [(c, op, o) for c, op, o in aggs
+                if not (op == "median" or op.startswith("quantile_"))]
+        out = reduce_table(t, aggs) if aggs else {}
+        for c, op, o in qaggs:
+            q = 0.5 if op == "median" else float(op[len("quantile_"):])
+            out[o] = _reduce_quantile(t, c, q)
+        return out
+
     specs = []
     layout = []
     for col, op, _ in aggs:
@@ -1261,6 +1417,43 @@ def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
                 v = np.nan
         out[oname] = _reduce_scalar(v, op, t.column(col).dtype, cnt)
     return out
+
+
+def _reduce_quantile(t: Table, col: str, q: float) -> float:
+    """Linear-interpolated whole-column quantile. 1D tables gather the
+    single column (the exact-selection distributed variant is a later
+    refinement; the reference gathers for exact quantiles too at this
+    size)."""
+    src = t.select([col])
+    if src.distribution == ONED:
+        src = src.gather()
+    key = ("reduceq", _sig(src), src.capacity)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        def body(tree, count):
+            d, v = tree[col]
+            cap = d.shape[0]
+            ok = K.value_ok(d, v, K.row_mask(count, cap))
+            enc_last = jnp.where(ok, jnp.zeros((), jnp.uint8),
+                                 jnp.ones((), jnp.uint8))
+            s_rank, s_val = jax.lax.sort(
+                (enc_last, d.astype(jnp.float64)), num_keys=2,
+                is_stable=False)
+            cnt = jnp.sum(ok)
+            return s_val, cnt
+
+        fn = jax.jit(body)
+        _jit_cache[key] = fn
+    s_val, cnt = fn(src.device_data(), jnp.asarray(src.nrows))
+    n = int(jax.device_get(cnt))
+    if n == 0:
+        return float("nan")
+    qpos = (n - 1) * q
+    lo, hi = int(np.floor(qpos)), int(np.ceil(qpos))
+    vals = np.asarray(jax.device_get(s_val[lo:hi + 1]))
+    if lo == hi:
+        return float(vals[0])
+    return float(vals[0] + (vals[1] - vals[0]) * (qpos - lo))
 
 
 def _reduce_scalar(v, op: str, src: dt.DType, cnt: Optional[int]):

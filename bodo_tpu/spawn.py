@@ -76,11 +76,17 @@ def run_spmd(fn: Callable[[int], object], n_processes: int = 2,
             out_path = os.path.join(d, f"out_{i}.pkl")
             outs.append(out_path)
             env = dict(os.environ)
+            # workers must import this package (cloudpickle references it
+            # by module), wherever the parent had it on its path
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
             env.update({
                 "BODO_TPU_COORD": coord,
                 "BODO_TPU_NPROCS": str(n_processes),
                 "BODO_TPU_PROC_ID": str(i),
                 "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": pkg_root + os.pathsep +
+                env.get("PYTHONPATH", ""),
             })
             procs.append(subprocess.Popen(
                 [sys.executable, worker_py, payload, out_path],

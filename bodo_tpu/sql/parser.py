@@ -109,6 +109,14 @@ class Func:
 
 
 @dataclass
+class WindowA:
+    """fn(...) OVER (PARTITION BY ... ORDER BY ...)."""
+    func: "Func"
+    partition_by: List[Any]
+    order_by: List[Tuple[Any, bool]]  # (expr, ascending)
+
+
+@dataclass
 class Case:
     whens: List[Tuple[Any, Any]]
     else_: Any = None
@@ -223,6 +231,7 @@ _KEYWORDS = {
     "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "DISTINCT", "EXISTS",
     "ASC", "DESC", "DATE", "INTERVAL", "EXTRACT", "WITH", "UNION", "ALL",
     "SUBSTRING", "FOR", "NULLS", "FIRST", "LAST", "TRUE", "FALSE",
+    "OVER", "PARTITION",
 }
 
 
@@ -431,6 +440,31 @@ class Parser:
     def expr(self):
         return self.or_expr()
 
+    def _over_clause(self, fn: Func) -> WindowA:
+        self.eat_kw("OVER")
+        self.eat_op("(")
+        partition: List[Any] = []
+        order: List[Tuple[Any, bool]] = []
+        if self.try_kw("PARTITION"):
+            self.eat_kw("BY")
+            partition.append(self.expr())
+            while self.try_op(","):
+                partition.append(self.expr())
+        if self.try_kw("ORDER"):
+            self.eat_kw("BY")
+            while True:
+                e = self.expr()
+                asc = True
+                if self.try_kw("DESC"):
+                    asc = False
+                else:
+                    self.try_kw("ASC")
+                order.append((e, asc))
+                if not self.try_op(","):
+                    break
+        self.eat_op(")")
+        return WindowA(fn, partition, order)
+
     def or_expr(self):
         e = self.and_expr()
         while self.try_kw("OR"):
@@ -636,7 +670,10 @@ class Parser:
                     while self.try_op(","):
                         args.append(self.expr())
                     self.eat_op(")")
-                return Func(name.lower(), args, distinct=distinct)
+                fn = Func(name.lower(), args, distinct=distinct)
+                if self.kw("OVER"):
+                    return self._over_clause(fn)
+                return fn
             if self.try_op("."):
                 col = self.ident()
                 return Col(col, qualifier=name)

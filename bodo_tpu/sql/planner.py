@@ -248,6 +248,11 @@ class Planner:
             raise NotImplementedError("SELECT without FROM")
         plan, scope = self._plan_from_where(sel.from_item, sel.where, outer)
 
+        # window-function extraction (ROW_NUMBER/RANK/DENSE_RANK/NTILE
+        # OVER (...)): each becomes a RankWindow node over the FROM/WHERE
+        # plan; the select expr is replaced by its output column
+        plan, scope, sel = self._lower_windows(plan, scope, sel)
+
         # aggregate extraction
         aggs: List[Tuple[Expr, str, str]] = []   # (arg expr, op, temp name)
 
@@ -400,6 +405,75 @@ class Planner:
         if sel.limit is not None:
             plan = L.Limit(plan, sel.limit)
         return plan, out_names
+
+    _WINDOW_FUNCS = {"row_number": "row_number", "rank": "rank",
+                     "dense_rank": "dense_rank", "ntile": "ntile"}
+
+    def _lower_windows(self, plan, scope, sel):
+        """Replace WindowA select items with RankWindow output columns."""
+        found: List[Tuple[P.WindowA, str]] = []
+
+        def walk_replace(e):
+            if isinstance(e, P.WindowA):
+                if e.func.name not in self._WINDOW_FUNCS:
+                    raise NotImplementedError(
+                        f"window function {e.func.name}() — supported: "
+                        f"{sorted(self._WINDOW_FUNCS)}")
+                tmp = f"__win{len(found)}"
+                found.append((e, tmp))
+                return P.Col(tmp, qualifier="__agg")
+            for f in getattr(e, "__dataclass_fields__", {}):
+                v = getattr(e, f)
+                if isinstance(v, tuple(_AST_TYPES)):
+                    setattr(e, f, walk_replace(v))
+                elif isinstance(v, list):
+                    setattr(e, f, [walk_replace(x)
+                                   if isinstance(x, tuple(_AST_TYPES))
+                                   else x for x in v])
+            return e
+
+        sel.projections = [(walk_replace(e), a) for e, a in sel.projections]
+        sel.order_by = [(walk_replace(e), a) for e, a in sel.order_by]
+        if not found:
+            return plan, scope, sel
+        if sel.group_by or _contains_agg(sel.projections) or \
+                sel.having is not None:
+            raise NotImplementedError(
+                "window functions combined with GROUP BY/aggregates in one "
+                "SELECT — compute the aggregate in a subquery first")
+
+        for w, tmp in found:
+            pre: List[Tuple[str, Expr]] = [(c, ColRef(c))
+                                           for c in plan.schema]
+            pkeys: List[str] = []
+            for i, pe in enumerate(w.partition_by):
+                ex = self._expr(pe, scope, None, None)
+                if isinstance(ex, ColRef):
+                    pkeys.append(ex.name)
+                else:
+                    pre.append((f"{tmp}_p{i}", ex))
+                    pkeys.append(f"{tmp}_p{i}")
+            okeys: List[str] = []
+            asc: List[bool] = []
+            for i, (oe, a) in enumerate(w.order_by):
+                ex = self._expr(oe, scope, None, None)
+                if isinstance(ex, ColRef):
+                    okeys.append(ex.name)
+                else:
+                    pre.append((f"{tmp}_o{i}", ex))
+                    okeys.append(f"{tmp}_o{i}")
+                asc.append(a)
+            if len(pre) > len(plan.schema):
+                plan = L.Projection(plan, pre)
+            op = self._WINDOW_FUNCS[w.func.name]
+            param = 0
+            if op == "ntile":
+                if not (w.func.args and isinstance(w.func.args[0], P.Num)):
+                    raise NotImplementedError("NTILE needs a constant")
+                param = int(w.func.args[0].value)
+            plan = L.RankWindow(plan, pkeys, okeys, asc, [(op, param, tmp)])
+            scope.add("__agg", tmp, tmp)
+        return plan, scope, sel
 
     # ------------------------------------------------------------------
     # FROM + WHERE: join-graph construction
@@ -947,7 +1021,7 @@ class Planner:
 _AST_TYPES = (P.BinA, P.UnA, P.Func, P.Case, P.CastA, P.InList, P.Between,
               P.Like, P.Extract, P.Col, P.Num, P.Str, P.DateLit,
               P.IntervalLit, P.SubstringA, P.ScalarSubquery, P.InSelect,
-              P.Exists)
+              P.Exists, P.WindowA)
 
 
 def _contains_agg(projections) -> bool:

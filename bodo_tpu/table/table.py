@@ -306,9 +306,16 @@ class Table:
                          ) -> "Table":
         """Rebuild a Table from a kernel-output pytree, preserving schema
         metadata for columns that still exist (host-side dictionary
-        re-attachment — see module docstring)."""
+        re-attachment — see module docstring).
+
+        Column ORDER is restored from this table, not the pytree: jax
+        flattens dict pytrees in sorted-key order, so a dict that round-
+        tripped through a jitted kernel comes back alphabetized."""
+        order = [n for n in self.columns if n in tree] + \
+            [n for n in tree if n not in self.columns]
         cols = {}
-        for name, (data, valid) in tree.items():
+        for name in order:
+            data, valid = tree[name]
             if dtypes and name in dtypes:
                 dtype = dtypes[name]
             elif name in self.columns:

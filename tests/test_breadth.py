@@ -1,0 +1,202 @@
+"""Window/agg/reshape breadth: rank-family windows, quantile/median,
+nlargest, melt/pivot, string ops (VERDICT round-1 item 5).
+
+Reference analogues: bodo/libs/window/_window_aggfuncs.cpp,
+_quantile_alg.cpp, bodo/hiframes/pd_dataframe_ext.py melt/pivot,
+bodo/libs/dict_arr_ext.py string kernels."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bodo_tpu.pandas_api as bd
+from bodo_tpu.config import config, set_config
+
+
+def _df(n=2000, seed=0):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "k": r.integers(0, 9, n),
+        "v": r.normal(size=n),
+        "w": r.integers(0, 50, n),
+        "s": r.choice(["foo bar", "baz qux quux", "one", "a b"], n),
+    })
+    df.loc[r.random(n) < 0.08, "v"] = np.nan
+    return df
+
+
+@pytest.fixture(params=["rep", "1d"])
+def dist(request, mesh8):
+    old = config.shard_min_rows
+    set_config(shard_min_rows=(1 << 60) if request.param == "rep" else 0)
+    yield request.param
+    set_config(shard_min_rows=old)
+
+
+def test_groupby_median_quantile(dist):
+    df = _df()
+    got = (bd.from_pandas(df).groupby("k", as_index=False)
+           .agg(md=("v", "median"), q1=("v", "quantile_0.25"))
+           ).to_pandas().sort_values("k").reset_index(drop=True)
+    exp = df.groupby("k", as_index=False).agg(
+        md=("v", "median"), q1=("v", lambda s: s.quantile(0.25)))
+    np.testing.assert_allclose(got["md"], exp["md"], rtol=1e-12)
+    np.testing.assert_allclose(got["q1"], exp["q1"], rtol=1e-12)
+
+
+def test_groupby_nunique_distributed(dist):
+    df = _df()
+    got = (bd.from_pandas(df).groupby("k", as_index=False)
+           .agg(u=("w", "nunique"), us=("s", "nunique"))
+           ).to_pandas().sort_values("k").reset_index(drop=True)
+    exp = df.groupby("k", as_index=False).agg(u=("w", "nunique"),
+                                              us=("s", "nunique"))
+    assert got["u"].tolist() == exp["u"].tolist()
+    assert got["us"].tolist() == exp["us"].tolist()
+
+
+@pytest.mark.parametrize("method", ["first", "min", "dense"])
+def test_groupby_rank(dist, method):
+    df = _df()
+    got = bd.from_pandas(df).groupby("k")["w"].rank(method=method
+                                                    ).to_pandas()
+    exp = df.groupby("k")["w"].rank(method=method)
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy())
+
+
+def test_groupby_rank_descending(dist):
+    df = _df()
+    got = bd.from_pandas(df).groupby("k")["w"].rank(
+        method="min", ascending=False).to_pandas()
+    exp = df.groupby("k")["w"].rank(method="min", ascending=False)
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy())
+
+
+def test_groupby_cumcount_ntile(dist):
+    df = _df()
+    got = bd.from_pandas(df).groupby("k").cumcount().to_pandas()
+    np.testing.assert_allclose(got.to_numpy(),
+                               df.groupby("k").cumcount().to_numpy())
+    nt = bd.from_pandas(df).groupby("k").ntile(4).to_pandas().to_numpy()
+    assert nt.min() >= 1 and nt.max() <= 4
+    # balanced buckets per partition
+    for k in df["k"].unique():
+        cnts = np.bincount(nt[df["k"].to_numpy() == k])[1:]
+        cnts = cnts[cnts > 0]
+        assert cnts.max() - cnts.min() <= 1
+
+
+def test_series_median_quantile_nlargest(dist):
+    df = _df()
+    s, ps = bd.from_pandas(df)["v"], df["v"]
+    np.testing.assert_allclose(s.median(), ps.median(), rtol=1e-12)
+    np.testing.assert_allclose(s.quantile(0.9), ps.quantile(0.9),
+                               rtol=1e-12)
+    w, pw = bd.from_pandas(df)["w"], df["w"]
+    assert w.nlargest(9).tolist() == pw.nlargest(9).tolist()
+    assert w.nsmallest(3).tolist() == pw.nsmallest(3).tolist()
+
+
+def test_melt(dist):
+    df = _df().rename(columns={"v": "x", "w": "y"})[["k", "x", "y"]]
+    got = bd.from_pandas(df).melt(id_vars="k").to_pandas()
+    exp = df.melt(id_vars="k")
+    assert list(got.columns) == list(exp.columns)
+    assert got["variable"].tolist() == exp["variable"].tolist()
+    np.testing.assert_allclose(got["value"].fillna(-9e9),
+                               exp["value"].fillna(-9e9), rtol=1e-12)
+
+
+def test_pivot_table(dist):
+    df = _df()
+    df["cat"] = np.where(df["w"] % 2 == 0, "even", "odd")
+    got = bd.from_pandas(df).pivot_table(values="v", index="k",
+                                         columns="cat", aggfunc="sum")
+    exp = df.pivot_table(values="v", index="k", columns="cat",
+                         aggfunc="sum")
+    pd.testing.assert_frame_equal(got.sort_index(), exp.sort_index(),
+                                  check_names=False, rtol=1e-9)
+
+
+def test_str_transforms(mesh8):
+    df = _df()
+    s, ps = bd.from_pandas(df)["s"], df["s"]
+    assert s.str.upper().to_pandas().tolist() == ps.str.upper().tolist()
+    assert s.str.len().to_pandas().tolist() == ps.str.len().tolist()
+    assert s.str.replace("a", "@").to_pandas().tolist() == \
+        ps.str.replace("a", "@").tolist()
+    assert s.str.strip().to_pandas().tolist() == ps.str.strip().tolist()
+    assert s.str.slice(1, 4).to_pandas().tolist() == \
+        ps.str.slice(1, 4).tolist()
+
+
+def test_str_split_expand(mesh8):
+    df = _df()
+    got = bd.from_pandas(df)["s"].str.split(expand=True).to_pandas()
+    exp = df["s"].str.split(expand=True)
+    assert got.shape == exp.shape
+    for c in range(exp.shape[1]):
+        assert got[str(c)].fillna("<NA>").tolist() == \
+            exp[c].fillna("<NA>").tolist()
+
+
+def test_rank_window_relational_ntile_order(mesh8):
+    """ntile with an explicit ORDER BY column (SQL shape)."""
+    import bodo_tpu.relational as R
+    from bodo_tpu import Table
+    df = _df(500)
+    t = Table.from_pandas(df)
+    out = R.rank_window(t, ["k"], ["v"], [("row_number", 0, "rn")]
+                        ).to_pandas()
+    exp = df.groupby("k")["v"].rank(method="first")
+    # NaN values: SQL ranks them (na_last), pandas yields NaN — compare
+    # non-null rows only
+    m = df["v"].notna().to_numpy()
+    np.testing.assert_allclose(out["rn"].to_numpy()[m].astype(float),
+                               exp.to_numpy()[m])
+
+
+def test_sql_window_functions(mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    r = np.random.default_rng(1)
+    n = 300
+    df = pd.DataFrame({"dept": r.choice(["eng", "ops", "hr"], n),
+                       "emp": np.arange(n),
+                       "sal": r.integers(50, 200, n) * 1000})
+    ctx = BodoSQLContext({"emps": df})
+    got = ctx.sql("""
+      select dept, emp, sal,
+             row_number() over (partition by dept order by sal desc) as rn,
+             rank() over (partition by dept order by sal desc) as rk,
+             dense_rank() over (partition by dept order by sal desc) as dr,
+             ntile(4) over (partition by dept order by sal) as q
+      from emps
+    """).to_pandas().sort_values("emp").reset_index(drop=True)
+    g = df.groupby("dept")["sal"]
+    assert got["rn"].tolist() == \
+        g.rank(method="first", ascending=False).astype(int).tolist()
+    assert got["rk"].tolist() == \
+        g.rank(method="min", ascending=False).astype(int).tolist()
+    assert got["dr"].tolist() == \
+        g.rank(method="dense", ascending=False).astype(int).tolist()
+    assert got["q"].min() >= 1 and got["q"].max() <= 4
+
+
+def test_sql_topn_per_group(mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    r = np.random.default_rng(2)
+    df = pd.DataFrame({"dept": r.choice(["a", "b"], 100),
+                       "sal": r.permutation(100)})
+    ctx = BodoSQLContext({"emps": df})
+    got = ctx.sql("""
+      select dept, sal from (
+        select dept, sal,
+               row_number() over (partition by dept order by sal desc) as rn
+        from emps) t
+      where rn <= 3 order by dept, sal desc
+    """).to_pandas()
+    exp = (df.sort_values(["dept", "sal"], ascending=[True, False])
+           .groupby("dept").head(3)
+           .sort_values(["dept", "sal"], ascending=[True, False])
+           .reset_index(drop=True))
+    assert got["sal"].tolist() == exp["sal"].tolist()

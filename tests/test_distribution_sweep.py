@@ -1,0 +1,165 @@
+"""Distribution-sweep suite: every major frontend op through the
+check_func oracle under {rep, 1d8, 1d1} (+ a spawn shard).
+
+Port of the reference's check_func-based coverage strategy
+(/root/reference/bodo/tests/utils.py:157 and its use across
+bodo/tests/test_dataframe*.py, test_join.py, test_groupby.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import check_func, check_func_spawn
+
+
+def _base(n=600, seed=0, nulls=True):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": r.integers(0, 8, n),
+        "b": r.normal(size=n),
+        "c": r.choice(["x", "yy", "zzz", "w"], n),
+        "d": r.integers(-1000, 1000, n).astype(np.int32),
+        "t": pd.Timestamp("2024-01-01") +
+        pd.to_timedelta(r.integers(0, 10_000, n), unit="h"),
+    })
+    if nulls:
+        df.loc[r.random(n) < 0.1, "b"] = np.nan
+    return df
+
+
+AGG_CASES = ["sum", "mean", "count", "min", "max", "var", "std", "size",
+             "prod", "first", "last"]
+
+
+@pytest.mark.parametrize("op", AGG_CASES)
+def test_sweep_groupby_agg(mesh8, op):
+    check_func(
+        lambda df, _op=op: df.groupby("a", as_index=False)
+        .agg(out=("b", _op)),
+        [_base()])
+
+
+def test_sweep_groupby_multikey_string(mesh8):
+    check_func(
+        lambda df: df.groupby(["a", "c"], as_index=False)
+        .agg(s=("b", "sum"), n=("d", "count")),
+        [_base()])
+
+
+def test_sweep_groupby_nunique(mesh8):
+    check_func(
+        lambda df: df.groupby("a", as_index=False).agg(u=("c", "nunique")),
+        [_base()], modes=("rep", "1d1"))  # distributed nunique: gather path
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_sweep_merge(mesh8, how):
+    right = pd.DataFrame({"a": np.arange(8), "z": np.arange(8) * 1.5})
+    check_func(
+        lambda df, r, _how=how: df.merge(r, on="a", how=_how),
+        [_base(), right])
+
+
+def test_sweep_merge_string_key(mesh8):
+    left = _base()
+    right = pd.DataFrame({"c": ["x", "yy", "zzz"],
+                          "label": ["ex", "why", "zee"]})
+    check_func(lambda df, r: df.merge(r, on="c", how="inner"),
+               [left, right])
+
+
+def test_sweep_filter_project(mesh8):
+    check_func(
+        lambda df: df[(df["b"] > 0) & (df["a"] != 3)][["a", "b", "d"]],
+        [_base()])
+
+
+def test_sweep_assign_arith(mesh8):
+    def fn(df):
+        df = df.copy() if isinstance(df, pd.DataFrame) else df
+        df["e"] = df["b"] * 2 + df["d"]
+        df["f"] = abs(df["d"])
+        return df[["a", "e", "f"]]
+    check_func(fn, [_base()])
+
+
+def test_sweep_sort_values(mesh8):
+    check_func(lambda df: df.sort_values(["a", "d"]),
+               [_base()], sort_output=False)
+
+
+def test_sweep_sort_descending(mesh8):
+    check_func(
+        lambda df: df.sort_values(["a", "d"], ascending=[False, True]),
+        [_base()], sort_output=False)
+
+
+def test_sweep_drop_duplicates(mesh8):
+    check_func(lambda df: df[["a", "c"]].drop_duplicates(), [_base()])
+
+
+@pytest.mark.parametrize("red", ["sum", "mean", "min", "max", "count",
+                                 "std", "var"])
+def test_sweep_series_reductions(mesh8, red):
+    check_func(lambda df, _r=red: getattr(df["b"], _r)(), [_base()],
+               rtol=1e-9)
+
+
+def test_sweep_value_counts_shape(mesh8):
+    check_func(
+        lambda df: df.groupby("c", as_index=False).agg(n=("c", "size")),
+        [_base()])
+
+
+def test_sweep_dt_accessors(mesh8):
+    def fn(df):
+        df["month"] = df["t"].dt.month
+        df["dow"] = df["t"].dt.dayofweek
+        return df.groupby("month", as_index=False).agg(n=("dow", "count"))
+    check_func(fn, [_base()])
+
+
+def test_sweep_isin_where(mesh8):
+    check_func(lambda df: df[df["a"].isin([1, 3, 5])][["a", "d"]],
+               [_base()])
+
+
+def test_sweep_concat(mesh8):
+    import bodo_tpu.pandas_api as bd
+
+    def fn(df, df2):
+        mod = pd if isinstance(df, pd.DataFrame) else bd
+        return mod.concat([df, df2], ignore_index=True) \
+            .groupby("a", as_index=False).agg(s=("b", "sum"))
+    check_func(fn, [_base(seed=1), _base(seed=2)])
+
+
+def test_sweep_head(mesh8):
+    check_func(lambda df: df.sort_values(["d", "a"]).head(17),
+               [_base()], sort_output=False)
+
+
+def test_sweep_window_cumsum_shift(mesh8):
+    def fn(df):
+        df = df.sort_values(["d", "a"])
+        df["cs"] = df["b"].fillna(0.0).cumsum()
+        df["sh"] = df["b"].shift(1)
+        return df[["a", "cs", "sh"]]
+    check_func(fn, [_base()], sort_output=False, rtol=1e-6)
+
+
+@pytest.mark.slow_spawn
+def test_sweep_spawn_groupby():
+    check_func_spawn(
+        lambda df: df.groupby("a", as_index=False)
+        .agg(s=("b", "sum"), n=("d", "count")),
+        [_base(300)])
+
+
+@pytest.mark.slow_spawn
+def test_sweep_spawn_merge_sort():
+    right = pd.DataFrame({"a": np.arange(8), "z": np.arange(8) * 2.0})
+    check_func_spawn(
+        lambda df, r: df.merge(r, on="a", how="inner")
+        .sort_values(["d", "a"]).head(50),
+        [_base(300), right], sort_output=False)

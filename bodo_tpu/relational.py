@@ -821,6 +821,27 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
         out = _join_dense_try(left, right, left_on, right_on, how, suffixes)
         if out is not None:
             return out
+    if left.distribution == ONED and right.distribution == ONED and \
+            right.nrows <= config.bcast_join_threshold and \
+            left.nrows > 4 * right.nrows:
+        # runtime broadcast decision on ACTUAL sizes (not scan-time
+        # heuristics): replicating a small build side skips shuffling the
+        # big probe side entirely (reference: broadcast join sizing,
+        # bodo/libs/_shuffle.h:153)
+        right = right.gather()
+    elif how == "inner" and left.distribution == ONED and \
+            right.distribution == ONED and \
+            left.nrows <= config.bcast_join_threshold and \
+            right.nrows > 4 * left.nrows:
+        # mirror case: tiny LEFT side — swap (inner join is symmetric),
+        # broadcast it, and restore the left-then-right column order
+        out = join_tables(right, left, right_on, left_on, "inner",
+                          (suffixes[1], suffixes[0]))
+        lmap, rmap = _suffix_columns(left, right, left_on, right_on,
+                                     suffixes)
+        names = [lmap[n] for n in left.names] + \
+            [rmap[n] for n in right.names if n in rmap]
+        return out.select([n for n in names if n in out.columns])
     if left.distribution == ONED and right.distribution == ONED:
         return _join_sharded(left, right, left_on, right_on, how, suffixes)
     if left.distribution == ONED and right.distribution == REP:

@@ -527,6 +527,7 @@ class Planner:
 
         edges = []   # (rel_i, rel_j, flat_i, flat_j)
         others = []
+        single_rel: List[List] = [[] for _ in planned]
         for c in conjuncts:
             if isinstance(c, P.BinA) and c.op == "==" and \
                     isinstance(c.left, P.Col) and isinstance(c.right, P.Col):
@@ -536,14 +537,41 @@ class Planner:
                     fj = self._try_col(c.right, planned[rj][1])
                     edges.append((ri, rj, fi, fj))
                     continue
-            others.append(c)
+            # single-relation plain predicate → filter the relation before
+            # joining (shrinks join inputs AND sharpens the cardinality
+            # estimates the greedy ordering runs on)
+            ri = self._sole_rel(c, planned)
+            if ri is not None:
+                single_rel[ri].append(c)
+            else:
+                others.append(c)
 
-        # greedy connected join order; track which edges became join keys
-        used = {0}
-        plan, scope = planned[0]
+        for i, cs in enumerate(single_rel):
+            if not cs:
+                continue
+            p_i, s_i = planned[i]
+            pred = None
+            for c in cs:
+                e = self._expr(c, s_i, None, None)
+                pred = e if pred is None else BinOp("&", pred, e)
+            planned[i] = (L.Filter(p_i, pred), s_i)
+
+        # greedy cost-based join order (replaces the reference's vendored
+        # DuckDB join-order optimizer, bodo/pandas/plan.py
+        # get_plan_cardinality): start from the smallest-estimate relation
+        # with edges, then repeatedly join the connected relation whose
+        # estimated output is smallest
+        from bodo_tpu.plan.stats import estimate, join_estimate
+        ests = [estimate(p) for p, _ in planned]
+        has_edge = {r for e in edges for r in (e[0], e[1])}
+        start = min(range(len(planned)),
+                    key=lambda i: (i not in has_edge, ests[i][0]))
+        used = {start}
+        plan, scope = planned[start]
+        cur_est, cur_raw = ests[start]
         consumed: set = set()
         while len(used) < len(planned):
-            batch = None
+            best = None
             for i in range(len(planned)):
                 if i in used:
                     continue
@@ -560,20 +588,31 @@ class Planner:
                         keys_r.append(fi)
                         ids.append(eid)
                 if keys_l:
-                    batch = (i, keys_l, keys_r, ids)
-                    break
-            if batch is None:
-                # disconnected — true cross join with the next relation
-                i = next(j for j in range(len(planned)) if j not in used)
+                    out = join_estimate(cur_est, cur_raw, *ests[i])
+                    if best is None or out < best[0]:
+                        best = (out, i, keys_l, keys_r, ids)
+            if best is None:
+                # disconnected — cross join with the smallest remainder
+                i = min((j for j in range(len(planned)) if j not in used),
+                        key=lambda j: ests[j][0])
                 plan = self._cross_join(plan, planned[i][0])
                 scope = scope.merged(planned[i][1])
+                cur_est *= max(ests[i][0], 1.0)
+                cur_raw = max(cur_raw, ests[i][1])
                 used.add(i)
                 continue
-            i, keys_l, keys_r, ids = batch
+            out, i, keys_l, keys_r, ids = best
             plan = L.Join(plan, planned[i][0], keys_l, keys_r, "inner")
             scope = scope.merged(planned[i][1])
+            cur_est, cur_raw = out, max(cur_raw, ests[i][1])
             used.add(i)
             consumed.update(ids)
+        # restore FROM-list column order (SELECT * and positional
+        # consumers must not see the cost-based join order)
+        from_order = [c for p, _ in planned for c in p.schema]
+        if list(plan.schema) != from_order:
+            plan = L.Projection(plan, [(n, ColRef(n)) for n in from_order
+                                       if n in plan.schema])
         # cycle edges not consumed as join keys → equality filters on the
         # joined table (flat names are globally unique, reference directly)
         residual_eq: Optional[Expr] = None
@@ -592,6 +631,30 @@ class Planner:
         if w is not None:
             plan = self._plan_where(plan, scope, w)
         return plan, scope
+
+    def _sole_rel(self, c, planned):
+        """Index of the single relation that resolves every column in a
+        plain conjunct, or None (multi-relation / subquery / ambiguous)."""
+        has_sub = [False]
+
+        def look(x):
+            if isinstance(x, (P.InSelect, P.Exists, P.ScalarSubquery)):
+                has_sub[0] = True
+            return x
+        self._walk_ast(c, look)
+        if has_sub[0]:
+            return None
+        cols = self._collect_cols(c)
+        if not cols:
+            return None
+        rels = set()
+        for col in cols:
+            hits = [i for i, (_, s) in enumerate(planned)
+                    if self._try_col(col, s)]
+            if len(hits) != 1:
+                return None
+            rels.add(hits[0])
+        return rels.pop() if len(rels) == 1 else None
 
     # ------------------------------------------------------------------
     # WHERE with subquery lowering

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import glob as globmod
 import os
+
+import numpy as np
 from typing import Optional, Sequence
 
 import pyarrow as pa
@@ -74,5 +76,98 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
 
 
 def write_parquet(t: Table, path: str, index: bool = False) -> None:
-    at = table_to_arrow(t)
-    pq.write_table(at, path)
+    """Write a Table to parquet.
+
+    REP tables write one file. 1D tables write a DIRECTORY of per-shard
+    part files with no gather — each shard's rows leave the device
+    straight into its own file (the reference's parallel writer,
+    bodo/io/parquet_write.cpp: one file per rank under a directory; in a
+    multi-host launch each process writes only its addressable shards).
+    """
+    if t.distribution != "1D" or t.num_shards == 1:
+        pq.write_table(table_to_arrow(t), path)
+        return
+    # destination hygiene: a prior single-file write leaves a regular
+    # file; a prior wider-mesh write leaves extra part files that the
+    # recursive reader glob would silently concatenate with the new ones
+    if os.path.isfile(path):
+        os.unlink(path)
+    os.makedirs(path, exist_ok=True)
+    import jax
+    if jax.process_index() == 0:
+        for stale in globmod.glob(os.path.join(path, "part-*.parquet")):
+            os.unlink(stale)
+    per = t.shard_capacity
+    # iterate ADDRESSABLE shards only: every process writes exactly the
+    # shards it owns, with no cross-process data movement (touching a
+    # non-addressable region of a global array would force a collective
+    # and deadlock against peers writing different shards)
+    local: dict = {}  # shard index -> {col: host array}
+    for name, c in t.columns.items():
+        for sh in c.data.addressable_shards:
+            start = sh.index[0].start or 0
+            local.setdefault(start // per, {})[name] = \
+                np.asarray(sh.data)
+        if c.valid is not None:
+            for sh in c.valid.addressable_shards:
+                start = sh.index[0].start or 0
+                local[start // per][f"__valid__{name}"] = \
+                    np.asarray(sh.data)
+    for shard in sorted(local):
+        data = local[shard]
+        n = int(t.counts[shard])
+        piece = _host_piece(t, data, n)
+        pq.write_table(table_to_arrow(piece),
+                       os.path.join(path, f"part-{shard:05d}.parquet"))
+
+
+def _host_piece(t: Table, data: dict, n: int) -> Table:
+    """Rebuild one shard's live rows as a REP table from host arrays."""
+    import jax.numpy as jnp
+
+    from bodo_tpu.table.table import Column, Table as _T, round_capacity
+    cap = round_capacity(max(n, 1))
+    cols = {}
+    for name, c in t.columns.items():
+        host = data[name]
+        padded = np.zeros((cap,), dtype=host.dtype)
+        padded[:n] = host[:n]
+        valid = None
+        if c.valid is not None:
+            hv = data[f"__valid__{name}"]
+            pv = np.zeros((cap,), dtype=bool)
+            pv[:n] = hv[:n]
+            valid = jnp.asarray(pv)
+        cols[name] = Column(jnp.asarray(padded), valid, c.dtype,
+                            c.dictionary)
+    return _T(cols, n, "REP", None)
+
+
+class StreamingParquetWriter:
+    """Batch-at-a-time parquet sink (reference:
+    bodo/io/stream_parquet_write.py ParquetWriter): each pushed batch
+    appends one row group; device memory stays O(batch)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._writer = None
+
+    def push(self, t: Table) -> None:
+        if t.nrows == 0 and self._writer is not None:
+            return
+        at = table_to_arrow(t)
+        if self._writer is None:
+            self._writer = pq.ParquetWriter(self._path, at.schema)
+        self._writer.write_table(at)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

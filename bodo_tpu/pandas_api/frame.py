@@ -229,6 +229,25 @@ class BodoDataFrame:
         return pdf.pivot(index=idx, columns=col, values="__v") \
             .rename_axis(columns=None if len(col) == 1 else col)
 
+    def to_parquet(self, path: str, index: bool = False) -> None:
+        """Write to parquet: streaming row groups when the plan is a
+        streamable chain, per-shard part files for 1D tables, one file
+        otherwise. Tables are index-free; index=True has nothing to
+        write."""
+        if index:
+            warn_fallback("DataFrame.to_parquet",
+                          "index=True — tables are index-free")
+        from bodo_tpu.config import config
+        from bodo_tpu.io.parquet import write_parquet
+        from bodo_tpu.plan.optimizer import optimize
+        from bodo_tpu.plan.physical import execute
+        plan = optimize(self._plan)
+        if config.stream_exec:
+            from bodo_tpu.plan import streaming
+            if streaming.stream_to_parquet(plan, path):
+                return
+        write_parquet(execute(plan, optimize_first=False), path)
+
     def drop(self, columns=None, **kw) -> "BodoDataFrame":
         if columns is None:
             warn_fallback("DataFrame.drop", "only columns= supported")
@@ -308,10 +327,6 @@ class BodoDataFrame:
 
     def to_pandas(self) -> pd.DataFrame:
         return self._execute().to_pandas()
-
-    def to_parquet(self, path: str, index: bool = False) -> None:
-        from bodo_tpu.io import write_parquet
-        write_parquet(self._execute(), path)
 
     def __len__(self) -> int:
         return self._execute().nrows

@@ -30,6 +30,7 @@ async-shuffle milestone.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -525,6 +526,44 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
                 yield join(b)
         return gen_join(inner)
     return None
+
+
+def stream_to_parquet(node: L.Node, path: str) -> bool:
+    """Stream an (already optimized) plan straight into a parquet file,
+    one row group per batch — end-to-end bounded device memory for
+    scan→filter→project→write shapes (reference:
+    bodo/io/stream_parquet_write.py). Returns False when the plan isn't a
+    streamable chain (caller materializes). Caller gates on
+    config.stream_exec."""
+    if mesh_mod.num_shards() > 1:
+        return False
+    # writing over one of the plan's own sources would truncate it while
+    # the lazy reader is mid-file — materialize instead
+    target = os.path.abspath(path)
+
+    def reads_target(n: L.Node) -> bool:
+        if isinstance(n, (L.ReadParquet, L.ReadCsv)):
+            src_p = os.path.abspath(n.path)
+            if src_p == target or src_p.startswith(target + os.sep) or \
+                    target.startswith(src_p + os.sep):
+                return True
+        return any(reads_target(c) for c in n.children)
+
+    if reads_target(node):
+        return False
+    src = _build_stream(node)
+    if src is None:
+        return False
+    from bodo_tpu.io.parquet import StreamingParquetWriter
+    n = 0
+    with StreamingParquetWriter(path) as w:
+        for b in src:
+            w.push(b)
+            n += 1
+    if n == 0:
+        return False  # empty stream: no schema to write — materialize
+    log(1, f"streaming parquet write: {n} batches -> {path}")
+    return True
 
 
 def try_stream_execute(node: L.Node) -> Optional[Table]:

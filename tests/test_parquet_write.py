@@ -1,0 +1,134 @@
+"""Distributed + streaming parquet write (VERDICT round-1 item 8).
+
+Reference analogues: bodo/io/parquet_write.cpp (per-rank part files),
+bodo/io/stream_parquet_write.py (batched row-group writer)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow.parquet as pq
+import pytest
+
+import bodo_tpu
+from bodo_tpu.config import config, set_config
+
+
+def _df(n=5000, seed=0):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "k": r.integers(0, 20, n),
+        "v": r.normal(size=n),
+        "s": r.choice(["aa", "bb", "cc"], n),
+        "t": pd.Timestamp("2024-01-01") +
+        pd.to_timedelta(r.integers(0, 1000, n), unit="h"),
+    })
+    df.loc[r.random(n) < 0.1, "v"] = np.nan
+    return df
+
+
+def test_write_rep_single_file(mesh8, tmp_path):
+    from bodo_tpu import Table
+    from bodo_tpu.io.parquet import write_parquet
+    df = _df()
+    p = str(tmp_path / "rep.parquet")
+    write_parquet(Table.from_pandas(df), p)
+    back = pd.read_parquet(p)
+    assert back["k"].tolist() == df["k"].tolist()
+    assert back["s"].tolist() == df["s"].tolist()
+
+
+def test_write_sharded_part_files_no_gather(mesh8, tmp_path):
+    """1D write emits one part file per shard; gather() must not run."""
+    from bodo_tpu import Table
+    from bodo_tpu.io import read_parquet
+    from bodo_tpu.io.parquet import write_parquet
+    df = _df()
+    t = Table.from_pandas(df).shard()
+    called = []
+    orig = Table.gather
+    Table.gather = lambda self: (called.append(1), orig(self))[1]
+    try:
+        p = str(tmp_path / "sharded_pq")
+        write_parquet(t, p)
+    finally:
+        Table.gather = orig
+    assert not called, "distributed write must not gather"
+    parts = sorted(os.listdir(p))
+    assert len(parts) == t.num_shards
+    back = pd.read_parquet(p).sort_values(["k", "v"])
+    exp = df.sort_values(["k", "v"])
+    np.testing.assert_allclose(back["v"].fillna(-9e9),
+                               exp["v"].fillna(-9e9), rtol=1e-12)
+    assert back["s"].tolist() == exp["s"].tolist()
+    # and the engine's own reader round-trips the directory
+    rt = read_parquet(p).to_pandas()
+    assert len(rt) == len(df)
+
+
+def test_streaming_write_row_groups(mesh8, tmp_path):
+    """Streaming sink: multiple batches → multiple row groups, bounded
+    memory, correct content."""
+    import jax
+
+    import bodo_tpu.pandas_api as bd
+    old_mesh = bodo_tpu.parallel.mesh.get_mesh()
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(jax.devices()[:1]))
+    old = (config.stream_exec, config.streaming_batch_size)
+    set_config(stream_exec=True, streaming_batch_size=1000)
+    try:
+        df = _df(4800, seed=1)
+        src = str(tmp_path / "src.parquet")
+        df.to_parquet(src)
+        out = str(tmp_path / "out.parquet")
+        b = bd.read_parquet(src)
+        b[b["v"] > 0].to_parquet(out)
+        meta = pq.ParquetFile(out).metadata
+        assert meta.num_row_groups >= 4  # really streamed
+        back = pd.read_parquet(out)
+        exp = df[df["v"] > 0].reset_index(drop=True)
+        assert len(back) == len(exp)
+        np.testing.assert_allclose(back["v"], exp["v"], rtol=1e-12)
+        assert back["s"].tolist() == exp["s"].tolist()
+    finally:
+        set_config(stream_exec=old[0], streaming_batch_size=old[1])
+        bodo_tpu.set_mesh(old_mesh)
+
+
+@pytest.mark.slow_spawn
+def test_write_multiprocess_spawn(tmp_path):
+    """Each spawned process writes only its addressable shards
+    (the reference's per-rank parallel write under mpiexec)."""
+    from bodo_tpu.spawn import run_spmd
+    out = str(tmp_path / "spawn_pq")
+
+    def worker(rank, _out=out, n=1200, seed=2):
+        # regenerate inside the worker; NaNs are excluded because jax's
+        # multi-process device_put value check treats NaN != NaN
+        import numpy as np
+        import pandas as pd
+        r = np.random.default_rng(seed)
+        _df = pd.DataFrame({
+            "k": r.integers(0, 20, n),
+            "v": r.normal(size=n),
+            "s": r.choice(["aa", "bb", "cc"], n),
+        })
+        import bodo_tpu
+        from bodo_tpu import Table
+        from bodo_tpu.io.parquet import write_parquet
+        bodo_tpu.set_mesh(bodo_tpu.make_mesh())
+        t = Table.from_pandas(_df).shard()
+        write_parquet(t, _out)
+        return t.num_shards
+
+    results = run_spmd(worker, n_processes=2)
+    assert results[0] == results[1]
+    r = np.random.default_rng(2)
+    exp = pd.DataFrame({
+        "k": r.integers(0, 20, 1200),
+        "v": r.normal(size=1200),
+        "s": r.choice(["aa", "bb", "cc"], 1200),
+    }).sort_values(["k", "v"])
+    back = pd.read_parquet(out).sort_values(["k", "v"])
+    assert len(back) == len(exp)
+    assert back["s"].tolist() == exp["s"].tolist()

@@ -85,18 +85,26 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
     multi-host launch each process writes only its addressable shards).
     """
     if t.distribution != "1D" or t.num_shards == 1:
+        if os.path.isdir(path):
+            _clear_part_dir(path)  # prior sharded write left a directory
+            os.rmdir(path)
         pq.write_table(table_to_arrow(t), path)
         return
+    import jax
+
     # destination hygiene: a prior single-file write leaves a regular
     # file; a prior wider-mesh write leaves extra part files that the
-    # recursive reader glob would silently concatenate with the new ones
-    if os.path.isfile(path):
-        os.unlink(path)
-    os.makedirs(path, exist_ok=True)
-    import jax
+    # recursive reader glob would silently concatenate with the new
+    # ones. Only process 0 cleans, and everyone barriers BEFORE any rank
+    # writes, so cleanup can never race a peer's fresh part file.
     if jax.process_index() == 0:
-        for stale in globmod.glob(os.path.join(path, "part-*.parquet")):
-            os.unlink(stale)
+        if os.path.isfile(path):
+            os.unlink(path)
+        os.makedirs(path, exist_ok=True)
+        _clear_part_dir(path)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("bodo_tpu_pq_write_clean")
     per = t.shard_capacity
     # iterate ADDRESSABLE shards only: every process writes exactly the
     # shards it owns, with no cross-process data movement (touching a
@@ -119,6 +127,19 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
         piece = _host_piece(t, data, n)
         pq.write_table(table_to_arrow(piece),
                        os.path.join(path, f"part-{shard:05d}.parquet"))
+
+
+def _clear_part_dir(path: str) -> None:
+    """Remove our own part files from a destination directory. Refuses
+    directories containing anything else (don't delete user data)."""
+    others = [f for f in os.listdir(path)
+              if not (f.startswith("part-") and f.endswith(".parquet"))]
+    if others:
+        raise ValueError(
+            f"refusing to overwrite {path}: directory contains non-part "
+            f"files {others[:3]}")
+    for f in globmod.glob(os.path.join(path, "part-*.parquet")):
+        os.unlink(f)
 
 
 def _host_piece(t: Table, data: dict, n: int) -> Table:
@@ -157,6 +178,9 @@ class StreamingParquetWriter:
             return
         at = table_to_arrow(t)
         if self._writer is None:
+            if os.path.isdir(self._path):  # prior sharded write
+                _clear_part_dir(self._path)
+                os.rmdir(self._path)
             self._writer = pq.ParquetWriter(self._path, at.schema)
         self._writer.write_table(at)
 

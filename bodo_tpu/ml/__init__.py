@@ -10,11 +10,17 @@ loop, and the MXU does the matmuls.
 
 from bodo_tpu.ml.linear import LinearRegression, LogisticRegression, Ridge
 from bodo_tpu.ml.cluster import KMeans
+from bodo_tpu.ml.ensemble import (RandomForestClassifier,
+                                  RandomForestRegressor)
+from bodo_tpu.ml.naive_bayes import GaussianNB
 from bodo_tpu.ml.preprocessing import StandardScaler, LabelEncoder
 from bodo_tpu.ml.metrics import (accuracy_score, mean_squared_error,
                                  r2_score)
 from bodo_tpu.ml.model_selection import train_test_split
+from bodo_tpu.ml.svm import LinearSVC
 
 __all__ = ["LinearRegression", "LogisticRegression", "Ridge", "KMeans",
+           "RandomForestClassifier", "RandomForestRegressor",
+           "GaussianNB", "LinearSVC",
            "StandardScaler", "LabelEncoder", "accuracy_score",
            "mean_squared_error", "r2_score", "train_test_split"]

@@ -1,0 +1,154 @@
+"""Random forests — estimator-parallel training, gathered forest.
+
+Same strategy as the reference (sklearn_ensemble_ext.py): n_estimators
+are split across processes, each trains scikit-learn trees on its LOCAL
+row block, and the trees are gathered into one global forest on every
+rank. Tree construction is branchy/host-bound (no TPU win there — the
+reference reaches the same conclusion by delegating to sklearn); the
+engine's role is the data distribution and the estimator split.
+
+In a single-controller session (jax.process_count() == 1) the split
+degenerates to per-shard row blocks trained sequentially — the same
+bagging structure, one process. Under spawn.run_spmd each process
+trains only its share.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bodo_tpu.ml._data import _to_numpy_1d, _to_numpy_2d
+
+
+def _portion(n: int, parts: int, i: int) -> slice:
+    lo = (n * i) // parts
+    hi = (n * (i + 1)) // parts
+    return slice(lo, hi)
+
+
+class _ForestBase:
+    _is_classifier = False
+
+    def __init__(self, n_estimators: int = 100, random_state=None, **kw):
+        self.n_estimators = n_estimators
+        self.random_state = random_state
+        self._kw = kw
+
+    @classmethod
+    def _sk_class(cls):
+        raise NotImplementedError
+
+    def fit(self, X, y):
+        import jax
+        X = _to_numpy_2d(X)
+        yv = _to_numpy_1d(y)
+        pi, pc = jax.process_index(), jax.process_count()
+        if pc == 1:
+            # single controller: train block-wise forests over shard-sized
+            # row blocks (bagging across blocks), then concatenate
+            from bodo_tpu.parallel import mesh as mesh_mod
+            parts = max(1, min(mesh_mod.num_shards(), self.n_estimators))
+        else:
+            parts = pc
+        classes = np.unique(yv) if self._is_classifier else None
+        forests = []
+        my_parts = range(parts) if pc == 1 else [pi]
+        for p in my_parts:
+            rows = _portion(len(X), parts, p)
+            est = _portion(self.n_estimators, parts, p)
+            n_est = est.stop - est.start
+            if n_est == 0 or rows.stop == rows.start:
+                continue
+            Xb, yb = X[rows], yv[rows]
+            if classes is not None and \
+                    len(np.unique(yb)) < len(classes):
+                # a block missing a class would merge trees with
+                # mismatched classes_ — train its share on all rows
+                Xb, yb = X, yv
+            m = self._sk_class()(
+                n_estimators=n_est,
+                random_state=None if self.random_state is None
+                else self.random_state + p, **self._kw)
+            m.fit(Xb, yb)
+            forests.append(m)
+        if pc > 1:
+            # every rank joins the collective, even trained-empty ones
+            # (n_estimators < process_count): ship a skeleton whose trees
+            # are discarded so shapes/classes stay consistent
+            if not forests:
+                skel = self._sk_class()(n_estimators=1, **self._kw)
+                skel.fit(X, yv)
+                skel.estimators_ = []
+                forests = [skel]
+            self._merge(forests)
+            self._allgather()
+        else:
+            self._merge(forests)
+        return self
+
+    def _merge(self, forests):
+        assert forests, "no training data"
+        base = forests[0]
+        for m in forests[1:]:
+            base.estimators_ += m.estimators_
+        base.n_estimators = len(base.estimators_)
+        self._model = base
+
+    def _allgather(self):
+        """Gather trees from every process (reference: chunked
+        MPI bcast of estimators_, sklearn_ensemble_ext.py:304)."""
+        from jax.experimental import multihost_utils
+        import pickle
+
+        import jax
+        import numpy as np_
+        blob = pickle.dumps(self._model.estimators_)
+        arr = np_.frombuffer(blob, dtype=np_.uint8)
+        # pad to the max length across processes for the allgather
+        n = np_.asarray([len(arr)], dtype=np_.int32)
+        lens = multihost_utils.process_allgather(n).reshape(-1)
+        mx = int(lens.max())
+        padded = np_.zeros(mx, dtype=np_.uint8)
+        padded[:len(arr)] = arr
+        gathered = multihost_utils.process_allgather(padded)
+        all_est = []
+        for i in range(jax.process_count()):
+            all_est += pickle.loads(gathered[i][:int(lens[i])].tobytes())
+        self._model.estimators_ = all_est
+        self._model.n_estimators = len(all_est)
+
+    def predict(self, X):
+        return self._model.predict(_to_numpy_2d(X))
+
+    def score(self, X, y) -> float:
+        return float(np.mean(self.predict(X) == _to_numpy_1d(y)))
+
+    @property
+    def estimators_(self):
+        return self._model.estimators_
+
+
+class RandomForestClassifier(_ForestBase):
+    _is_classifier = True
+
+    @classmethod
+    def _sk_class(cls):
+        from sklearn.ensemble import RandomForestClassifier as SK
+        return SK
+
+    def predict_proba(self, X):
+        return self._model.predict_proba(_to_numpy_2d(X))
+
+
+class RandomForestRegressor(_ForestBase):
+    @classmethod
+    def _sk_class(cls):
+        from sklearn.ensemble import RandomForestRegressor as SK
+        return SK
+
+    def score(self, X, y) -> float:  # R^2, sklearn convention
+        yv = _to_numpy_1d(y).astype(float)
+        pred = self.predict(X)
+        ss_res = float(((yv - pred) ** 2).sum())
+        ss_tot = float(((yv - yv.mean()) ** 2).sum())
+        return 1.0 - ss_res / max(ss_tot, 1e-300)

@@ -1,0 +1,167 @@
+"""Probabilistic sketches: theta (distinct count), bloom (membership),
+t-digest (quantiles).
+
+Replaces the reference's sketch libraries (bodo/libs/_theta_sketches.cpp
+via Apache DataSketches, _bodo_tdigest.cpp, the bloom filter in
+_join_hashing): theta and bloom build on-device with the engine's
+splitmix64 hashing (one pass, mergeable across shards — merge is how the
+distributed build works: per-shard sketches combine associatively);
+t-digest compresses on host (it feeds planner statistics, not the data
+path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bodo_tpu.ops.hashing import hash_columns
+
+
+def _hash_u64(data, valid=None):
+    h = hash_columns(((data, valid),))
+    return h.view(jnp.uint64) if h.dtype != jnp.uint64 else h
+
+
+# ---------------------------------------------------------------------------
+# theta sketch: K smallest normalized hashes -> distinct estimate
+# ---------------------------------------------------------------------------
+
+class ThetaSketch:
+    """KMV (K minimum values) theta sketch. estimate() ≈ ndv."""
+
+    def __init__(self, k: int = 4096, values: Optional[np.ndarray] = None):
+        self.k = k
+        self._vals = values if values is not None else \
+            np.empty(0, np.uint64)
+
+    @staticmethod
+    def build(data, valid=None, k: int = 4096) -> "ThetaSketch":
+        h = _hash_u64(data, valid)
+        sentinel = np.uint64(0xFFFFFFFFFFFFFFFF)
+        if valid is not None:
+            h = jnp.where(valid, h, jnp.uint64(sentinel))
+        # k smallest DISTINCT hashes: dedupe before truncating, or the
+        # smallest slots are dominated by repeats of frequent values
+        # (np.unique sorts — no need to pre-sort on device)
+        uniq = np.unique(np.asarray(jax.device_get(h)))
+        uniq = uniq[uniq != sentinel]  # nulls are not a distinct value
+        return ThetaSketch(k, uniq[:k])
+
+    def merge(self, other: "ThetaSketch") -> "ThetaSketch":
+        vals = np.unique(np.concatenate([self._vals, other._vals]))[:self.k]
+        return ThetaSketch(self.k, vals)
+
+    def estimate(self) -> float:
+        m = len(self._vals)
+        if m == 0:
+            return 0.0
+        if m < self.k:  # exact regime
+            return float(m)
+        theta = float(self._vals[self.k - 1]) / float(2**64)
+        return (self.k - 1) / max(theta, 1e-300)
+
+
+# ---------------------------------------------------------------------------
+# bloom filter
+# ---------------------------------------------------------------------------
+
+class BloomFilter:
+    """Split bloom filter: d hash probes into an m-bit array (device
+    scatter build, device gather probe — usable as a join prefilter)."""
+
+    def __init__(self, m_bits: int = 1 << 20, d: int = 4,
+                 bits: Optional[jnp.ndarray] = None):
+        self.m = m_bits
+        self.d = d
+        self.bits = bits if bits is not None else \
+            jnp.zeros((m_bits,), dtype=bool)
+
+    def add(self, data, valid=None) -> "BloomFilter":
+        h = _hash_u64(data, valid)
+        bits = self.bits
+        for i in range(self.d):
+            idx = ((h >> jnp.uint64(i * 13)).astype(jnp.uint32)
+                   % jnp.uint32(self.m)).astype(jnp.int32)
+            if valid is not None:
+                idx = jnp.where(valid, idx, self.m)  # dropped
+            bits = bits.at[idx].set(True, mode="drop")
+        return BloomFilter(self.m, self.d, bits)
+
+    def contains(self, data):
+        h = _hash_u64(data)
+        ok = jnp.ones(h.shape, dtype=bool)
+        for i in range(self.d):
+            idx = ((h >> jnp.uint64(i * 13)).astype(jnp.uint32)
+                   % jnp.uint32(self.m)).astype(jnp.int32)
+            ok = ok & self.bits[idx]
+        return ok
+
+    def merge(self, other: "BloomFilter") -> "BloomFilter":
+        return BloomFilter(self.m, self.d, self.bits | other.bits)
+
+
+# ---------------------------------------------------------------------------
+# t-digest (host): mergeable quantile sketch
+# ---------------------------------------------------------------------------
+
+class TDigest:
+    """Simplified merging t-digest (Dunning): centroids kept under the
+    k1 scale-function size bound; add/merge/quantile. Host-side numpy —
+    it summarizes columns for planner statistics."""
+
+    def __init__(self, compression: float = 100.0):
+        self.compression = compression
+        self.means = np.empty(0)
+        self.weights = np.empty(0)
+
+    def add(self, values: np.ndarray) -> "TDigest":
+        v = np.asarray(values, dtype=np.float64)
+        v = v[~np.isnan(v)]
+        if len(v) == 0:
+            return self
+        self.means = np.concatenate([self.means, v])
+        self.weights = np.concatenate([self.weights, np.ones(len(v))])
+        self._compress()
+        return self
+
+    def merge(self, other: "TDigest") -> "TDigest":
+        out = TDigest(self.compression)
+        out.means = np.concatenate([self.means, other.means])
+        out.weights = np.concatenate([self.weights, other.weights])
+        out._compress()
+        return out
+
+    def _compress(self):
+        if len(self.means) <= self.compression:
+            order = np.argsort(self.means, kind="stable")
+            self.means, self.weights = self.means[order], \
+                self.weights[order]
+            return
+        order = np.argsort(self.means, kind="stable")
+        means, weights = self.means[order], self.weights[order]
+        total = weights.sum()
+        # q-limits from the k1 scale function
+        n_cent = int(self.compression)
+        qlim = np.sin(np.linspace(-np.pi / 2, np.pi / 2, n_cent + 1))
+        qlim = (qlim + 1) / 2
+        cum = np.cumsum(weights) / total
+        bucket = np.clip(np.searchsorted(qlim, cum, side="left") - 1,
+                         0, n_cent - 1)
+        new_m = np.zeros(n_cent)
+        new_w = np.zeros(n_cent)
+        np.add.at(new_w, bucket, weights)
+        np.add.at(new_m, bucket, weights * means)
+        keep = new_w > 0
+        self.means = new_m[keep] / new_w[keep]
+        self.weights = new_w[keep]
+
+    def quantile(self, q: float) -> float:
+        if len(self.means) == 0:
+            return float("nan")
+        cum = np.cumsum(self.weights) - self.weights / 2
+        target = q * self.weights.sum()
+        return float(np.interp(target, cum, self.means))

@@ -95,3 +95,67 @@ def test_ml_from_lazy_frame(mesh8, rng):
     b = bd.from_pandas(df)
     m = LinearRegression().fit(b[["x1", "x2"]], b["y"])
     np.testing.assert_allclose(m.coef_, [2, -1], atol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# ML breadth: GaussianNB / LinearSVC / RandomForest (VERDICT item 10;
+# reference sklearn_naive_bayes_ext.py, sklearn_svm_ext.py,
+# sklearn_ensemble_ext.py)
+# ---------------------------------------------------------------------------
+
+def _clf_data(n=2000, seed=0, n_classes=3):
+    r = np.random.default_rng(seed)
+    centers = r.normal(size=(n_classes, 4)) * 4
+    y = r.integers(0, n_classes, n)
+    X = centers[y] + r.normal(size=(n, 4))
+    return X, y
+
+
+def test_gaussian_nb_vs_sklearn(mesh8):
+    from sklearn.naive_bayes import GaussianNB as SKNB
+
+    from bodo_tpu.ml import GaussianNB
+    X, y = _clf_data()
+    ours = GaussianNB().fit(X, y)
+    sk = SKNB().fit(X, y)
+    np.testing.assert_allclose(ours.theta_, sk.theta_, rtol=1e-9)
+    np.testing.assert_allclose(ours.class_prior_, sk.class_prior_,
+                               rtol=1e-12)
+    agree = np.mean(ours.predict(X) == sk.predict(X))
+    assert agree > 0.99, agree
+
+
+def test_linear_svc_accuracy(mesh8):
+    from sklearn.svm import LinearSVC as SKSVC
+
+    from bodo_tpu.ml import LinearSVC
+    X, y = _clf_data(n_classes=2, seed=1)
+    ours = LinearSVC(max_iter=2000).fit(X, y)
+    sk = SKSVC().fit(X, y)
+    acc_ours = ours.score(X, y)
+    acc_sk = float(np.mean(sk.predict(X) == y))
+    assert acc_ours >= acc_sk - 0.01, (acc_ours, acc_sk)
+
+    # multiclass one-vs-rest
+    Xm, ym = _clf_data(n_classes=3, seed=2)
+    m = LinearSVC(max_iter=2000).fit(Xm, ym)
+    assert m.score(Xm, ym) > 0.9
+
+
+def test_random_forest_classifier(mesh8):
+    from bodo_tpu.ml import RandomForestClassifier
+    X, y = _clf_data(seed=3)
+    m = RandomForestClassifier(n_estimators=40, random_state=0).fit(X, y)
+    assert len(m.estimators_) == 40  # estimator split preserved the count
+    assert m.score(X, y) > 0.95
+    proba = m.predict_proba(X)
+    assert proba.shape == (len(X), 3)
+
+
+def test_random_forest_regressor(mesh8):
+    from bodo_tpu.ml import RandomForestRegressor
+    r = np.random.default_rng(4)
+    X = r.normal(size=(1500, 3))
+    y = X @ np.array([1.0, -2.0, 0.5]) + 0.1 * r.normal(size=1500)
+    m = RandomForestRegressor(n_estimators=30, random_state=0).fit(X, y)
+    assert m.score(X, y) > 0.9

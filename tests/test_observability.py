@@ -59,3 +59,59 @@ def test_explain(mesh8):
     ctx = BodoSQLContext({"t": pd.DataFrame({"x": [1]})})
     txt = ctx.explain("select x from t where x > 0")
     assert "Filter" in txt
+
+
+# ---------------------------------------------------------------------------
+# sketches (reference: bodo/libs/_theta_sketches.cpp, _bodo_tdigest.cpp,
+# join bloom filter)
+# ---------------------------------------------------------------------------
+
+def test_theta_sketch_ndv_estimate(mesh8):
+    import jax.numpy as jnp
+
+    from bodo_tpu.utils.sketches import ThetaSketch
+    r = np.random.default_rng(0)
+    true_ndv = 50_000
+    data = jnp.asarray(r.integers(0, true_ndv, 200_000))
+    sk = ThetaSketch.build(data, k=4096)
+    est = sk.estimate()
+    assert abs(est - true_ndv) / true_ndv < 0.08, est
+    # exact regime
+    small = jnp.asarray(np.arange(100))
+    assert ThetaSketch.build(small, k=4096).estimate() == 100.0
+    # merge of two shards ~ union
+    a = ThetaSketch.build(jnp.asarray(r.integers(0, 30_000, 80_000)))
+    b = ThetaSketch.build(jnp.asarray(r.integers(15_000, 45_000, 80_000)))
+    m = a.merge(b).estimate()
+    assert abs(m - 45_000) / 45_000 < 0.1, m
+
+
+def test_bloom_filter(mesh8):
+    import jax.numpy as jnp
+
+    from bodo_tpu.utils.sketches import BloomFilter
+    r = np.random.default_rng(1)
+    present = jnp.asarray(r.integers(0, 1 << 40, 20_000))
+    bf = BloomFilter(1 << 20, 4).add(present)
+    assert bool(jnp.all(bf.contains(present)))  # no false negatives
+    absent = jnp.asarray(r.integers(1 << 41, 1 << 42, 20_000))
+    fpr = float(jnp.mean(bf.contains(absent)))
+    assert fpr < 0.02, fpr
+
+
+def test_tdigest_quantiles(mesh8):
+    from bodo_tpu.utils.sketches import TDigest
+    r = np.random.default_rng(2)
+    data = r.normal(size=100_000)
+    td = TDigest(200)
+    for chunk in np.array_split(data, 20):
+        td.add(chunk)
+    for q in (0.01, 0.25, 0.5, 0.75, 0.99):
+        exact = np.quantile(data, q)
+        est = td.quantile(q)
+        assert abs(est - exact) < 0.05, (q, est, exact)
+    # mergeable across shards
+    t1 = TDigest(200).add(data[:50_000])
+    t2 = TDigest(200).add(data[50_000:])
+    tm = t1.merge(t2)
+    assert abs(tm.quantile(0.5) - np.quantile(data, 0.5)) < 0.05

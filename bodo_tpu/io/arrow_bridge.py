@@ -75,6 +75,26 @@ def _arrow_column(arr: pa.ChunkedArray, cap: int) -> Column:
                       jnp.asarray(_pad(valid_np, cap))
                       if valid_np is not None else None,
                       dt.DATE, None)
+    if pa.types.is_decimal(typ):
+        # decimal128(p≤18, s) → scaled int64 exactly (SURVEY §2.9 plan;
+        # reference runtime: bodo/libs/_decimal_ext.cpp)
+        if typ.precision > 18:
+            raise NotImplementedError(
+                f"decimal precision {typ.precision} > 18 does not fit a "
+                f"scaled int64")
+        # decimal128 stores the scaled integer as int128 little-endian;
+        # for precision ≤ 18 the low 64 bits ARE the two's-complement
+        # value — read them straight from the buffer, no rescaling cast
+        # (arr was combined to a single chunk at function entry)
+        raw = np.frombuffer(arr.buffers()[1], dtype=np.int64)
+        vals = raw.reshape(-1, 2)[arr.offset:arr.offset + len(arr), 0]
+        vals = np.ascontiguousarray(vals)
+        if valid_np is not None:
+            vals = np.where(valid_np, vals, 0)
+        return Column(jnp.asarray(_pad(vals, cap)),
+                      jnp.asarray(_pad(valid_np, cap))
+                      if valid_np is not None else None,
+                      dt.decimal(typ.scale), None)
     if pa.types.is_boolean(typ):
         vals = arr.to_numpy(zero_copy_only=False)
         if vals.dtype == object:
@@ -134,6 +154,28 @@ def table_to_arrow(t: Table) -> pa.Table:
             arrays[name] = pa.array(data.view("datetime64[ns]"), mask=mask)
         elif col.dtype is dt.DATE:
             arrays[name] = pa.array(data, type=pa.date32(), mask=mask)
+        elif dt.is_decimal(col.dtype):
+            arrays[name] = _decimal_from_int64(data, col.dtype.scale, mask)
         else:
             arrays[name] = pa.array(data, mask=mask)
     return pa.table(arrays)
+
+
+def _decimal_from_int64(ints: np.ndarray, scale: int, mask) -> pa.Array:
+    """Exact int64-scaled → arrow decimal128(18, scale): widen to the
+    int128 little-endian pair buffer with numpy (hi = sign extension),
+    no per-row Python objects — the inverse of the read path above."""
+    n = len(ints)
+    pair = np.empty((n, 2), dtype=np.int64)
+    pair[:, 0] = ints
+    pair[:, 1] = ints >> 63  # two's-complement sign extension
+    data_buf = pa.py_buffer(np.ascontiguousarray(pair).tobytes())
+    validity = None
+    null_count = 0
+    if mask is not None and mask.any():
+        null_count = int(mask.sum())
+        validity = pa.py_buffer(
+            np.packbits(~mask, bitorder="little").tobytes())
+    return pa.Array.from_buffers(pa.decimal128(18, scale), n,
+                                 [validity, data_buf],
+                                 null_count=null_count)

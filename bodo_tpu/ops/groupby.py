@@ -65,6 +65,39 @@ COMBINE_OF = {"sum": "sum", "sumnull": "sumnull", "sum64": "sum",
               "prod": "prod"}
 
 
+def agg_dtype(op: str, src) -> "object":
+    """Logical result DType of an aggregation (decimal-aware): the single
+    source of truth shared by plan schema inference and the executors."""
+    from bodo_tpu.table import dtypes as dt
+    if op in ("count", "size", "nunique"):
+        return dt.INT64
+    if op in ("min", "max", "first", "last"):
+        return src
+    if dt.is_decimal(src):
+        if op == "prod":
+            raise NotImplementedError(
+                "prod over a decimal column: the product of n values "
+                "carries scale n·s, which a fixed-scale column can't hold")
+        if op in ("sum", "sumnull"):
+            return src
+        return dt.FLOAT64  # mean/var/std/quantiles descale to float
+    return dt.from_numpy(result_dtype(op, src.numpy))
+
+
+def agg_descale_factor(op: str, src) -> float:
+    """Factor dividing a physical agg output of a decimal column to get
+    the logical float value (1.0 when no descale applies)."""
+    from bodo_tpu.table import dtypes as dt
+    if not dt.is_decimal(src):
+        return 1.0
+    if op in ("sum", "sumnull", "prod", "min", "max", "first", "last",
+              "count", "size", "nunique"):
+        return 1.0
+    if op in ("var", "var0"):
+        return 10.0 ** (2 * src.scale)
+    return 10.0 ** src.scale  # mean/std/median/quantiles
+
+
 def result_dtype(op: str, dtype):
     d = jnp.dtype(dtype)
     if op in ("count", "size", "nunique"):

@@ -323,6 +323,16 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
             return dt.BOOL
         lt = infer_dtype(e.left, schema)
         rt = infer_dtype(e.right, schema)
+        if dt.is_decimal(lt) or dt.is_decimal(rt):
+            ls = lt.scale if dt.is_decimal(lt) else None
+            rs = rt.scale if dt.is_decimal(rt) else None
+            float_side = (ls is None and lt.kind == "f") or \
+                (rs is None and rt.kind == "f")
+            if float_side or e.op == "/":
+                return dt.FLOAT64
+            if e.op == "*":
+                return dt.decimal((ls or 0) + (rs or 0))
+            return dt.decimal(max(ls or 0, rs or 0))
         if e.op == "/":
             return dt.FLOAT64 if lt.numpy.itemsize == 8 or rt.numpy.itemsize == 8 \
                 else dt.FLOAT32
@@ -551,6 +561,28 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
             raise TypeError(
                 "string comparison must be rewritten to dict codes by the "
                 "frontend (StrPredicate / code-space compare)")
+        # decimal fixed-point coercion (scaled int64, exact where possible)
+        if dt.is_decimal(lt) or dt.is_decimal(rt):
+            ls = lt.scale if dt.is_decimal(lt) else None
+            rs = rt.scale if dt.is_decimal(rt) else None
+            float_side = (ls is None and lt.kind == "f") or \
+                (rs is None and rt.kind == "f")
+            if float_side or e.op == "/":
+                # mixed float / division: leave fixed point
+                ld = ld.astype(jnp.float64) / (10.0 ** ls) \
+                    if ls is not None else ld.astype(jnp.float64)
+                rd = rd.astype(jnp.float64) / (10.0 ** rs) \
+                    if rs is not None else rd.astype(jnp.float64)
+            elif e.op == "*":
+                # dec(sa)·dec(sb) → dec(sa+sb): plain int64 product;
+                # int sides carry scale 0
+                ld = ld.astype(jnp.int64)
+                rd = rd.astype(jnp.int64)
+            else:
+                # +,-,cmp: align both sides to the larger scale exactly
+                s = max(ls or 0, rs or 0)
+                ld = ld.astype(jnp.int64) * np.int64(10 ** (s - (ls or 0)))
+                rd = rd.astype(jnp.int64) * np.int64(10 ** (s - (rs or 0)))
         valid = None
         if lv is not None or rv is not None:
             valid = (lv if lv is not None else jnp.ones(ld.shape, bool)) & \

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from bodo_tpu.ops.groupby import result_dtype
+from bodo_tpu.ops.groupby import agg_dtype
 from bodo_tpu.plan.expr import Expr, expr_columns, infer_dtype
 from bodo_tpu.table import dtypes as dt
 
@@ -140,11 +140,7 @@ class Aggregate(Node):
         self.aggs = list(aggs)
         sch: Schema = {k: child.schema[k] for k in self.keys}
         for col, op, out in self.aggs:
-            src = child.schema[col]
-            if op in ("min", "max", "first", "last"):
-                sch[out] = src
-            else:
-                sch[out] = dt.from_numpy(result_dtype(op, src.numpy))
+            sch[out] = agg_dtype(op, child.schema[col])
         self.schema = sch
 
     @property
@@ -164,11 +160,7 @@ class Reduce(Node):
         self.aggs = list(aggs)
         sch: Schema = {}
         for col, op, out in self.aggs:
-            src = child.schema[col]
-            if op in ("min", "max", "first", "last"):
-                sch[out] = src
-            else:
-                sch[out] = dt.from_numpy(result_dtype(op, src.numpy))
+            sch[out] = agg_dtype(op, child.schema[col])
         self.schema = sch
 
     @property

@@ -573,6 +573,10 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
         return None
 
     if isinstance(node, L.Aggregate):
+        from bodo_tpu.table import dtypes as dt_
+        if any(dt_.is_decimal(node.child.schema[c])
+               for c, _, _ in node.aggs):
+            return None  # streaming agg state isn't decimal-aware yet
         src = _build_stream(node.child)
         if src is None:
             return None

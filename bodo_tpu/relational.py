@@ -24,7 +24,8 @@ from jax.sharding import PartitionSpec as P
 
 from bodo_tpu.config import config
 from bodo_tpu.ops import kernels as K
-from bodo_tpu.ops.groupby import groupby_local, result_dtype
+from bodo_tpu.ops.groupby import (agg_descale_factor, agg_dtype,
+                                  groupby_local, result_dtype)
 from bodo_tpu.ops.hashing import dest_shard, hash_columns
 from bodo_tpu.ops.join import join_count, join_local
 from bodo_tpu.ops.sort import sort_local, sort_sharded
@@ -351,6 +352,21 @@ def _unpack_keys(packed, pack):
 # groupby aggregate
 # ---------------------------------------------------------------------------
 
+
+def _agg_out_col(src: Column, op: str, vd, vv) -> Column:
+    """Build an aggregation output Column: logical dtype from agg_dtype,
+    decimal physical values descaled, kernel accumulator dtypes (f64
+    quantiles, f32 MXU sums) cast to the declared dtype."""
+    rdt = agg_dtype(op, src.dtype)
+    f = agg_descale_factor(op, src.dtype)
+    if f != 1.0:  # decimal physical -> logical float
+        vd = vd.astype(np.float64) / f
+    if vd.dtype != rdt.numpy:
+        vd = vd.astype(rdt.numpy)
+    return Column(vd, vv, rdt,
+                  src.dictionary if rdt is dt.STRING else None)
+
+
 def groupby_agg(t: Table, keys: Sequence[str],
                 aggs: Sequence[Tuple[str, str, str]]) -> Table:
     """Group by `keys`; aggs = [(value_col, op, out_name)].
@@ -432,13 +448,7 @@ def groupby_agg(t: Table, keys: Sequence[str],
         cols[kname] = Column(kd, kv, src.dtype, src.dictionary)
     for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
         src = t.column(cname)
-        rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
-        if op in ("min", "max", "first", "last"):
-            rdt = src.dtype
-        if vd.dtype != rdt.numpy:  # e.g. quantiles accumulate in f64
-            vd = vd.astype(rdt.numpy)
-        cols[oname] = Column(vd, vv, rdt,
-                             src.dictionary if rdt is dt.STRING else None)
+        cols[oname] = _agg_out_col(src, op, vd, vv)
     return shrink_to_fit(Table(cols, nrows, dist, counts))
 
 
@@ -654,16 +664,9 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
         elif kd.dtype != src.dtype.numpy:
             kd = kd.astype(src.dtype.numpy)
         cols[kname] = Column(kd, None, src.dtype, src.dictionary)
-    from bodo_tpu.ops.groupby import result_dtype
     for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
         src = t.column(cname)
-        rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
-        if op in ("min", "max", "first", "last"):
-            rdt = src.dtype
-        if vd.dtype != rdt.numpy:  # MXU path accumulates in f32
-            vd = vd.astype(rdt.numpy)
-        cols[oname] = Column(vd, vv, rdt,
-                             src.dictionary if rdt is dt.STRING else None)
+        cols[oname] = _agg_out_col(src, op, vd, vv)
     return shrink_to_fit(Table(cols, nrows, REP, None))
 
 
@@ -702,16 +705,9 @@ def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
     for kname, (kd, kv) in zip(keys, out_keys):
         src = t.column(kname)
         cols[kname] = Column(kd, kv, src.dtype, src.dictionary)
-    from bodo_tpu.ops.groupby import result_dtype
     for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
         src = t.column(cname)
-        rdt = dt.from_numpy(result_dtype(op, src.dtype.numpy))
-        if op in ("min", "max", "first", "last"):
-            rdt = src.dtype
-        if vd.dtype != rdt.numpy:  # e.g. quantiles accumulate in f64
-            vd = vd.astype(rdt.numpy)
-        cols[oname] = Column(vd, vv, rdt,
-                             src.dictionary if rdt is dt.STRING else None)
+        cols[oname] = _agg_out_col(src, op, vd, vv)
     return shrink_to_fit(Table(cols, int(counts.sum()), ONED, counts))
 
 
@@ -1472,9 +1468,11 @@ def _reduce_quantile(t: Table, col: str, q: float) -> float:
     qpos = (n - 1) * q
     lo, hi = int(np.floor(qpos)), int(np.ceil(qpos))
     vals = np.asarray(jax.device_get(s_val[lo:hi + 1]))
-    if lo == hi:
-        return float(vals[0])
-    return float(vals[0] + (vals[1] - vals[0]) * (qpos - lo))
+    out = float(vals[0]) if lo == hi else \
+        float(vals[0] + (vals[1] - vals[0]) * (qpos - lo))
+    if dt.is_decimal(src.column(col).dtype):
+        out /= 10.0 ** src.column(col).dtype.scale
+    return out
 
 
 def _reduce_scalar(v, op: str, src: dt.DType, cnt: Optional[int]):
@@ -1482,6 +1480,18 @@ def _reduce_scalar(v, op: str, src: dt.DType, cnt: Optional[int]):
     import pandas as pd
     if op in ("count", "size"):
         return int(v)
+    if dt.is_decimal(src):
+        import decimal as pydec
+        if op == "prod":
+            raise NotImplementedError("prod over a decimal column")
+        if op in ("sum", "sumnull", "min", "max", "first", "last"):
+            if isinstance(v, float) and np.isnan(v):
+                return v
+            return pydec.Decimal(int(v)).scaleb(-src.scale)
+        # mean/var/std: physical float → descale
+        f = 10.0 ** (2 * src.scale) if op in ("var", "var0") \
+            else 10.0 ** src.scale
+        return float(v) / f
     if op in ("min", "max", "first", "last"):
         if src is dt.DATETIME:
             return pd.Timestamp(int(v)) if v is not None else pd.NaT

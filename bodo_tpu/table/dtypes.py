@@ -59,6 +59,33 @@ _BY_NAME = {
 }
 
 
+@dataclass(frozen=True)
+class DecimalDType(DType):
+    """Fixed-point decimal as a scaled int64 (value = physical / 10^scale)
+    — the SURVEY §2.9 plan replacing the reference's decimal128 runtime
+    (bodo/libs/_decimal_ext.cpp). Exact for +,-,*,sum,min,max,compare
+    within int64 range; division and float mixing promote to float64."""
+    scale: int = 2
+
+
+_DECIMALS: dict = {}
+
+
+def decimal(scale: int) -> DecimalDType:
+    """Interned decimal dtype of the given scale (identity-stable so
+    kernel caches keyed on dtype objects stay warm)."""
+    t = _DECIMALS.get(scale)
+    if t is None:
+        t = DecimalDType(f"decimal({scale})", "int64", "dec", scale)
+        _DECIMALS[scale] = t
+        _BY_NAME[t.name] = t
+    return t
+
+
+def is_decimal(t: DType) -> bool:
+    return t.kind == "dec"
+
+
 def by_name(name: str) -> DType:
     return _BY_NAME[name]
 

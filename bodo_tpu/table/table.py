@@ -65,6 +65,8 @@ class Column:
                    valid: Optional[np.ndarray] = None) -> "Column":
         n = len(arr)
         cap = capacity if capacity is not None else round_capacity(n)
+        if arr.dtype == object and _looks_decimal(arr):
+            return _decimal_column(arr, cap, valid)
         dtype = dt.from_numpy(arr.dtype)
         dictionary = None
         if dtype is dt.STRING:
@@ -128,6 +130,15 @@ class Column:
             if valid is not None:
                 out[~valid] = np.timedelta64("NaT")
             return out
+        if self.dtype.kind == "dec":
+            import decimal as pydec
+            q = pydec.Decimal(1).scaleb(-self.dtype.scale)
+            out = np.array([pydec.Decimal(int(v))
+                            .scaleb(-self.dtype.scale).quantize(q)
+                            for v in data], dtype=object)
+            if valid is not None:
+                out[~valid] = None
+            return out
         if valid is not None and self.dtype.kind in ("i", "u", "b"):
             return _masked_to_pandas(data, valid, self.dtype)
         if valid is not None and self.dtype.kind == "f":
@@ -135,6 +146,52 @@ class Column:
             out[~valid] = np.nan
             return out
         return data
+
+
+def _dec_isna(v) -> bool:
+    import decimal as pydec
+    if v is None or v is getattr(pd, "NA", None):
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    if isinstance(v, pydec.Decimal) and not v.is_finite():
+        return True  # Decimal('NaN')/Decimal('Infinity') → null
+    return False
+
+
+def _looks_decimal(arr: np.ndarray) -> bool:
+    import decimal as pydec
+    for v in arr:
+        if _dec_isna(v):
+            continue
+        return isinstance(v, pydec.Decimal)
+    return False
+
+
+def _decimal_column(arr: np.ndarray, cap: int, valid) -> "Column":
+    """Object array of decimal.Decimal → scaled int64 column; the scale
+    is the maximum fractional-digit count across the values."""
+    import decimal as pydec
+    isna = np.array([_dec_isna(v) for v in arr])
+    if valid is not None:
+        isna |= ~np.asarray(valid, dtype=bool)
+    scale = 0
+    for v, na in zip(arr, isna):
+        if not na:
+            scale = max(scale, -int(v.as_tuple().exponent))
+    phys = np.zeros(len(arr), dtype=np.int64)
+    mul = pydec.Decimal(10) ** scale
+    for i, (v, na) in enumerate(zip(arr, isna)):
+        if not na:
+            phys[i] = int(v * mul)
+    padded = np.zeros((cap,), dtype=np.int64)
+    padded[:len(arr)] = phys
+    vcol = None
+    if isna.any():
+        vm = np.zeros(cap, dtype=bool)
+        vm[:len(arr)] = ~isna
+        vcol = jnp.asarray(vm)
+    return Column(jnp.asarray(padded), vcol, dt.decimal(scale), None)
 
 
 def _masked_to_pandas(data, valid, dtype: DType):

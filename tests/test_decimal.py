@@ -1,0 +1,136 @@
+"""Decimal (scaled int64) columns: ingest, arithmetic, aggregation,
+parquet roundtrip (SURVEY §2.9 item 13; reference runtime:
+bodo/libs/_decimal_ext.cpp)."""
+
+import decimal as pydec
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import bodo_tpu.pandas_api as bd
+from bodo_tpu import Table
+from bodo_tpu.table import dtypes as dt
+
+D = pydec.Decimal
+
+
+def _money_df(n=2000, seed=0):
+    r = np.random.default_rng(seed)
+    cents = r.integers(100, 100000, n)
+    disc = r.integers(0, 11, n)
+    df = pd.DataFrame({"k": r.integers(0, 5, n)})
+    df["price"] = np.array([D(int(c)).scaleb(-2) for c in cents],
+                           dtype=object)
+    df["disc"] = np.array([D(int(x)).scaleb(-2) for x in disc],
+                          dtype=object)
+    return df
+
+
+def test_decimal_ingest_roundtrip(mesh8):
+    df = _money_df()
+    t = Table.from_pandas(df)
+    assert dt.is_decimal(t.column("price").dtype)
+    assert t.column("price").dtype.scale == 2
+    back = t.to_pandas()
+    assert back["price"].tolist() == df["price"].tolist()
+
+
+def test_decimal_arithmetic_exact(mesh8):
+    """price·(1−disc) and its grouped sums must be EXACT, not float."""
+    df = _money_df()
+    bdf = bd.from_pandas(df)
+    bdf["rev"] = bdf["price"] * (1 - bdf["disc"])
+    got = bdf.groupby("k", as_index=False).agg(
+        total=("rev", "sum"), mx=("price", "max"), avg=("price", "mean")
+    ).to_pandas().sort_values("k").reset_index(drop=True)
+    pdf = df.copy()
+    pdf["rev"] = [p * (1 - d) for p, d in zip(df["price"], df["disc"])]
+    exp = pdf.groupby("k").agg(total=("rev", "sum"),
+                               mx=("price", "max")).reset_index()
+    assert got["total"].tolist() == exp["total"].tolist()  # Decimal ==
+    assert got["mx"].tolist() == exp["mx"].tolist()
+    exp_avg = pdf.groupby("k")["price"].apply(
+        lambda s: float(sum(s)) / len(s))
+    np.testing.assert_allclose(got["avg"].astype(float), exp_avg.values,
+                               rtol=1e-12)
+
+
+def test_decimal_sum_exact_where_float_drifts(mesh8):
+    """The headline exactness property: summing 100k dimes is exactly
+    $10,000.00 — float64 accumulates ~2e-9 of drift on the same data."""
+    n = 100_000
+    df = pd.DataFrame({"v": np.array([D("0.10")] * n, dtype=object)})
+    s = bd.from_pandas(df)["v"].sum()
+    assert s == D("10000.00")
+    assert isinstance(s, D)
+    assert float(np.sum(np.full(n, 0.1))) != 10000.0  # the float drift
+
+
+def test_decimal_filter_sort_join_keys(mesh8):
+    df = _money_df(seed=1)
+    bdf = bd.from_pandas(df)
+    got = bdf[bdf["price"] > 500].to_pandas()
+    exp = df[[p > D(500) for p in df["price"]]]
+    assert len(got) == len(exp)
+    srt = bdf.sort_values("price").to_pandas()
+    assert srt["price"].tolist() == sorted(df["price"].tolist())
+
+
+def test_decimal_scale_alignment(mesh8):
+    df = pd.DataFrame({
+        "a": np.array([D("1.5"), D("2.25")], dtype=object),      # s=2
+        "b": np.array([D("0.125"), D("0.375")], dtype=object),   # s=3
+    })
+    bdf = bd.from_pandas(df)
+    bdf["s"] = bdf["a"] + bdf["b"]       # align to s=3, exact
+    bdf["p"] = bdf["a"] * bdf["b"]       # s=5, exact
+    out = bdf.to_pandas()
+    assert out["s"].tolist() == [D("1.625"), D("2.625")]
+    assert out["p"].tolist() == [D("0.18750"), D("0.84375")]
+    # division leaves fixed point
+    f2 = bd.from_pandas(df)
+    q = (f2["a"] / f2["b"]).to_pandas()
+    np.testing.assert_allclose(q, [12.0, 6.0], rtol=1e-12)
+
+
+def test_decimal_parquet_roundtrip(mesh8):
+    d_ = tempfile.mkdtemp()
+    df = _money_df(seed=2)
+    at = pa.table({
+        "p": pa.array(df["price"].tolist(), type=pa.decimal128(15, 2)),
+        "k": pa.array(df["k"].to_numpy()),
+    })
+    pq.write_table(at, f"{d_}/dec.parquet")
+    t = bd.read_parquet(f"{d_}/dec.parquet")
+    assert t["p"].sum() == sum(df["price"])
+    t.to_parquet(f"{d_}/out.parquet")
+    back = pq.read_table(f"{d_}/out.parquet")
+    assert pa.types.is_decimal(back.schema.field("p").type)
+    assert back.column("p").to_pylist() == df["price"].tolist()
+
+
+def test_decimal_negative_and_null(mesh8):
+    d_ = tempfile.mkdtemp()
+    neg = pa.table({"p": pa.array([D("-12.34"), D("5.00"), None],
+                                  type=pa.decimal128(10, 2))})
+    pq.write_table(neg, f"{d_}/neg.parquet")
+    vals = bd.read_parquet(f"{d_}/neg.parquet")["p"].to_pandas().tolist()
+    assert vals == [D("-12.34"), D("5.00"), None]
+
+
+def test_decimal_distributed(mesh8):
+    from bodo_tpu.config import config, set_config
+    old = config.shard_min_rows
+    set_config(shard_min_rows=0)
+    try:
+        df = _money_df(seed=3)
+        got = (bd.from_pandas(df).groupby("k", as_index=False)
+               .agg(s=("price", "sum"))).to_pandas().sort_values("k")
+        exp = df.groupby("k")["price"].apply(lambda s: sum(s))
+        assert got["s"].tolist() == exp.tolist()
+    finally:
+        set_config(shard_min_rows=old)

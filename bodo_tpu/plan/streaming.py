@@ -202,7 +202,18 @@ def table_batches(t: Table, batch_rows: int) -> Iterator[Table]:
 class GroupbyAccumulator:
     """Streaming groupby: per-batch local partial aggregation merged into
     a packed device state (reference: GroupbyState::UpdateGroupsAndCombine,
-    bodo/libs/streaming/_groupby.cpp). State is O(distinct groups)."""
+    bodo/libs/streaming/_groupby.cpp). State is O(distinct groups).
+
+    Pipelined (the async-overlap milestone): push() only DISPATCHES the
+    partial aggregation — group counts stay on device as traced scalars,
+    and merges size their static capacities from host-known row-count
+    BOUNDS, so no host sync sits between batches. The device works on
+    batch k's merge while the host decodes batch k+1 (the reference gets
+    the same overlap from IncrementalShuffleState's async sends,
+    bodo/libs/streaming/_shuffle.h:777). Every SYNC_EVERY merges the
+    actual group count is synced once to re-tighten capacities."""
+
+    SYNC_EVERY = 4
 
     def __init__(self, keys: Sequence[str], aggs: Sequence[Tuple]):
         self.keys = list(keys)
@@ -214,33 +225,59 @@ class GroupbyAccumulator:
         self._nparts = [len(_plan_decomposition((op,))[0])
                         for _, op, _ in self.aggs]
         self.state: Optional[Table] = None  # keys + __p{i} partial cols
-        self.n_state = 0
-        self._template: Optional[Table] = None  # schema source (first batch)
+        self._n_state_dev = None            # device scalar (deferred sync)
+        self._bound = 0                     # host upper bound on n_state
+        self._since_sync = 0
+        self._queue: List = []              # dispatched, unmerged partials
+        self._template: Optional[Table] = None  # schema source
+
+    @property
+    def n_state(self) -> int:
+        self._drain_all()
+        return int(jax.device_get(self._n_state_dev)) \
+            if self._n_state_dev is not None else 0
 
     def _partial_names(self) -> List[str]:
         return [f"__p{i}" for i in range(len(self.partial_specs))]
 
     def push(self, batch: Table) -> None:
+        from bodo_tpu.utils import tracing
         nk = len(self.keys)
         if self._template is None:
             self._template = batch
+        if batch.nrows == 0 and (self.state is not None or self._queue):
+            return  # empty batch (selective filter): nothing to merge
         arrays = tuple((batch.column(k).data, batch.column(k).valid)
                        for k in self.keys)
         arrays += tuple(
             (batch.column(c).data, batch.column(c).valid)
             for (c, _, _), np_ in zip(self.aggs, self._nparts)
             for _ in range(np_))
-        pk, pv, ng = groupby_local(arrays, jnp.asarray(batch.nrows),
-                                   self.partial_specs, batch.capacity, nk)
-        ng_b = int(ng)
-        if ng_b == 0 and self.state is not None:
-            return
-        partial = self._as_state_table(batch, pk, pv, ng_b)
-        partial = _with_capacity(partial, _bucket_cap(max(ng_b, 1)))
+        with tracing.event("stream_partial"):
+            pk, pv, ng = groupby_local(arrays, jnp.asarray(batch.nrows),
+                                       self.partial_specs, batch.capacity,
+                                       nk)
+        ng_bound = max(min(batch.nrows, batch.capacity), 1)
+        partial = self._as_state_table(batch, pk, pv, 0)
+        partial = _with_capacity(partial, _bucket_cap(ng_bound))
+        self._queue.append((partial, ng, ng_bound))
+        # depth-1 lookahead: merge batch k while the caller decodes k+1
+        while len(self._queue) > 1:
+            self._drain_one()
+
+    def _drain_all(self) -> None:
+        while self._queue:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        from bodo_tpu.utils import tracing
+        nk = len(self.keys)
+        partial, ng_dev, ng_bound = self._queue.pop(0)
 
         if self.state is None:
             self.state = partial
-            self.n_state = ng_b
+            self._n_state_dev = ng_dev
+            self._bound = ng_bound
             return
 
         # re-code state onto any grown dictionaries before merging
@@ -256,17 +293,18 @@ class GroupbyAccumulator:
         if changed:
             state = Table(cols, state.nrows, REP, None)
 
-        needed = self.n_state + ng_b
-        out_cap = _bucket_cap(max(needed, state.capacity))
+        out_cap = _bucket_cap(max(self._bound + ng_bound, state.capacity))
         s_arrays = tuple((state.column(n).data, state.column(n).valid)
                          for n in state.names)
         b_arrays = tuple((partial.column(n).data, partial.column(n).valid)
                          for n in state.names)
-        mk, mv, ng2 = groupby_merge(s_arrays, b_arrays,
-                                    jnp.asarray(self.n_state),
-                                    jnp.asarray(ng_b),
-                                    self.combine_specs, out_cap, nk)
-        self.n_state = int(ng2)
+        with tracing.event("stream_merge"):
+            mk, mv, ng2 = groupby_merge(s_arrays, b_arrays,
+                                        self._n_state_dev, ng_dev,
+                                        self.combine_specs, out_cap, nk)
+        self._n_state_dev = ng2
+        self._bound += ng_bound
+        self._since_sync += 1
         names = state.names
         cols = {}
         for name, (d, v) in zip(names[:nk], mk):
@@ -275,12 +313,20 @@ class GroupbyAccumulator:
         for name, (d, v) in zip(names[nk:], mv):
             src = state.columns[name]
             cols[name] = Column(d, v, src.dtype, src.dictionary)
-        st = Table(cols, self.n_state, REP, None)
-        # shrink once occupancy drops far below capacity (keeps merge cost
-        # proportional to the true group count)
-        tight = _bucket_cap(max(self.n_state, 1))
-        if tight * 2 <= st.capacity:
-            st = _with_capacity(st, tight)
+        # mid-stream state.nrows is the host BOUND, not the true group
+        # count — the true count lives on device until the next sync
+        st = Table(cols, self._bound, REP, None)
+
+        if self._since_sync >= self.SYNC_EVERY:
+            # periodic sync: tighten the bound (and the state capacity)
+            # to the actual group count so capacities don't creep
+            n = int(jax.device_get(ng2))
+            self._bound = n
+            self._since_sync = 0
+            st = Table(cols, n, REP, None)
+            tight = _bucket_cap(max(n, 1))
+            if tight * 2 <= st.capacity:
+                st = _with_capacity(st, tight)
         self.state = st
 
     def _as_state_table(self, batch: Table, pk, pv, ng: int) -> Table:
@@ -305,6 +351,7 @@ class GroupbyAccumulator:
 
     def finish(self) -> Table:
         nk = len(self.keys)
+        n_final = self.n_state  # drains the pipeline + syncs the count
         # push() sets state on the first batch (even an all-padding one);
         # a truly batch-less stream is filtered by try_stream_execute
         assert self.state is not None
@@ -325,7 +372,7 @@ class GroupbyAccumulator:
         out: Dict[str, Column] = {n: state.columns[n] for n in names[:nk]}
         for oname, col in finals:
             out[oname] = col
-        return Table(out, self.n_state, REP, None)
+        return Table(out, n_final, REP, None)
 
 
 class ReduceAccumulator:

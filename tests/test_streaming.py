@@ -192,3 +192,45 @@ def test_stream_empty_input(stream_mode):
     got = (bd.from_pandas(df).groupby("k", as_index=False)
            .agg(s=("v", "sum"))).to_pandas()
     assert len(got) == 0
+
+
+def test_stream_groupby_pipelined_overlap(stream_mode):
+    """Async-overlap milestone: batch k+1's partial aggregation must be
+    DISPATCHED before batch k's merge runs (depth-1 lookahead, no host
+    sync between batches) — observable in the trace event order."""
+    from bodo_tpu.utils import tracing
+
+    import bodo_tpu.pandas_api as bd
+    tracing.reset()
+    set_config(tracing_level=1)
+    try:
+        df = _taxi_df(6000, seed=9)
+        got = (bd.from_pandas(df).groupby("k", as_index=False)
+               .agg(s=("v", "sum"))).to_pandas()
+    finally:
+        set_config(tracing_level=0)
+    names = [e["name"] for e in tracing._events
+             if e.get("name") in ("stream_partial", "stream_merge")]
+    assert names.count("stream_partial") >= 5
+    # batch 1 seeds the state without a merge, so a synchronous loop
+    # traces [partial, partial, merge, partial, merge...]; the depth-1
+    # lookahead dispatches a THIRD partial before the first merge
+    first_merge = names.index("stream_merge")
+    partials_before = names[:first_merge].count("stream_partial")
+    assert partials_before >= 3, names[:6]
+    exp = df.groupby("k", as_index=False).agg(s=("v", "sum"))
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        sorted(got["s"]), sorted(exp["s"]), rtol=1e-12)
+
+
+def test_stream_groupby_growth_with_deferred_sync(stream_mode):
+    """Group count growing across many batches (forcing capacity growth
+    between the periodic syncs) must stay exact."""
+    import bodo_tpu.pandas_api as bd
+    n = 12_000  # 12 batches at 1000; ~every row a new group early on
+    df = pd.DataFrame({"k": np.arange(n) // 2, "v": np.ones(n)})
+    got = (bd.from_pandas(df).groupby("k", as_index=False)
+           .agg(s=("v", "sum"))).to_pandas()
+    assert len(got) == n // 2
+    assert got["s"].sum() == n

@@ -6,9 +6,19 @@ host; columns are converted straight into the padded device layout with
 dictionary-encoded strings.
 """
 
-from bodo_tpu.io.arrow_bridge import arrow_to_table, table_to_arrow
-from bodo_tpu.io.parquet import read_parquet, write_parquet
+def stripe(n: int, pi: int, pc: int):
+    """Contiguous per-process stripe [lo, hi) — the one stripe-assignment
+    invariant every distributed reader shares (reference:
+    bodo/libs/distributed_api.py get_node_portion)."""
+    return (n * pi) // pc, (n * (pi + 1)) // pc
+
+
+from bodo_tpu.io.arrow_bridge import arrow_to_table, table_to_arrow  # noqa: E402
 from bodo_tpu.io.csv import read_csv
+from bodo_tpu.io.hdf5 import read_hdf5, write_hdf5
+from bodo_tpu.io.np_io import fromfile, tofile
+from bodo_tpu.io.parquet import read_parquet, write_parquet
 
 __all__ = ["arrow_to_table", "table_to_arrow", "read_parquet",
-           "write_parquet", "read_csv"]
+           "write_parquet", "read_csv", "read_hdf5", "write_hdf5",
+           "fromfile", "tofile"]

@@ -1,0 +1,49 @@
+"""Iceberg connector (gated).
+
+The reference reads/writes Iceberg tables through its connector +
+pyiceberg catalogs (bodo/io/iceberg/ — 18 files). The design here is the
+same split the parquet path already implements:
+
+  1. catalog/metadata on host (pyiceberg): resolve the snapshot, collect
+     data-file paths + delete files, push column pruning and partition/
+     metrics filters into the scan plan,
+  2. the data files are parquet — they feed the existing
+     `io.parquet.read_parquet` / `plan.streaming.parquet_batches`
+     machinery unchanged (row-group striping per process, batched
+     streaming reads),
+  3. writes go through `write_parquet`'s per-shard part files plus a
+     pyiceberg append commit.
+
+pyiceberg is not present in this environment, so the module gates with a
+clear error instead of shipping an untestable implementation.
+"""
+
+from __future__ import annotations
+
+
+def _require_pyiceberg():
+    try:
+        import pyiceberg  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "Iceberg support needs the optional 'pyiceberg' package; "
+            "install it to read/write Iceberg tables "
+            "(design: bodo_tpu/io/iceberg.py docstring)") from e
+
+
+def read_iceberg(table_identifier: str, catalog: str = "default",
+                 columns=None, snapshot_id=None):
+    """Read an Iceberg table into a Table (gated on pyiceberg)."""
+    _require_pyiceberg()
+    raise NotImplementedError(
+        "Iceberg read: catalog resolution is designed but not wired "
+        "(see module docstring for the planned split)")  # pragma: no cover
+
+
+def write_iceberg(t, table_identifier: str, catalog: str = "default",
+                  mode: str = "append"):
+    """Append/overwrite a Table into an Iceberg table (gated)."""
+    _require_pyiceberg()
+    raise NotImplementedError(
+        "Iceberg write: parquet part files + append commit is designed "
+        "but not wired (see module docstring)")  # pragma: no cover

@@ -1,0 +1,35 @@
+"""NumPy binary IO (reference: bodo/io/np_io.py fromfile/tofile —
+distributed flat-binary reads with per-rank offsets)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def fromfile(path: str, dtype, count: int = -1,
+             process_index: Optional[int] = None,
+             process_count: Optional[int] = None) -> np.ndarray:
+    """Each process reads its contiguous stripe of a flat binary file
+    (the reference's get_node_portion + seek/read pattern)."""
+    import jax
+
+    from bodo_tpu.io import stripe
+    pi = process_index if process_index is not None else jax.process_index()
+    pc = process_count if process_count is not None else jax.process_count()
+    item = np.dtype(dtype).itemsize
+    total = os.path.getsize(path) // item if count < 0 else count
+    lo, hi = stripe(total, pi, pc)
+    with open(path, "rb") as f:
+        f.seek(lo * item)
+        return np.fromfile(f, dtype=dtype, count=hi - lo)
+
+
+def tofile(arr, path: str) -> None:
+    """Write an array (gathering sharded jax arrays host-side first)."""
+    import jax
+    if isinstance(arr, jax.Array):
+        arr = np.asarray(jax.device_get(arr))
+    np.asarray(arr).tofile(path)

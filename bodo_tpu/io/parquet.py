@@ -23,7 +23,33 @@ from bodo_tpu.io.arrow_bridge import arrow_to_table, table_to_arrow
 from bodo_tpu.table.table import Table
 
 
+def _is_remote(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def _fs_of(path: str):
+    """fsspec filesystem for a remote URL (reference: bodo/io/fs_io.py —
+    s3/gcs/hdfs resolution; here any fsspec scheme, e.g. s3://, gs://,
+    memory://)."""
+    import fsspec
+    fs, _ = fsspec.core.url_to_fs(path)
+    return fs
+
+
 def _dataset_files(path: str):
+    if _is_remote(path):
+        fs = _fs_of(path)
+        scheme = path.split("://", 1)[0]
+        p = fs._strip_protocol(path)
+        if fs.isdir(p):
+            files = sorted(fs.glob(p.rstrip("/") + "/**/*.parquet"))
+        elif any(ch in p for ch in "*?["):
+            files = sorted(fs.glob(p))
+        else:
+            files = [p]
+        if not files:
+            raise FileNotFoundError(f"no parquet files match {path}")
+        return [f"{scheme}://{f}" for f in files]
     if os.path.isdir(path):
         files = sorted(globmod.glob(os.path.join(path, "**", "*.parquet"),
                                     recursive=True))
@@ -34,6 +60,28 @@ def _dataset_files(path: str):
     if not files:
         raise FileNotFoundError(f"no parquet files match {path}")
     return files
+
+
+def _open_one(path: str):
+    """File-like handle for local or fsspec-remote paths. Remote handles
+    must be closed by the caller — prefer `_opened` below."""
+    if _is_remote(path):
+        return _fs_of(path).open(path.split("://", 1)[1], "rb")
+    return path
+
+
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def _opened(path: str):
+    """Context-managed _open_one: closes remote handles on exit."""
+    src = _open_one(path)
+    try:
+        yield src
+    finally:
+        if hasattr(src, "close"):
+            src.close()
 
 
 def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
@@ -50,28 +98,43 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     files = _dataset_files(path)
 
     if pc_ == 1:
-        at = pq.read_table(files if len(files) > 1 else files[0],
-                           columns=list(columns) if columns else None)
+        if not _is_remote(files[0]):
+            at = pq.read_table(files if len(files) > 1 else files[0],
+                               columns=list(columns) if columns else None)
+        else:
+            parts = []
+            for f in files:
+                with _opened(f) as src:
+                    parts.append(pq.read_table(
+                        src, columns=list(columns) if columns else None))
+            at = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
         return arrow_to_table(at)
 
     # row-group assignment across processes (reference: parquet_reader.cpp
     # get_scan_units distribution); each file opened/parsed once
-    handles = {f: pq.ParquetFile(f) for f in files}
     units = []  # (file, row_group)
     for f in files:
-        units.extend((f, rg)
-                     for rg in range(handles[f].metadata.num_row_groups))
-    lo = (len(units) * pi) // pc_
-    hi = (len(units) * (pi + 1)) // pc_
-    tables = []
+        with _opened(f) as src:
+            nrg = pq.ParquetFile(src).metadata.num_row_groups
+        units.extend((f, rg) for rg in range(nrg))
+    from bodo_tpu.io import stripe
+    lo, hi = stripe(len(units), pi, pc_)
+    mine: dict = {}
     for f, rg in units[lo:hi]:
-        tables.append(handles[f].read_row_group(
-            rg, columns=list(columns) if columns else None))
+        mine.setdefault(f, []).append(rg)
+    tables = []
+    for f, rgs in mine.items():
+        with _opened(f) as src:
+            pf = pq.ParquetFile(src)
+            for rg in rgs:
+                tables.append(pf.read_row_group(
+                    rg, columns=list(columns) if columns else None))
     if tables:
         at = pa.concat_tables(tables)
     else:
-        at = pq.read_table(files[0], columns=list(columns) if columns
-                           else None).slice(0, 0)
+        with _opened(files[0]) as src:
+            at = pq.read_table(src, columns=list(columns) if columns
+                               else None).slice(0, 0)
     return arrow_to_table(at)
 
 

@@ -40,11 +40,12 @@ class ReadParquet(Node):
     def __init__(self, path: str, columns: Optional[Sequence[str]] = None):
         import pyarrow.parquet as pq
 
-        from bodo_tpu.io.parquet import _dataset_files
+        from bodo_tpu.io.parquet import _dataset_files, _opened
         self.path = path
         self.children = []
         f = _dataset_files(path)[0]
-        arrow_schema = pq.read_schema(f)
+        with _opened(f) as src:
+            arrow_schema = pq.read_schema(src)
         names = list(columns) if columns else arrow_schema.names
         self.columns = names
         self.schema = {}

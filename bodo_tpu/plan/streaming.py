@@ -137,7 +137,7 @@ def parquet_batches(path: str, columns: Optional[Sequence[str]],
     import pyarrow.parquet as pq
 
     from bodo_tpu.io.arrow_bridge import arrow_to_table
-    from bodo_tpu.io.parquet import _dataset_files
+    from bodo_tpu.io.parquet import _dataset_files, _opened
 
     cap = round_capacity(batch_rows)
     tracker = DictTracker()
@@ -152,18 +152,20 @@ def parquet_batches(path: str, columns: Optional[Sequence[str]],
         return tracker.absorb(arrow_to_table(at, capacity=cap))
 
     for f in _dataset_files(path):
-        pf = pq.ParquetFile(f)
-        for rb in pf.iter_batches(batch_size=batch_rows, columns=cols):
-            pending.append(rb)
-            pending_rows += rb.num_rows
-            while pending_rows >= batch_rows:
-                # split off exactly batch_rows
-                at = pa.Table.from_batches(pending)
-                head = at.slice(0, batch_rows)
-                tail = at.slice(batch_rows)
-                pending = tail.to_batches() if tail.num_rows else []
-                pending_rows = tail.num_rows
-                yield tracker.absorb(arrow_to_table(head, capacity=cap))
+        with _opened(f) as src:
+            pf = pq.ParquetFile(src)
+            for rb in pf.iter_batches(batch_size=batch_rows, columns=cols):
+                pending.append(rb)
+                pending_rows += rb.num_rows
+                while pending_rows >= batch_rows:
+                    # split off exactly batch_rows
+                    at = pa.Table.from_batches(pending)
+                    head = at.slice(0, batch_rows)
+                    tail = at.slice(batch_rows)
+                    pending = tail.to_batches() if tail.num_rows else []
+                    pending_rows = tail.num_rows
+                    yield tracker.absorb(arrow_to_table(head,
+                                                        capacity=cap))
     if pending_rows:
         yield flush()
 

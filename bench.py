@@ -21,19 +21,100 @@ import sys
 import time
 
 
-def _probe_accelerator(timeout_s: int = 240) -> bool:
-    """Check the accelerator backend initializes, in a subprocess so a
-    hanging device tunnel can't wedge the benchmark itself."""
+def _probe_accelerator(timeout_s: int = 240, attempts: int = 3,
+                       backoff_s: int = 20):
+    """Fight for the accelerator backend: probe in a subprocess (so a
+    hanging device tunnel can't wedge the benchmark itself), retrying
+    with backoff — the TPU tunnel here is flaky and a single failed
+    probe must not convert a transient outage into a CPU-only round.
+
+    Returns {"platform": ..., "device_kind": ..., "n": ...} on success,
+    else None."""
     import subprocess
+    probe_src = (
+        "import jax, json; d = jax.devices(); "
+        "assert d and d[0].platform != 'cpu', d; "
+        "import jax.numpy as jnp; "
+        "x = jnp.ones((128, 128)); (x @ x).block_until_ready(); "
+        "print(json.dumps({'platform': d[0].platform, "
+        "'device_kind': d[0].device_kind, 'n': len(d)}))")
+    for i in range(attempts):
+        if i:
+            print(f"accelerator probe retry {i + 1}/{attempts} "
+                  f"in {backoff_s}s ...", file=sys.stderr)
+            time.sleep(backoff_s)
+        try:
+            r = subprocess.run([sys.executable, "-c", probe_src],
+                               timeout=timeout_s, capture_output=True,
+                               text=True)
+            if r.returncode == 0:
+                return json.loads(r.stdout.strip().splitlines()[-1])
+            print(f"accelerator probe failed (rc={r.returncode}): "
+                  f"{r.stderr.strip()[-300:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"accelerator probe timed out after {timeout_s}s",
+                  file=sys.stderr)
+        except Exception as e:  # unparseable probe stdout etc. — retry
+            print(f"accelerator probe error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return None
+
+
+# peak dense f32 TFLOP/s per TPU generation (public specs; one chip).
+# Used only to turn the measured one-hot-matmul rate into an MFU figure.
+_PEAK_F32_TFLOPS = {
+    "TPU v2": 23.0, "TPU v3": 61.5, "TPU v4": 137.5,
+    "TPU v5 lite": 98.5, "TPU v5e": 98.5, "TPU v5p": 229.5,
+    "TPU v6 lite": 459.0, "TPU v6e": 459.0,
+}
+
+
+def _pallas_proof():
+    """Prove the Pallas MXU groupby kernel executes on this backend:
+    correctness vs numpy, then a timed run for achieved FLOP/s + MFU.
+    Returns a detail dict (always includes 'ok')."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from bodo_tpu.ops import pallas_kernels as PK
+
+    info = {"ok": False}
     try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); "
-             "assert d and d[0].platform != 'cpu'"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        r = np.random.default_rng(0)
+        n, k, c = 4096, 512, 4
+        codes = jnp.asarray(r.integers(0, k, n), jnp.int32)
+        vals = jnp.asarray(r.normal(size=(n, c)), jnp.float32)
+        got = np.asarray(jax.device_get(
+            PK.matmul_groupby_sum(codes, vals, k, c)))
+        exp = np.zeros((k, c), np.float64)
+        np.add.at(exp, np.asarray(codes), np.asarray(vals, np.float64))
+        np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-4)
+        info["ok"] = True
+
+        # timed: one-hot contraction is 2*N*K_pad*C_pad flops per call
+        n_t, k_t, c_t = 1 << 20, 4096, 8
+        codes_t = jnp.asarray(r.integers(0, k_t, n_t), jnp.int32)
+        vals_t = jnp.asarray(r.normal(size=(n_t, c_t)), jnp.float32)
+        PK.matmul_groupby_sum(codes_t, vals_t, k_t, c_t
+                              ).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            PK.matmul_groupby_sum(codes_t, vals_t, k_t, c_t
+                                  ).block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        flops = 2.0 * n_t * k_t * max(c_t, 8)
+        info["matmul_groupby_tflops"] = round(flops / dt / 1e12, 3)
+        kind = jax.devices()[0].device_kind
+        peak = next((v for pfx, v in _PEAK_F32_TFLOPS.items()
+                     if kind.lower().startswith(pfx.lower())), None)
+        if peak:
+            info["mfu_vs_f32_peak"] = round(flops / dt / 1e12 / peak, 4)
+        info["mrows_per_s"] = round(n_t / dt / 1e6, 1)
+    except Exception as e:  # pragma: no cover
+        info["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    return info
 
 
 def bench_tpch(args):
@@ -54,12 +135,18 @@ def bench_tpch(args):
 
     import pandas as pd
     conn = sqlite_connection(data)
-    t0 = time.perf_counter()
-    for q in sorted(QUERIES):
-        if q not in UNSUPPORTED:
-            pd.read_sql_query(to_sqlite(QUERIES[q]), conn)
-    t_sqlite = time.perf_counter() - t0
-    print(f"sqlite baseline: {t_sqlite:.2f}s", file=sys.stderr)
+    # symmetric baseline: sqlite gets a cold AND a hot (page-cache warm)
+    # pass, mirroring the engine's cold/hot measurement — comparing
+    # sqlite-cold against engine-hot would inflate the reported speedup
+    t_sqlite = {}
+    for label in ("cold", "hot"):
+        t0 = time.perf_counter()
+        for q in sorted(QUERIES):
+            if q not in UNSUPPORTED:
+                pd.read_sql_query(to_sqlite(QUERIES[q]), conn)
+        t_sqlite[label] = time.perf_counter() - t0
+    print(f"sqlite baseline: cold {t_sqlite['cold']:.2f}s "
+          f"hot {t_sqlite['hot']:.2f}s", file=sys.stderr)
     times = {}
     from bodo_tpu.plan.physical import _result_cache
     for q in sorted(QUERIES):
@@ -87,11 +174,14 @@ def bench_tpch(args):
         "metric": "tpch_total_hot_seconds",
         "value": round(total_hot, 3) if not failed else 0.0,
         "unit": "s",
-        "vs_baseline": (round(t_sqlite / total_hot, 3)
+        "vs_baseline": (round(t_sqlite["hot"] / total_hot, 3)
                         if ok and not failed and total_hot > 0 else 0.0),
         "detail": {"orders": args.rows, "queries_ok": len(ok),
-                   "sqlite_s": round(t_sqlite, 3),
+                   "sqlite_cold_s": round(t_sqlite["cold"], 3),
+                   "sqlite_hot_s": round(t_sqlite["hot"], 3),
                    "queries_failed": failed,
+                   "platform": jax.devices()[0].platform,
+                   "device_kind": jax.devices()[0].device_kind,
                    "skipped": {str(k): v for k, v in UNSUPPORTED.items()},
                    "per_query": {str(k): (None if v is None
                                           else round(v, 3))
@@ -133,10 +223,16 @@ def main():
     n_rows = 200_000 if args.quick else (args.rows or 20_000_000)
 
     use_cpu = args.cpu
-    if not use_cpu and not _probe_accelerator(timeout_s=240):
-        print("accelerator backend unavailable — falling back to CPU mesh",
-              file=sys.stderr)
-        use_cpu = True
+    accel = None
+    if not use_cpu:
+        accel = _probe_accelerator(timeout_s=240)
+        if accel is None:
+            print("ACCELERATOR UNAVAILABLE after retries — falling back "
+                  "to CPU mesh (this is a degraded, CPU-only artifact)",
+                  file=sys.stderr)
+            use_cpu = True
+        else:
+            print(f"accelerator up: {accel}", file=sys.stderr)
     if use_cpu:
         if args.mesh is None:
             args.mesh = 1  # fastest CPU config: 1-device mesh, no shuffles
@@ -171,8 +267,16 @@ def main():
 
     devs = jax.devices()[:args.mesh]
     args.mesh = len(devs)  # report the mesh actually built, not requested
+    platform = devs[0].platform
     print(f"devices: {devs}", file=sys.stderr)
     bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+
+    # on a real accelerator, prove the Pallas MXU kernel runs on hardware
+    # (correctness vs numpy + achieved FLOP/s) before the pipeline runs
+    pallas_proof = None
+    if platform == "tpu":
+        pallas_proof = _pallas_proof()
+        print(f"pallas MXU proof: {pallas_proof}", file=sys.stderr)
 
     # pandas baseline (includes IO, like the reference harness)
     t0 = time.perf_counter()
@@ -199,14 +303,25 @@ def main():
         return 1
 
     speedup = t_pandas / t_hot
+    from bodo_tpu.ops import pallas_kernels as PK
+    scanned = os.path.getsize(pq) + os.path.getsize(csv)
+    detail = {"rows": n_rows, "pandas_s": round(t_pandas, 3),
+              "hot_s": round(t_hot, 3), "cold_s": round(t_cold, 3),
+              "n_devices": args.mesh,
+              "platform": platform,
+              "device_kind": devs[0].device_kind,
+              "scan_mb_per_s": round(scanned / t_hot / 1e6, 1),
+              "pallas_traced_into_pipeline": PK.trace_count}
+    if pallas_proof is not None:
+        detail["pallas_mxu"] = pallas_proof
+    if accel is None and not args.cpu:
+        detail["degraded"] = "accelerator unavailable; CPU-only result"
     print(json.dumps({
         "metric": "nyc_taxi_speedup_vs_pandas",
         "value": round(speedup, 3),
         "unit": "x",
         "vs_baseline": round(speedup / 3.0, 3),
-        "detail": {"rows": n_rows, "pandas_s": round(t_pandas, 3),
-                   "hot_s": round(t_hot, 3), "cold_s": round(t_cold, 3),
-                   "n_devices": args.mesh},
+        "detail": detail,
     }))
     return 0
 

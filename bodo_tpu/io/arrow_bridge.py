@@ -94,7 +94,7 @@ def _arrow_column(arr: pa.ChunkedArray, cap: int) -> Column:
         return Column(jnp.asarray(_pad(vals, cap)),
                       jnp.asarray(_pad(valid_np, cap))
                       if valid_np is not None else None,
-                      dt.decimal(typ.scale), None)
+                      dt.decimal(typ.scale, precision=typ.precision), None)
     if pa.types.is_boolean(typ):
         vals = arr.to_numpy(zero_copy_only=False)
         if vals.dtype == object:
@@ -155,16 +155,21 @@ def table_to_arrow(t: Table) -> pa.Table:
         elif col.dtype is dt.DATE:
             arrays[name] = pa.array(data, type=pa.date32(), mask=mask)
         elif dt.is_decimal(col.dtype):
-            arrays[name] = _decimal_from_int64(data, col.dtype.scale, mask)
+            arrays[name] = _decimal_from_int64(
+                data, col.dtype.scale, mask,
+                precision=col.dtype.precision)
         else:
             arrays[name] = pa.array(data, mask=mask)
     return pa.table(arrays)
 
 
-def _decimal_from_int64(ints: np.ndarray, scale: int, mask) -> pa.Array:
-    """Exact int64-scaled → arrow decimal128(18, scale): widen to the
-    int128 little-endian pair buffer with numpy (hi = sign extension),
-    no per-row Python objects — the inverse of the read path above."""
+def _decimal_from_int64(ints: np.ndarray, scale: int, mask,
+                        precision: int = 18) -> pa.Array:
+    """Exact int64-scaled → arrow decimal128(precision, scale): widen to
+    the int128 little-endian pair buffer with numpy (hi = sign extension),
+    no per-row Python objects — the inverse of the read path above.
+    `precision` is the source schema's (carried on DecimalDType) so the
+    round-trip doesn't widen the column type to 18."""
     n = len(ints)
     pair = np.empty((n, 2), dtype=np.int64)
     pair[:, 0] = ints
@@ -176,6 +181,6 @@ def _decimal_from_int64(ints: np.ndarray, scale: int, mask) -> pa.Array:
         null_count = int(mask.sum())
         validity = pa.py_buffer(
             np.packbits(~mask, bitorder="little").tobytes())
-    return pa.Array.from_buffers(pa.decimal128(18, scale), n,
+    return pa.Array.from_buffers(pa.decimal128(precision, scale), n,
                                  [validity, data_buf],
                                  null_count=null_count)

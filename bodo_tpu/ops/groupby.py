@@ -79,7 +79,9 @@ def agg_dtype(op: str, src) -> "object":
                 "prod over a decimal column: the product of n values "
                 "carries scale n·s, which a fixed-scale column can't hold")
         if op in ("sum", "sumnull"):
-            return src
+            # sums overflow the source precision; widen to the full 18
+            # digits an int64 holds (scale preserved, values exact)
+            return dt.decimal(src.scale)
         return dt.FLOAT64  # mean/var/std/quantiles descale to float
     return dt.from_numpy(result_dtype(op, src.numpy))
 

@@ -288,8 +288,21 @@ def rank_window_local(key_arrays, order_arrays, count,
         elif op == "dense_rank":
             o = dense_rank
         elif op == "ntile":
+            # SQL NTILE: first (cnt mod n) buckets get ceil(cnt/n) rows,
+            # the rest floor(cnt/n) (ref _window_aggfuncs.cpp ntile)
+            if int(param) < 1:
+                raise ValueError(
+                    f"NTILE argument must be positive, got {param}")
             n = jnp.int64(param)
-            o = ((row_no - 1) * n) // jnp.maximum(seg_cnt[seg], 1) + 1
+            cnt = jnp.maximum(seg_cnt[seg], 1)
+            small = cnt // n
+            rem = cnt - small * n
+            big_rows = rem * (small + 1)       # rows in the big buckets
+            r0 = row_no - 1
+            o = jnp.where(
+                r0 < big_rows,
+                r0 // (small + 1) + 1,
+                rem + (r0 - big_rows) // jnp.maximum(small, 1) + 1)
         else:
             raise ValueError(f"unknown rank window op: {op}")
         outs_sorted.append(jnp.where(padmask_s, o, 0).astype(jnp.int64))

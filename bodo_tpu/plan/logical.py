@@ -191,9 +191,28 @@ class Union(Node):
                     continue
                 if dt.is_numeric(a) and dt.is_numeric(b):
                     continue  # concat_tables promotes
+                if dt.is_decimal(a) or dt.is_decimal(b):
+                    # concat_tables handles every decimal mix: same-scale
+                    # decimals keep the type, otherwise float64 descale
+                    if (dt.is_decimal(a) or dt.is_numeric(a)) and \
+                            (dt.is_decimal(b) or dt.is_numeric(b)):
+                        continue
                 raise ValueError(
                     f"union dtype mismatch on {name}: {a.name} vs {b.name}")
         self.schema = dict(first)
+        # decimal result type mirrors concat_tables' promotion: all same
+        # scale → widest precision; mixed scale / decimal+float → float64
+        for name, a in first.items():
+            kinds = [c.schema[name] for c in self.children]
+            if any(dt.is_decimal(k) for k in kinds):
+                scales = {k.scale for k in kinds if dt.is_decimal(k)}
+                if len(scales) == 1 and all(dt.is_decimal(k)
+                                            for k in kinds):
+                    self.schema[name] = dt.decimal(
+                        scales.pop(),
+                        precision=max(k.precision for k in kinds))
+                else:
+                    self.schema[name] = dt.FLOAT64
 
     def key(self):
         return ("union", tuple(c.key() for c in self.children))

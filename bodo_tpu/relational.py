@@ -1607,6 +1607,21 @@ def concat_tables(tables: Sequence[Table]) -> Table:
             _, src_cols = unify_dictionaries(src_cols)
             out_dtype = dt.STRING
             dictionary = src_cols[0].dictionary
+        elif any(dt.is_decimal(c.dtype) for c in src_cols):
+            scales = {c.dtype.scale for c in src_cols
+                      if dt.is_decimal(c.dtype)}
+            if len(scales) == 1 and all(dt.is_decimal(c.dtype)
+                                        for c in src_cols):
+                out_dtype = dt.decimal(
+                    scales.pop(),
+                    precision=max(c.dtype.precision for c in src_cols))
+            else:  # mixed scales / decimal+float: descale to float64
+                out_dtype = dt.FLOAT64
+                src_cols = [
+                    Column(c.data / 10.0 ** c.dtype.scale, c.valid,
+                           dt.FLOAT64, None)
+                    if dt.is_decimal(c.dtype) else c for c in src_cols]
+            dictionary = None
         else:
             out_np = np.result_type(*[c.dtype.numpy for c in src_cols])
             out_dtype = dt.from_numpy(out_np)

@@ -64,20 +64,28 @@ class DecimalDType(DType):
     """Fixed-point decimal as a scaled int64 (value = physical / 10^scale)
     — the SURVEY §2.9 plan replacing the reference's decimal128 runtime
     (bodo/libs/_decimal_ext.cpp). Exact for +,-,*,sum,min,max,compare
-    within int64 range; division and float mixing promote to float64."""
+    within int64 range; division and float mixing promote to float64.
+    `precision` carries the source schema's decimal128 precision so a
+    parquet round-trip preserves the column type; engine-created decimals
+    default to the full 18 digits an int64 can hold."""
     scale: int = 2
+    precision: int = 18
 
 
 _DECIMALS: dict = {}
 
 
-def decimal(scale: int) -> DecimalDType:
+def decimal(scale: int, *, precision: int = 18) -> DecimalDType:
     """Interned decimal dtype of the given scale (identity-stable so
-    kernel caches keyed on dtype objects stay warm)."""
-    t = _DECIMALS.get(scale)
+    kernel caches keyed on dtype objects stay warm). `precision` is
+    keyword-only: positionally it would read as arrow's
+    decimal128(precision, scale) order and silently swap the two."""
+    t = _DECIMALS.get((scale, precision))
     if t is None:
-        t = DecimalDType(f"decimal({scale})", "int64", "dec", scale)
-        _DECIMALS[scale] = t
+        name = (f"decimal({scale})" if precision == 18
+                else f"decimal({precision},{scale})")
+        t = DecimalDType(name, "int64", "dec", scale, precision)
+        _DECIMALS[(scale, precision)] = t
         _BY_NAME[t.name] = t
     return t
 

@@ -79,11 +79,15 @@ def test_groupby_cumcount_ntile(dist):
                                df.groupby("k").cumcount().to_numpy())
     nt = bd.from_pandas(df).groupby("k").ntile(4).to_pandas().to_numpy()
     assert nt.min() >= 1 and nt.max() <= 4
-    # balanced buckets per partition
+    # SQL NTILE: the first (cnt mod n) buckets take ceil(cnt/n) rows,
+    # the rest floor(cnt/n) (ADVICE r2: remainder goes to the FIRST
+    # buckets, not spread evenly)
     for k in df["k"].unique():
-        cnts = np.bincount(nt[df["k"].to_numpy() == k])[1:]
-        cnts = cnts[cnts > 0]
-        assert cnts.max() - cnts.min() <= 1
+        cnts = np.bincount(nt[df["k"].to_numpy() == k], minlength=5)[1:]
+        cnt = cnts.sum()
+        small, rem = divmod(cnt, 4)
+        exp_sizes = [small + 1] * rem + [small] * (4 - rem)
+        assert cnts.tolist() == exp_sizes, (k, cnts.tolist(), exp_sizes)
 
 
 def test_series_median_quantile_nlargest(dist):

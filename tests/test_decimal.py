@@ -109,7 +109,9 @@ def test_decimal_parquet_roundtrip(mesh8):
     assert t["p"].sum() == sum(df["price"])
     t.to_parquet(f"{d_}/out.parquet")
     back = pq.read_table(f"{d_}/out.parquet")
-    assert pa.types.is_decimal(back.schema.field("p").type)
+    # source precision carried through DecimalDType (ADVICE r2): the
+    # round-trip must not widen decimal128(15, 2) to (18, 2)
+    assert back.schema.field("p").type == pa.decimal128(15, 2)
     assert back.column("p").to_pylist() == df["price"].tolist()
 
 

@@ -44,10 +44,19 @@ def _to_u64(data):
 
 
 def hash_column(data, valid=None):
-    """64-bit hash of one column; nulls hash to a fixed tag."""
+    """64-bit hash of one column; nulls hash to a fixed tag. A float NaN
+    and a mask-null are the same logical null (sort_encoding.null_flag),
+    so both take the tag — otherwise the two null forms would land on
+    different shards and nulls-match joins would mis-co-locate."""
     h = splitmix64(_to_u64(data))
+    null = None
     if valid is not None:
-        h = jnp.where(valid, h, np.uint64(0xDEAD_BEEF_CAFE_F00D))
+        null = ~valid
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        isnan = jnp.isnan(data)
+        null = isnan if null is None else (null | isnan)
+    if null is not None:
+        h = jnp.where(null, np.uint64(0xDEAD_BEEF_CAFE_F00D), h)
     return h
 
 

@@ -36,11 +36,15 @@ from bodo_tpu.ops import kernels as K
 from bodo_tpu.ops import sort_encoding as SE
 
 
-def _union_gids(probe_keys, build_keys, p_padmask, b_padmask):
+def _union_gids(probe_keys, build_keys, p_padmask, b_padmask,
+                null_equal: bool = False):
     """Segment the union of probe+build keys; returns (gid_p, gid_b).
 
-    Excluded rows (padding or null key) get gid == ucap (sentinel, matches
-    nothing because build counts are only accumulated for real rows)."""
+    Excluded rows get gid == ucap (sentinel, matches nothing because
+    build counts are only accumulated for real rows). null_equal=False
+    (SQL): null keys are excluded — they never match. null_equal=True
+    (pandas merge): nulls form a real group and match other nulls
+    (a null in any key position compares equal to a null there)."""
     pcap = probe_keys[0][0].shape[0]
     bcap = build_keys[0][0].shape[0]
     ucap = pcap + bcap
@@ -57,17 +61,37 @@ def _union_gids(probe_keys, build_keys, p_padmask, b_padmask):
             v = jnp.concatenate([pv_, bv_])
         ukeys.append((d, v))
         nf = SE.null_flag(d, v)
-        if nf is not None:
-            unionmask = unionmask & ~nf
-        operands.extend(SE.key_operands(d, v, padmask=unionmask))
+        if not null_equal:
+            if nf is not None:
+                unionmask = unionmask & ~nf
+            operands.extend(SE.key_operands(d, v, padmask=unionmask))
+        elif nf is not None:
+            # sort all nulls of this key into one adjacent block with a
+            # CONSTANT value encoding (zeroed data) — a mask-null's
+            # garbage payload must not scatter equal follow-on keys
+            dz = jnp.where(nf, jnp.zeros((), d.dtype), d)
+            rank = jnp.where(nf, jnp.uint8(2), jnp.uint8(1))
+            rank = jnp.where(unionmask, rank, jnp.uint8(3))
+            operands.extend([rank, SE.encode_value(dz)])
+        else:
+            operands.extend(SE.key_operands(d, v, padmask=unionmask))
     nko = len(operands)
     operands.append(jnp.arange(ucap))
     perm = lax.sort(tuple(operands), num_keys=nko, is_stable=True)[-1]
     umask_s = unionmask[perm]
     pos = jnp.arange(ucap)
     diff = jnp.zeros(ucap, dtype=bool).at[0].set(True)
-    for d, _ in ukeys:
+    for d, v in ukeys:
         ks = d[perm]
+        if null_equal:
+            # canonicalize: all nulls (mask or NaN) compare equal to each
+            # other and different from every value (raw NaN != NaN would
+            # make each null row its own group)
+            nf = SE.null_flag(d, v)
+            if nf is not None:
+                ns = nf[perm]
+                ks = jnp.where(ns, jnp.zeros((), ks.dtype), ks)
+                diff = diff | (ns != jnp.roll(ns, 1))
         diff = diff | (ks != jnp.roll(ks, 1))
     new_group = umask_s & (diff | (pos == 0))
     seg = jnp.maximum(jnp.cumsum(new_group) - 1, 0)
@@ -77,13 +101,14 @@ def _union_gids(probe_keys, build_keys, p_padmask, b_padmask):
 
 
 def _join_plan(probe_keys, build_keys, probe_count, build_count,
-               how: str):
+               how: str, null_equal: bool = False):
     pcap = probe_keys[0][0].shape[0]
     bcap = build_keys[0][0].shape[0]
     ucap = pcap + bcap
     p_pad = K.row_mask(probe_count, pcap)
     b_pad = K.row_mask(build_count, bcap)
-    gid_p, gid_b = _union_gids(probe_keys, build_keys, p_pad, b_pad)
+    gid_p, gid_b = _union_gids(probe_keys, build_keys, p_pad, b_pad,
+                               null_equal)
 
     # order build rows by gid (sentinel rows last)
     gid_b_s, b_perm = lax.sort((gid_b, jnp.arange(bcap)), num_keys=1,
@@ -102,22 +127,40 @@ def _join_plan(probe_keys, build_keys, probe_count, build_count,
         L = matches
     offsets = jnp.cumsum(L) - L
     total = jnp.sum(L)
-    return gid_p, b_perm, bc, starts, offsets, L, total, p_pad
+
+    # full outer: build rows whose gid no real keyed probe row shares are
+    # appended after the probe-driven rows (null-key build rows — gid ==
+    # sentinel — never match, so they are unmatched too, SQL semantics)
+    unm_idx = None
+    n_unm = jnp.zeros((), jnp.int64)
+    if how == "outer":
+        pc_per_gid = jax.ops.segment_sum(
+            jnp.where(p_pad & keyed, 1, 0).astype(jnp.int64),
+            jnp.minimum(gid_p, ucap), num_segments=ucap + 1)
+        unmatched_b = b_pad & (
+            (gid_b >= ucap) | (pc_per_gid[jnp.minimum(gid_b, ucap)] == 0))
+        (unm_idx,), n_unm = K.compact(unmatched_b,
+                                      (jnp.arange(bcap, dtype=jnp.int64),))
+        total = total + n_unm
+    return (gid_p, b_perm, bc, starts, offsets, L, total, p_pad,
+            unm_idx, n_unm)
 
 
-@partial(jax.jit, static_argnames=("num_keys", "how"))
+@partial(jax.jit, static_argnames=("num_keys", "how", "null_equal"))
 def join_count(probe_keys, build_keys, probe_count, build_count,
-               num_keys: int, how: str):
+               num_keys: int, how: str, null_equal: bool = False):
     """Exact output row count of the join (cheap pre-pass; the host uses
     it to pick the materialization capacity bucket)."""
-    *_, total, _ = _join_plan(probe_keys, build_keys, probe_count,
-                              build_count, how)
-    return total
+    plan = _join_plan(probe_keys, build_keys, probe_count,
+                      build_count, how, null_equal)
+    return plan[6]
 
 
-@partial(jax.jit, static_argnames=("num_keys", "how", "out_capacity"))
+@partial(jax.jit, static_argnames=("num_keys", "how", "out_capacity",
+                                   "null_equal"))
 def join_local(probe_arrays, build_arrays, probe_count, build_count,
-               num_keys: int, how: str, out_capacity: int):
+               num_keys: int, how: str, out_capacity: int,
+               null_equal: bool = False):
     """Materialize the equi-join.
 
     probe_arrays/build_arrays: tuples of (data, valid); the first
@@ -129,36 +172,77 @@ def join_local(probe_arrays, build_arrays, probe_count, build_count,
     """
     probe_keys = probe_arrays[:num_keys]
     build_keys = build_arrays[:num_keys]
-    gid_p, b_perm, bc, starts, offsets, L, total, p_pad = _join_plan(
-        probe_keys, build_keys, probe_count, build_count, how)
+    (gid_p, b_perm, bc, starts, offsets, L, total, p_pad,
+     unm_idx, n_unm) = _join_plan(
+        probe_keys, build_keys, probe_count, build_count, how, null_equal)
     ucap = gid_p.shape[0] + b_perm.shape[0]
     bcap = b_perm.shape[0]
+    total_probe = total - n_unm  # probe-driven rows (== total unless outer)
 
     j = jnp.arange(out_capacity)
     live = j < total
+    probe_row = live & (j < total_probe)
     pidx = jnp.clip(jnp.searchsorted(offsets, j, side="right") - 1,
                     0, gid_p.shape[0] - 1)
     k = j - offsets[pidx]
     g = jnp.minimum(gid_p[pidx], ucap)
-    matched = live & (k < bc[g])
+    matched = probe_row & (k < bc[g])
     bpos = jnp.clip(starts[g] + k, 0, bcap - 1)
     bidx = b_perm[bpos]
+    if how == "outer":
+        # appended unmatched-build rows: slots [total_probe, total)
+        appended = live & (j >= total_probe)
+        k_app = jnp.clip(j - total_probe, 0, bcap - 1)
+        bidx = jnp.where(appended, unm_idx[k_app], bidx)
+        build_emit = matched | appended
+    else:
+        build_emit = matched
 
     out_probe = []
     for d, v in probe_arrays:
-        od = jnp.where(live, d[pidx], jnp.zeros((), d.dtype))
-        ov = None
-        if v is not None:
-            ov = live & v[pidx]
+        od = jnp.where(probe_row, d[pidx], jnp.zeros((), d.dtype))
+        base_v = probe_row if v is None else (probe_row & v[pidx])
+        # probe columns are nullable on appended build-only rows
+        ov = base_v if how == "outer" else (
+            None if v is None else base_v)
         out_probe.append((od, ov))
     out_build = []
     for d, v in build_arrays:
-        od = jnp.where(matched, d[bidx], jnp.zeros((), d.dtype))
-        base_v = matched if v is None else (matched & v[bidx])
-        # build side columns are nullable after a left join
+        od = jnp.where(build_emit, d[bidx], jnp.zeros((), d.dtype))
+        base_v = build_emit if v is None else (build_emit & v[bidx])
+        # build side columns are nullable after a left/outer join
         ov = base_v if how in ("left", "outer") else (
             None if v is None else base_v)
         out_build.append((od, ov))
     out_count = jnp.minimum(total, out_capacity)
     overflow = total > out_capacity
     return tuple(out_probe), tuple(out_build), out_count, overflow
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
+def cross_local(probe_arrays, build_arrays, probe_count, build_count,
+                out_capacity: int):
+    """Cartesian product in pandas row order (probe-major: each probe row
+    paired with every build row in order). The host computes the exact
+    output size (nl * nr) up front, so there is no overflow retry —
+    reference analogue: bodo/libs/_nested_loop_join_impl.cpp's block
+    product, here a static index transform instead of a loop."""
+    pcap = probe_arrays[0][0].shape[0]
+    bcap = build_arrays[0][0].shape[0]
+    total = probe_count * build_count
+    nb = jnp.maximum(build_count, 1)
+    j = jnp.arange(out_capacity)
+    live = j < total
+    pidx = jnp.clip(j // nb, 0, pcap - 1)
+    bidx = jnp.clip(j % nb, 0, bcap - 1)
+
+    def _gather(arrays, idx):
+        out = []
+        for d, v in arrays:
+            od = jnp.where(live, d[idx], jnp.zeros((), d.dtype))
+            ov = None if v is None else (live & v[idx])
+            out.append((od, ov))
+        return tuple(out)
+
+    return (_gather(probe_arrays, pidx), _gather(build_arrays, bidx),
+            jnp.minimum(total, out_capacity))

@@ -286,15 +286,20 @@ class BodoDataFrame:
     def merge(self, right: "BodoDataFrame", on=None, left_on=None,
               right_on=None, how: str = "inner",
               suffixes=("_x", "_y")) -> "BodoDataFrame":
-        if on is not None:
-            left_on = right_on = [on] if isinstance(on, str) else list(on)
-        if left_on is None or right_on is None:
-            raise ValueError("merge requires on= or left_on=/right_on=")
-        left_on = [left_on] if isinstance(left_on, str) else list(left_on)
-        right_on = [right_on] if isinstance(right_on, str) else list(right_on)
-        if how == "right":
-            return right.merge(self, left_on=right_on, right_on=left_on,
-                               how="left", suffixes=(suffixes[1], suffixes[0]))
+        if how == "cross":
+            if on is not None or left_on is not None or right_on is not None:
+                raise ValueError("cross merge takes no join keys")
+            left_on = right_on = []
+        else:
+            if on is not None:
+                left_on = right_on = [on] if isinstance(on, str) \
+                    else list(on)
+            if left_on is None or right_on is None:
+                raise ValueError("merge requires on= or left_on=/right_on=")
+            left_on = [left_on] if isinstance(left_on, str) \
+                else list(left_on)
+            right_on = [right_on] if isinstance(right_on, str) \
+                else list(right_on)
         return BodoDataFrame(L.Join(self._plan, right._plan, left_on,
                                     right_on, how, suffixes))
 

@@ -267,12 +267,15 @@ class RankWindow(Node):
 
 class Join(Node):
     def __init__(self, left: Node, right: Node, left_on, right_on,
-                 how: str = "inner", suffixes=("_x", "_y")):
+                 how: str = "inner", suffixes=("_x", "_y"),
+                 null_equal: bool = True):
         self.children = [left, right]
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.how = how
         self.suffixes = tuple(suffixes)
+        # pandas merge matches NaN keys to each other; SQL joins don't
+        self.null_equal = null_equal
         overlap = (set(left.schema) & set(right.schema)) - \
             (set(self.left_on) & set(self.right_on))
         sch: Schema = {}
@@ -296,7 +299,7 @@ class Join(Node):
     def key(self):
         return ("join", self.left.key(), self.right.key(),
                 tuple(self.left_on), tuple(self.right_on), self.how,
-                self.suffixes)
+                self.suffixes, self.null_equal)
 
 
 class Sort(Node):

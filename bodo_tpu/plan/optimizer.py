@@ -83,15 +83,21 @@ def push_filters(node: L.Node) -> L.Node:
             cols = expr_columns(pred)
             lcols = set(child.left.schema)
             rcols = set(child.right.schema)
-            # only push when the names are unambiguous pass-throughs
-            if cols <= lcols and not (cols & rcols):
+            # only push when the names are unambiguous pass-throughs, and
+            # only INTO a side the join preserves 1:1 (pushing into the
+            # null-padded side of an outer/right join changes results)
+            if cols <= lcols and not (cols & rcols) and \
+                    child.how in ("inner", "left", "cross"):
                 nl = push_filters(L.Filter(child.left, pred))
                 return L.Join(nl, push_filters(child.right), child.left_on,
-                              child.right_on, child.how, child.suffixes)
-            if cols <= rcols and not (cols & lcols) and child.how == "inner":
+                              child.right_on, child.how, child.suffixes,
+                              child.null_equal)
+            if cols <= rcols and not (cols & lcols) and \
+                    child.how in ("inner", "right", "cross"):
                 nr = push_filters(L.Filter(child.right, pred))
                 return L.Join(push_filters(child.left), nr, child.left_on,
-                              child.right_on, child.how, child.suffixes)
+                              child.right_on, child.how, child.suffixes,
+                              child.null_equal)
         return L.Filter(push_filters(child), pred)
     # recurse
     return _rebuild(node, [push_filters(c) for c in node.children])
@@ -162,7 +168,8 @@ def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
                     rneed.add(n)
         return L.Join(prune_columns(node.left, lneed),
                       prune_columns(node.right, rneed),
-                      node.left_on, node.right_on, node.how, node.suffixes)
+                      node.left_on, node.right_on, node.how, node.suffixes,
+                      node.null_equal)
     if isinstance(node, L.Sort):
         need = None if required is None else \
             (set(required) | set(node.by))

@@ -112,7 +112,8 @@ def _exec_inner(node: L.Node) -> Table:
         left = _exec(node.left)
         right = _exec(node.right)
         return R.join_tables(left, right, node.left_on, node.right_on,
-                             node.how, node.suffixes)
+                             node.how, node.suffixes,
+                             null_equal=node.null_equal)
     if isinstance(node, L.Union):
         return _maybe_shard(R.concat_tables(
             [_exec(c) for c in node.children]))

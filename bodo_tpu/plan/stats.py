@@ -93,6 +93,8 @@ def estimate(node: L.Node) -> Tuple[float, float]:
     if isinstance(node, L.Join):
         le, lr = estimate(node.left)
         re_, rr = estimate(node.right)
+        if node.how == "cross":
+            return max(le * re_, 1.0), max(lr, rr)
         return join_estimate(le, lr, re_, rr), max(lr, rr)
     return 10_000.0, 10_000.0  # unknown node: neutral guess
 

@@ -503,10 +503,12 @@ class StreamJoin:
     the reference's streaming hash join with the build table parked in
     the buffer pool (bodo/libs/streaming/_join.cpp HashJoinState)."""
 
-    def __init__(self, build: Table, left_on, right_on, how, suffixes):
+    def __init__(self, build: Table, left_on, right_on, how, suffixes,
+                 null_equal: bool = True):
         from bodo_tpu.runtime.offload import offload_table
         self.left_on, self.right_on = left_on, right_on
         self.how, self.suffixes = how, suffixes
+        self.null_equal = null_equal
         self._off = offload_table(build.gather()
                                   if build.distribution != REP else build)
         self._build: Optional[Table] = None
@@ -515,7 +517,8 @@ class StreamJoin:
         if self._build is None:
             self._build = self._off.restore()
         out = R.join_tables(batch, self._build, self.left_on, self.right_on,
-                            self.how, self.suffixes)
+                            self.how, self.suffixes,
+                            null_equal=self.null_equal)
         return _with_capacity(out, _bucket_cap(max(out.nrows, 1)))
 
 
@@ -556,6 +559,11 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
                 yield apply_projection(b, exprs)
         return gen_project(inner)
     if isinstance(node, L.Join):
+        if node.how not in ("inner", "left"):
+            # right/outer emit unmatched BUILD rows: probing per batch
+            # would duplicate them once per batch; cross would need the
+            # probe-major order across batches — whole-table path instead
+            return None
         inner = _build_stream(node.left)
         if inner is None:
             return None
@@ -563,7 +571,7 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
         build = physical._exec(node.right)
         try:
             join = StreamJoin(build, node.left_on, node.right_on,
-                              node.how, node.suffixes)
+                              node.how, node.suffixes, node.null_equal)
         except RuntimeError as e:
             # native host pool unavailable (no C++ toolchain): whole-table
             # fallback is correct, just not memory-bounded

@@ -769,10 +769,29 @@ def _suffix_columns(left: Table, right: Table, left_on, right_on,
 
 def join_tables(left: Table, right: Table, left_on: Sequence[str],
                 right_on: Sequence[str], how: str = "inner",
-                suffixes=("_x", "_y")) -> Table:
-    """Equi-join (pandas merge analogue). Build side = right."""
+                suffixes=("_x", "_y"), null_equal: bool = True) -> Table:
+    """Join (pandas merge analogue). Build side = right.
+    how: inner / left / right / outer / cross (reference join matrix:
+    bodo/libs/_hash_join.cpp build_table_outer/probe_table_outer,
+    _nested_loop_join_impl.cpp for cross). null_equal=True gives pandas
+    merge semantics (NaN keys match each other); SQL passes False (null
+    keys never match, the reference's is_na_equal=false join mode)."""
     left_on, right_on = list(left_on), list(right_on)
-    assert how in ("inner", "left"), f"join how={how} not yet supported"
+    assert how in ("inner", "left", "right", "outer", "cross"), \
+        f"join how={how} not supported"
+    if how == "cross":
+        return _cross_join(left, right, suffixes)
+    if how == "right":
+        # right join = left join with sides swapped; restore the pandas
+        # column order (left's columns first) afterwards
+        out = join_tables(right, left, right_on, left_on, "left",
+                          (suffixes[1], suffixes[0]), null_equal)
+        lmap, rmap = _suffix_columns(left, right, left_on, right_on,
+                                     suffixes)
+        names = [lmap[n] for n in left.names if lmap[n] in out.columns]
+        names += [rmap[n] for n in right.names
+                  if n in rmap and rmap[n] in out.columns]
+        return out.select(list(dict.fromkeys(names)))
 
     # unify dictionaries of string join keys so codes are comparable, and
     # align numeric key dtypes so hashing/comparison agree across sides
@@ -814,10 +833,17 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
     if left.distribution == REP and right.distribution == ONED:
         left = left.shard()
     if left.distribution == REP and right.distribution == REP:
-        out = _join_dense_try(left, right, left_on, right_on, how, suffixes)
+        out = _join_dense_try(left, right, left_on, right_on, how, suffixes,
+                              null_equal)
         if out is not None:
             return out
-    if left.distribution == ONED and right.distribution == ONED and \
+    if how == "outer" and left.distribution == ONED and \
+            right.distribution == REP:
+        # a replicated build side would emit its unmatched rows once PER
+        # SHARD; shard it so every build row is owned by exactly one shard
+        right = right.shard()
+    if how != "outer" and \
+            left.distribution == ONED and right.distribution == ONED and \
             right.nrows <= config.bcast_join_threshold and \
             left.nrows > 4 * right.nrows:
         # runtime broadcast decision on ACTUAL sizes (not scan-time
@@ -832,21 +858,24 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
         # mirror case: tiny LEFT side — swap (inner join is symmetric),
         # broadcast it, and restore the left-then-right column order
         out = join_tables(right, left, right_on, left_on, "inner",
-                          (suffixes[1], suffixes[0]))
+                          (suffixes[1], suffixes[0]), null_equal)
         lmap, rmap = _suffix_columns(left, right, left_on, right_on,
                                      suffixes)
         names = [lmap[n] for n in left.names] + \
             [rmap[n] for n in right.names if n in rmap]
         return out.select([n for n in names if n in out.columns])
     if left.distribution == ONED and right.distribution == ONED:
-        return _join_sharded(left, right, left_on, right_on, how, suffixes)
+        return _join_sharded(left, right, left_on, right_on, how, suffixes,
+                             null_equal=null_equal)
     if left.distribution == ONED and right.distribution == REP:
-        return _join_broadcast(left, right, left_on, right_on, how, suffixes)
-    return _join_rep(left, right, left_on, right_on, how, suffixes)
+        return _join_broadcast(left, right, left_on, right_on, how,
+                               suffixes, null_equal)
+    return _join_rep(left, right, left_on, right_on, how, suffixes,
+                     null_equal)
 
 
-def _join_dense_try(left, right, left_on, right_on, how, suffixes
-                    ) -> Optional[Table]:
+def _join_dense_try(left, right, left_on, right_on, how, suffixes,
+                    null_equal: bool = True) -> Optional[Table]:
     """Dense-LUT equi-join: when the build (right) side's keys have a
     small host-known range and are unique, the join is a perfect-hash
     lookup — build scatters row indices into a dense LUT, probe gathers.
@@ -857,6 +886,13 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes
     back to the union-segmentation sort join)."""
     if how not in ("inner", "left") or right.nrows == 0 or \
             config.dense_join_max_slots <= 0:
+        return None
+    if null_equal and \
+            any(left.column(k).valid is not None for k in left_on) and \
+            any(right.column(k).valid is not None for k in right_on):
+        # dense slots drop null keys (SQL style); under pandas null-match
+        # semantics a null-null pair would be silently missed when both
+        # sides can hold nulls — use the sort join there
         return None
     ranges = _key_ranges(right, right_on)
     if any(r is None for r in ranges):
@@ -948,9 +984,22 @@ def _assemble_join(left, right, left_on, right_on, lorder, rorder,
                    out_p, out_b, nrows, counts, how, suffixes) -> Table:
     lmap, rmap = _suffix_columns(left, right, left_on, right_on, suffixes)
     cols: Dict[str, Column] = {}
+    # full outer with a merged key column (same name both sides): pandas
+    # fills the key from the right side on build-only appended rows
+    merged_keys = {}
+    if how == "outer":
+        for i, (ln, rn) in enumerate(zip(left_on, right_on)):
+            if ln == rn:
+                merged_keys[ln] = i
     for i, n in enumerate(lorder):
         src = left.column(n)
         d, v = out_p[i]
+        if n in merged_keys:
+            ki = merged_keys[n]
+            bd, bv = out_b[ki]
+            assert v is not None and bv is not None
+            d = jnp.where(v, d, bd.astype(d.dtype))
+            v = v | bv
         cols[lmap[n]] = Column(d, v, src.dtype, src.dictionary)
     for i, n in enumerate(rorder):
         if n not in rmap:
@@ -966,7 +1015,8 @@ def _assemble_join(left, right, left_on, right_on, lorder, rorder,
     return res.select(names)
 
 
-def _join_rep(left, right, left_on, right_on, how, suffixes) -> Table:
+def _join_rep(left, right, left_on, right_on, how, suffixes,
+              null_equal: bool = True) -> Table:
     lorder, rorder, pa, ba = _probe_build_arrays(left, right, left_on,
                                                  right_on)
     pc = jnp.asarray(left.nrows)
@@ -974,10 +1024,12 @@ def _join_rep(left, right, left_on, right_on, how, suffixes) -> Table:
     nk = len(left_on)
     out_cap = round_capacity(max(left.nrows, right.nrows, 1))
     for _ in range(2):
-        out_p, out_b, cnt, ovf = join_local(pa, ba, pc, bc, nk, how, out_cap)
+        out_p, out_b, cnt, ovf = join_local(pa, ba, pc, bc, nk, how,
+                                            out_cap, null_equal)
         if not bool(jax.device_get(ovf)):
             break
-        total = int(join_count(pa[:nk], ba[:nk], pc, bc, nk, how))
+        total = int(join_count(pa[:nk], ba[:nk], pc, bc, nk, how,
+                               null_equal))
         out_cap = round_capacity(total)
     nrows = int(jax.device_get(cnt))
     return _assemble_join(left, right, left_on, right_on, lorder, rorder,
@@ -1009,14 +1061,15 @@ def _rebuild_from_flat(flat, slots):
 
 
 def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
-                           sig_key):
+                           sig_key, null_equal: bool = True):
     """shard_map join of co-located shards — probe rows and build rows
     with equal keys are already on the same shard (hash shuffle happened
     as a separate sized stage via shuffle_by_key), or the build side is
     replicated (broadcast join, reference bodo/libs/_shuffle.h:153).
     Analogue of the reference's partitioned hash join
     (streaming/_join.h:892)."""
-    key = ("join", mesh_key, nk, how, out_cap, broadcast, sig_key)
+    key = ("join", mesh_key, nk, how, out_cap, broadcast, sig_key,
+           null_equal)
     fn = _jit_cache.get(key)
     if fn is not None:
         return fn
@@ -1025,7 +1078,8 @@ def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
 
     def body(p_arrays, b_arrays, pcounts, bcounts):
         out_p, out_b, cnt, ovf = join_local(
-            p_arrays, b_arrays, pcounts[0], bcounts[0], nk, how, out_cap)
+            p_arrays, b_arrays, pcounts[0], bcounts[0], nk, how, out_cap,
+            null_equal)
         return out_p, out_b, cnt[None], ovf[None]
 
     fn = jax.jit(C.smap(body,
@@ -1038,7 +1092,8 @@ def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
 
 
 def _join_sharded(left, right, left_on, right_on, how, suffixes,
-                  broadcast: bool = False) -> Table:
+                  broadcast: bool = False,
+                  null_equal: bool = True) -> Table:
     m = mesh_mod.get_mesh()
     if not broadcast:
         # co-locate equal keys, then join at tight static shapes
@@ -1059,19 +1114,19 @@ def _join_sharded(left, right, left_on, right_on, how, suffixes,
     sig_key = (_sig(left), _sig(right))
     for attempt in range(2):
         fn = _build_join_sharded_fn(_mesh_key(m), nk, how, out_cap,
-                                    broadcast, sig_key)
+                                    broadcast, sig_key, null_equal)
         out_p, out_b, cnts, ovf = fn(pa, ba, left.counts_device(), bcounts)
         if not np.asarray(jax.device_get(ovf)).any():
             break
         # exact per-shard counts, then one final right-sized run
-        cfn_key = ("join_count", _mesh_key(m), nk, how, sig_key)
+        cfn_key = ("join_count", _mesh_key(m), nk, how, sig_key, null_equal)
         cfn = _jit_cache.get(cfn_key)
         if cfn is None:
             ax = config.data_axis
 
             def cbody(p_arrays, b_arrays, pcounts, bcounts_):
                 return join_count(p_arrays[:nk], b_arrays[:nk], pcounts[0],
-                                  bcounts_[0], nk, how)[None]
+                                  bcounts_[0], nk, how, null_equal)[None]
             cfn = jax.jit(C.smap(
                 cbody,
                 in_specs=(P(ax), P() if broadcast else P(ax), P(ax),
@@ -1090,9 +1145,63 @@ def _join_sharded(left, right, left_on, right_on, how, suffixes,
     return shrink_to_fit(res)
 
 
-def _join_broadcast(left, right, left_on, right_on, how, suffixes) -> Table:
+def _join_broadcast(left, right, left_on, right_on, how, suffixes,
+                    null_equal: bool = True) -> Table:
     return _join_sharded(left, right, left_on, right_on, how, suffixes,
-                         broadcast=True)
+                         broadcast=True, null_equal=null_equal)
+
+
+def _cross_join(left, right, suffixes) -> Table:
+    """Cartesian product (merge how='cross'). Distributed form: left rows
+    stay sharded, the right side is replicated, every shard emits its
+    local probe-major block — output row order matches pandas because
+    shard row ranges are ordered. Output size is known exactly on the
+    host (nl x nr), so capacities are right-sized with no overflow retry."""
+    from bodo_tpu.ops.join import cross_local
+
+    ll, rl = _as_local(left), _as_local(right)
+    if ll is not None:
+        left = ll
+    if rl is not None:
+        right = rl
+    if left.distribution == REP and right.distribution == ONED:
+        # output order follows left rows; replicate the right side
+        right = right.gather()
+    if left.distribution == ONED:
+        if right.distribution == ONED:
+            right = right.gather()
+        left = shrink_to_fit(left)
+        lorder, rorder, pa, ba = _probe_build_arrays(left, right, [], [])
+        m = mesh_mod.get_mesh()
+        percap = int(max(left.counts)) if len(left.counts) else 0
+        out_cap = round_capacity(max(percap * max(right.nrows, 1), 1))
+        key = ("crossjoin", _mesh_key(m), _sig(left), _sig(right), out_cap)
+        fn = _jit_cache.get(key)
+        if fn is None:
+            ax = config.data_axis
+
+            def body(p_arrays, b_arrays, pcounts, bcount):
+                op, ob, cnt = cross_local(p_arrays, b_arrays, pcounts[0],
+                                          bcount[0], out_cap)
+                return op, ob, cnt[None]
+
+            fn = jax.jit(C.smap(body, in_specs=(P(ax), P(), P(ax), P()),
+                                out_specs=(P(ax), P(ax), P(ax)), mesh=m))
+            _jit_cache[key] = fn
+        out_p, out_b, cnts = fn(pa, ba, left.counts_device(),
+                                jnp.asarray([right.nrows], dtype=jnp.int64))
+        counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
+        res = _assemble_join(left, right, [], [], lorder, rorder, out_p,
+                             out_b, int(counts.sum()), counts, "cross",
+                             suffixes)
+        return shrink_to_fit(res)
+    lorder, rorder, pa, ba = _probe_build_arrays(left, right, [], [])
+    out_cap = round_capacity(max(left.nrows * right.nrows, 1))
+    out_p, out_b, cnt = cross_local(pa, ba, jnp.asarray(left.nrows),
+                                    jnp.asarray(right.nrows), out_cap)
+    nrows = int(jax.device_get(cnt))
+    return _assemble_join(left, right, [], [], lorder, rorder, out_p,
+                         out_b, nrows, None, "cross", suffixes)
 
 
 # ---------------------------------------------------------------------------

@@ -56,8 +56,9 @@ class SubSelect:
 class JoinItem:
     left: Any
     right: Any
-    kind: str          # inner/left/right/cross
+    kind: str          # inner/left/right/outer/cross
     on: Any = None
+    using: Any = None  # list of column names for JOIN ... USING (a, b)
 
 
 @dataclass
@@ -410,14 +411,26 @@ class Parser:
                 elif self.try_kw("CROSS"):
                     kind = "cross"
                 elif self.try_kw("FULL"):
-                    raise NotImplementedError("FULL OUTER JOIN")
+                    self.try_kw("OUTER")
+                    kind = "outer"
                 self.eat_kw("JOIN")
                 right = self.table_item()
                 on = None
+                using = None
                 if kind != "cross":
-                    self.eat_kw("ON")
-                    on = self.expr()
-                item = JoinItem(item, right, kind, on)
+                    # USING is not in _KEYWORDS; it tokenizes as an id
+                    if (self.peek()[0] == "id" and
+                            self.peek()[1].upper() == "USING"):
+                        self.i += 1
+                        self.eat_op("(")
+                        using = [self.ident()]
+                        while self.try_op(","):
+                            using.append(self.ident())
+                        self.eat_op(")")
+                    else:
+                        self.eat_kw("ON")
+                        on = self.expr()
+                item = JoinItem(item, right, kind, on, using)
             else:
                 return item
 
@@ -432,7 +445,8 @@ class Parser:
         alias = None
         if self.try_kw("AS"):
             alias = self.ident()
-        elif self.peek()[0] == "id":
+        elif self.peek()[0] == "id" and self.peek()[1].upper() != "USING":
+            # USING introduces a join-key list, never a table alias
             alias = self.ident()
         return TableRef(name, alias)
 

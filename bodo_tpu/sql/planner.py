@@ -155,9 +155,34 @@ class Planner:
             scope = ls.merged(rs)
             if item.kind == "cross":
                 return self._cross_join(lp, rp), scope
-            eq_l, eq_r, residual = self._split_join_condition(
-                item.on, ls, rs, scope)
+            if item.using is not None:
+                # JOIN ... USING (a, b): equi keys by shared name; the
+                # unqualified name resolves to the left side afterwards
+                # (coalescing for FULL JOIN is not modeled — reject it)
+                if item.kind == "outer":
+                    raise NotImplementedError(
+                        "FULL JOIN ... USING (coalesced key) — use ON")
+                eq_l, eq_r, residual = [], [], None
+                for c in item.using:
+                    lc, rc = ls.resolve(c, None), rs.resolve(c, None)
+                    if lc is None or rc is None:
+                        raise ValueError(f"USING column {c} not on both "
+                                         f"sides")
+                    eq_l.append(lc)
+                    eq_r.append(rc)
+                    # the USING column coalesces; binding it to the
+                    # preserved side's key is exact for inner/left/right
+                    # (matched rows agree, unmatched preserved rows only
+                    # have their own side's value)
+                    scope.by_col[c.lower()] = \
+                        [rc if item.kind == "right" else lc]
+            else:
+                eq_l, eq_r, residual = self._split_join_condition(
+                    item.on, ls, rs, scope)
             how = item.kind
+            if residual is not None and how == "outer":
+                raise NotImplementedError(
+                    "FULL JOIN with a non-equality ON condition")
             if residual is not None and how in ("left", "right"):
                 # outer-join ON residuals restrict the null-padded side
                 # BEFORE the join (a post-filter would turn preserved rows
@@ -176,12 +201,16 @@ class Planner:
                     raise NotImplementedError(
                         "outer-join ON condition touching the preserved side")
             if not eq_l:
+                if how != "inner":
+                    # cross+filter lowering has inner semantics only
+                    raise NotImplementedError(
+                        f"non-equi {how} join needs an equality conjunct")
                 plan = self._cross_join(lp, rp)
             else:
                 if how == "right":
-                    plan = L.Join(rp, lp, eq_r, eq_l, "left")
+                    plan = L.Join(rp, lp, eq_r, eq_l, "left", null_equal=False)
                 else:
-                    plan = L.Join(lp, rp, eq_l, eq_r, how)
+                    plan = L.Join(lp, rp, eq_l, eq_r, how, null_equal=False)
             if residual is not None:
                 plan = L.Filter(plan, residual)
             return plan, scope
@@ -194,7 +223,7 @@ class Planner:
                            + [(k, Lit(1))])
         rp2 = L.Projection(rp, [(c, ColRef(c)) for c in rp.schema]
                            + [(k + "_r", Lit(1))])
-        j = L.Join(lp2, rp2, [k], [k + "_r"], "inner")
+        j = L.Join(lp2, rp2, [k], [k + "_r"], "inner", null_equal=False)
         keep = [c for c in j.schema if not c.startswith("__cross")]
         return L.Projection(j, [(c, ColRef(c)) for c in keep])
 
@@ -602,7 +631,8 @@ class Planner:
                 used.add(i)
                 continue
             out, i, keys_l, keys_r, ids = best
-            plan = L.Join(plan, planned[i][0], keys_l, keys_r, "inner")
+            plan = L.Join(plan, planned[i][0], keys_l, keys_r, "inner",
+                          null_equal=False)
             scope = scope.merged(planned[i][1])
             cur_est, cur_raw = out, max(cur_raw, ests[i][1])
             used.add(i)
@@ -726,10 +756,11 @@ class Planner:
         node = L.Distinct(node, [tmp])
         lcol, plan = self._materialize_expr(plan, lhs)
         if anti:
-            j = L.Join(plan, node, [lcol], [tmp], "left")
+            j = L.Join(plan, node, [lcol], [tmp], "left", null_equal=False)
             probe = L.Filter(j, UnOp("isna", ColRef(tmp)))
         else:
-            probe = L.Join(plan, node, [lcol], [tmp], "inner")
+            probe = L.Join(plan, node, [lcol], [tmp], "inner",
+                           null_equal=False)
         keep = [c for c in plan.schema if not c.startswith("__mat")]
         return L.Projection(probe, [(c, ColRef(c)) for c in keep])
 
@@ -752,7 +783,7 @@ class Planner:
             node = L.Distinct(node, names)
             outer_cols = [oc for oc, _ in corr]
             how = "left" if anti else "inner"
-            j = L.Join(plan, node, outer_cols, names, how)
+            j = L.Join(plan, node, outer_cols, names, how, null_equal=False)
             if anti:
                 j = L.Filter(j, UnOp("isna", ColRef(names[0])))
             keep = [c for c in plan.schema]
@@ -784,7 +815,8 @@ class Planner:
         node, names = self._plan_core(sub2, outer=None)
         key_names = names[:len(inner_cols)]
         outer_cols = [oc for oc, _ in corr]
-        j = L.Join(plan_rid, node, outer_cols, key_names, "inner")
+        j = L.Join(plan_rid, node, outer_cols, key_names, "inner",
+                   null_equal=False)
         # residual conversion: outer cols resolve via the original scope,
         # inner cols via the fresh projected names
         res_scope = Scope()
@@ -802,10 +834,12 @@ class Planner:
         matched = L.Distinct(
             L.Projection(f, [(rid + "_m", ColRef(rid))]), [rid + "_m"])
         if anti:
-            j2 = L.Join(plan_rid, matched, [rid], [rid + "_m"], "left")
+            j2 = L.Join(plan_rid, matched, [rid], [rid + "_m"], "left",
+                        null_equal=False)
             out = L.Filter(j2, UnOp("isna", ColRef(rid + "_m")))
         else:
-            out = L.Join(plan_rid, matched, [rid], [rid + "_m"], "inner")
+            out = L.Join(plan_rid, matched, [rid], [rid + "_m"], "inner",
+                         null_equal=False)
         keep = [c for c in plan.schema]
         return L.Projection(out, [(c, ColRef(c)) for c in keep])
 
@@ -959,7 +993,8 @@ class Planner:
             [(proj_expr, val)]
         sub2.group_by = list(inner_keys)
         node, names = self._plan_core(sub2, outer=None)
-        j = L.Join(plan, node, outer_keys, names[:-1], "inner")
+        j = L.Join(plan, node, outer_keys, names[:-1], "inner",
+                   null_equal=False)
         return None, j, names[-1]
 
     # ------------------------------------------------------------------

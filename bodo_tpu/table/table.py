@@ -72,7 +72,7 @@ class Column:
         if dtype is dt.STRING:
             vals = np.asarray(arr, dtype=object)
             isna = np.array([v is None or (isinstance(v, float) and np.isnan(v))
-                             for v in vals])
+                             for v in vals], dtype=bool)
             if valid is not None:
                 isna |= ~np.asarray(valid, dtype=bool)
             fill = vals[~isna]
@@ -115,6 +115,11 @@ class Column:
                  if self.valid is not None else None)
         if self.dtype is dt.STRING:
             assert self.dictionary is not None
+            if len(self.dictionary) == 0:
+                # empty dictionary: every row is null (e.g. the all-null
+                # string column appended by an outer join with an empty
+                # side)
+                return np.full(len(data), None, dtype=object)
             out = self.dictionary[np.clip(data, 0, len(self.dictionary) - 1)]
             out = out.astype(object)
             if valid is not None:

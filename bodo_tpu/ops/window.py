@@ -217,57 +217,13 @@ def rank_window_local(key_arrays, order_arrays, count,
     group together in PARTITION BY). Returns int64 outputs aligned with
     input rows (0 on padding rows).
     """
-    from bodo_tpu.ops import kernels as K
-    from bodo_tpu.ops import sort_encoding as SE
-
     cap = key_arrays[0][0].shape[0] if key_arrays else \
         order_arrays[0][0].shape[0]
-    padmask = K.row_mask(count, cap)
-
-    operands: list = []
-    for d, v in key_arrays:
-        # partition nulls group together: use the null rank slot but keep
-        # them, padding rows still sort last
-        operands.extend(SE.key_operands(d, v, padmask=padmask))
-    if not ascending:
-        ascending = tuple(True for _ in order_arrays)
-    for (d, v), asc in zip(order_arrays, ascending):
-        operands.extend(SE.key_operands(d, v, ascending=asc,
-                                        na_last=na_last, padmask=padmask))
-    nko = len(operands)
-    operands.append(jnp.arange(cap))
-    sorted_ops = lax.sort(tuple(operands), num_keys=nko, is_stable=True)
-    perm = sorted_ops[-1]
-    padmask_s = padmask[perm]
-    pos = jnp.arange(cap)
-
-    def _changes(arrays):
-        """Adjacent-difference flags on null-canonicalized values: a null
-        (mask or NaN) compares equal to another null, never to a value —
-        raw NaN != NaN would split every null row into its own group."""
-        chg = jnp.zeros(cap, dtype=bool)
-        for d, v in arrays:
-            null = SE.null_flag(d, v)
-            ds = d[perm]
-            if null is not None:
-                ns = null[perm]
-                ds = jnp.where(ns, jnp.zeros((), d.dtype), ds)
-                chg = chg | (ns != jnp.roll(ns, 1))
-            chg = chg | (ds != jnp.roll(ds, 1))
-        return chg
-
-    # partition boundaries: any key column changes (nulls = one group)
-    newpart = (_changes(key_arrays) & padmask_s) | (pos == 0)
-    seg = jnp.maximum(jnp.cumsum(newpart) - 1, 0)
-    # order-value change points (for rank/dense_rank ties)
-    newval = newpart | (_changes(order_arrays) & padmask_s)
-
-    n_segs = cap  # upper bound; segment ops sized to cap
-    seg_start = jax.ops.segment_min(jnp.where(padmask_s, pos, cap), seg,
-                                    num_segments=n_segs)
-    seg_cnt = jax.ops.segment_sum(padmask_s.astype(jnp.int64), seg,
-                                  num_segments=n_segs)
-    row_no = pos - seg_start[seg] + 1                     # 1-based
+    (perm, padmask_s, seg, seg_start, seg_end, seg_cnt_row, newval,
+     peer_end, pos) = _sorted_segments(key_arrays, order_arrays, count,
+                                       ascending, na_last, cap)
+    n_segs = cap
+    row_no = pos - seg_start + 1                          # 1-based
     dense = jnp.cumsum(newval & padmask_s)
     dense_rank = dense - jax.ops.segment_min(
         jnp.where(padmask_s, dense, cap + 1), seg, num_segments=n_segs
@@ -275,7 +231,7 @@ def rank_window_local(key_arrays, order_arrays, count,
     # rank: row_number of the first row with an equal order value
     first_eq = jnp.where(newval, pos, 0)
     first_eq = jax.lax.cummax(first_eq)                   # last change point
-    rank = first_eq - seg_start[seg] + 1
+    rank = first_eq - seg_start + 1
 
     outs_sorted = []
     for op, param in specs:
@@ -294,7 +250,7 @@ def rank_window_local(key_arrays, order_arrays, count,
                 raise ValueError(
                     f"NTILE argument must be positive, got {param}")
             n = jnp.int64(param)
-            cnt = jnp.maximum(seg_cnt[seg], 1)
+            cnt = jnp.maximum(seg_cnt_row, 1)
             small = cnt // n
             rem = cnt - small * n
             big_rows = rem * (small + 1)       # rows in the big buckets
@@ -310,3 +266,236 @@ def rank_window_local(key_arrays, order_arrays, count,
     # scatter back to input row order
     inv = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(pos)
     return tuple(o[inv] for o in outs_sorted)
+
+
+# ---------------------------------------------------------------------------
+# partitioned aggregate windows: SUM/AVG/MIN/MAX/COUNT ... OVER
+# (PARTITION BY k ORDER BY o [ROWS BETWEEN a AND b]) + LEAD/LAG +
+# FIRST_VALUE/LAST_VALUE
+# ---------------------------------------------------------------------------
+
+def _sorted_segments(key_arrays, order_arrays, count, ascending, na_last,
+                     cap: int):
+    """Shared sort/segment machinery for ALL partitioned window kernels:
+    stable sort by (partition keys, order cols); partition boundaries
+    from null-canonicalized key changes (a null — mask or NaN — compares
+    equal to another null, never to a value; raw NaN != NaN would split
+    every null row into its own group). Returns per-row arrays in sorted
+    order: (perm, padmask_s, seg, seg_start, seg_end, seg_cnt_row,
+    newval, peer_end, pos)."""
+    from bodo_tpu.ops import kernels as K
+    from bodo_tpu.ops import sort_encoding as SE
+
+    padmask = K.row_mask(count, cap)
+    operands: list = []
+    for d, v in key_arrays:
+        # partition nulls group together: use the null rank slot but keep
+        # them, padding rows still sort last
+        operands.extend(SE.key_operands(d, v, padmask=padmask))
+    if not ascending:
+        ascending = tuple(True for _ in order_arrays)
+    for (d, v), asc in zip(order_arrays, ascending):
+        operands.extend(SE.key_operands(d, v, ascending=asc,
+                                        na_last=na_last, padmask=padmask))
+    nko = len(operands)
+    operands.append(jnp.arange(cap))
+    perm = lax.sort(tuple(operands), num_keys=max(nko, 1),
+                    is_stable=True)[-1]
+    padmask_s = padmask[perm]
+    pos = jnp.arange(cap)
+
+    def _changes(arrays):
+        chg = jnp.zeros(cap, dtype=bool)
+        for d, v in arrays:
+            null = SE.null_flag(d, v)
+            ds = d[perm]
+            if null is not None:
+                ns = null[perm]
+                ds = jnp.where(ns, jnp.zeros((), d.dtype), ds)
+                chg = chg | (ns != jnp.roll(ns, 1))
+            chg = chg | (ds != jnp.roll(ds, 1))
+        return chg
+
+    newpart = (_changes(key_arrays) & padmask_s) | (pos == 0)
+    seg = jnp.maximum(jnp.cumsum(newpart) - 1, 0)
+    n_segs = cap
+    seg_start = jax.ops.segment_min(jnp.where(padmask_s, pos, cap), seg,
+                                    num_segments=n_segs)[seg]
+    seg_cnt_row = jax.ops.segment_sum(padmask_s.astype(jnp.int64), seg,
+                                      num_segments=n_segs)[seg]
+    seg_end = seg_start + seg_cnt_row - 1
+    # peer groups: rows equal on ALL order keys (RANGE frame boundary)
+    newval = newpart | (_changes(order_arrays) & padmask_s)
+    peer = jnp.cumsum(newval & padmask_s)
+    peer_end = jax.ops.segment_max(jnp.where(padmask_s, pos, -1), peer,
+                                   num_segments=cap + 1)[peer]
+    return (perm, padmask_s, seg, seg_start, seg_end, seg_cnt_row,
+            newval, peer_end, pos)
+
+
+def _minmax_sparse_table(x_masked, n_levels: int):
+    """Sparse-table levels for range-min/max queries: levels[k][i] =
+    red(x[i .. i+2^k-1]) (array-clamped; queries stay inside segments so
+    no segment masking is needed at build time)."""
+    cap = x_masked.shape[0]
+    levels = [x_masked]
+    span = 1
+    for _ in range(n_levels - 1):
+        prev = levels[-1]
+        idx = jnp.minimum(jnp.arange(cap) + span, cap - 1)
+        levels.append(jnp.minimum(prev, prev[idx]))
+        span *= 2
+    return jnp.stack(levels)  # [K, cap]
+
+
+def _range_min(levels, a, b, empty):
+    """min over [a, b] per row from sparse-table levels ([K, cap])."""
+    length = jnp.maximum(b - a + 1, 1)
+    k = jnp.frexp(length.astype(jnp.float64))[1] - 1  # floor(log2)
+    k = jnp.clip(k, 0, levels.shape[0] - 1)
+    cap = levels.shape[1]
+    left = levels[k, jnp.clip(a, 0, cap - 1)]
+    right = levels[k, jnp.clip(b - (1 << jnp.clip(k, 0, 62)) + 1,
+                               0, cap - 1)]
+    out = jnp.minimum(left, right)
+    return jnp.where(empty, jnp.inf, out)
+
+
+@partial(jax.jit, static_argnames=("specs", "num_keys", "ascending",
+                                   "na_last"))
+def agg_window_local(key_arrays, order_arrays, val_arrays, count,
+                     specs: Tuple, num_keys: int,
+                     ascending: Tuple[bool, ...] = (),
+                     na_last: bool = True):
+    """Aggregate/navigation window functions in one sorted pass.
+
+    TPU-native replacement for the reference's window aggregate family
+    (bodo/libs/window/_window_aggfuncs.cpp WindowAggfunc,
+    bodo/libs/_lead_lag.cpp): sort once by (partition, order) keys, then
+    every frame aggregate is a prefix-sum difference (sum/count/mean) or
+    a sparse-table range query (min/max) over the sorted array —
+    O(n log n) total, no per-row loops, MXU/VPU-friendly static shapes.
+
+    specs: tuple of (op, val_idx, frame, param):
+      op    ∈ sum/mean/count/min/max/lead/lag/first_value/last_value
+      frame ∈ ("all",)                — whole partition (no ORDER BY)
+              ("cumrange",)           — RANGE UNBOUNDED PRECEDING..CURRENT
+                                        ROW (ORDER BY default; peers incl.)
+              ("rows", lo, hi)        — ROWS BETWEEN frames; lo/hi are
+                                        row offsets (None = unbounded)
+      param — LEAD/LAG offset (ignored otherwise)
+
+    Returns one (data_f64, valid_bool) pair per spec, aligned with input
+    rows (gather ops lead/lag/first/last return data in the SOURCE dtype
+    so dictionary codes and datetimes survive)."""
+    from bodo_tpu.ops import kernels as K
+
+    cap = (key_arrays[0][0].shape[0] if key_arrays
+           else (order_arrays[0][0].shape[0] if order_arrays
+                 else val_arrays[0][0].shape[0]))
+    (perm, padmask_s, seg, seg_start, seg_end, _seg_cnt, _newval,
+     peer_end, pos) = _sorted_segments(key_arrays, order_arrays, count,
+                                       ascending, na_last, cap)
+    padmask = K.row_mask(count, cap)
+
+    # per-value-column sorted data, ok masks, prefix sums (built lazily)
+    sorted_cache: dict = {}
+
+    def _sorted_val(vi):
+        if vi not in sorted_cache:
+            d, v = val_arrays[vi]
+            ok = K.value_ok(d, v, padmask)
+            sorted_cache[vi] = (d[perm], ok[perm])
+        return sorted_cache[vi]
+
+    prefix_cache: dict = {}
+
+    def _prefixes(vi):
+        if vi not in prefix_cache:
+            ds, oks = _sorted_val(vi)
+            xf = jnp.where(oks, ds.astype(jnp.float64), 0.0)
+            P0 = jnp.concatenate([jnp.zeros(1), jnp.cumsum(xf)])
+            C0 = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                                  jnp.cumsum(oks.astype(jnp.int64))])
+            prefix_cache[vi] = (P0, C0)
+        return prefix_cache[vi]
+
+    n_levels = max(int(np.ceil(np.log2(max(cap, 2)))) + 1, 1)
+    table_cache: dict = {}
+
+    def _tables(vi, want_max: bool):
+        key = (vi, want_max)
+        if key not in table_cache:
+            ds, oks = _sorted_val(vi)
+            xf = ds.astype(jnp.float64)
+            xm = jnp.where(oks, -xf if want_max else xf, jnp.inf)
+            table_cache[key] = _minmax_sparse_table(xm, n_levels)
+        return table_cache[key]
+
+    def _frame_bounds(frame):
+        if frame[0] == "all":
+            return seg_start, seg_end
+        if frame[0] == "cumrange":
+            return seg_start, peer_end
+        lo, hi = frame[1], frame[2]
+        a = seg_start if lo is None else jnp.maximum(pos + lo, seg_start)
+        b = seg_end if hi is None else jnp.minimum(pos + hi, seg_end)
+        return a, b
+
+    outs = []
+    inv = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(pos)
+    for op, vi, frame, param in specs:
+        if op in ("lead", "lag"):
+            off = int(param) * (1 if op == "lead" else -1)
+            tgt = pos + off
+            ds, oks = _sorted_val(vi)
+            inside = (tgt >= seg_start) & (tgt <= seg_end) & padmask_s
+            safe = jnp.clip(tgt, 0, cap - 1)
+            od = jnp.where(inside, ds[safe], jnp.zeros((), ds.dtype))
+            ov = inside & oks[safe]
+        elif op in ("first_value", "last_value"):
+            a, b = _frame_bounds(frame)
+            ds, oks = _sorted_val(vi)
+            at = a if op == "first_value" else b
+            nonempty = (b >= a) & padmask_s
+            safe = jnp.clip(at, 0, cap - 1)
+            od = jnp.where(nonempty, ds[safe], jnp.zeros((), ds.dtype))
+            ov = nonempty & oks[safe]
+        elif op in ("sum", "sum0", "mean", "count"):
+            a, b = _frame_bounds(frame)
+            P0, C0 = _prefixes(vi)
+            a_ = jnp.clip(a, 0, cap)
+            b_ = jnp.clip(b + 1, 0, cap)
+            nonempty = (b >= a) & padmask_s
+            wsum = jnp.where(nonempty, P0[b_] - P0[a_], 0.0)
+            wcnt = jnp.where(nonempty, C0[b_] - C0[a_], 0)
+            if op == "count":
+                od = wcnt.astype(jnp.float64)
+                ov = padmask_s
+            elif op == "sum":
+                od = wsum
+                ov = wcnt > 0          # SQL: SUM over empty/all-null=NULL
+            elif op == "sum0":
+                od = wsum              # pandas: empty/all-null sums to 0
+                ov = padmask_s
+            else:
+                od = wsum / jnp.maximum(wcnt, 1)
+                ov = wcnt > 0
+        elif op in ("min", "max"):
+            a, b = _frame_bounds(frame)
+            lv = _tables(vi, op == "max")
+            _, C0 = _prefixes(vi)
+            empty = (b < a) | ~padmask_s
+            m = _range_min(lv, a, b, empty)
+            # validity from the non-null COUNT, not isfinite(m): a real
+            # +/-inf data value must survive as inf, not become NULL
+            wcnt = jnp.where(empty, 0,
+                             C0[jnp.clip(b + 1, 0, cap)]
+                             - C0[jnp.clip(a, 0, cap)])
+            ov = wcnt > 0
+            od = jnp.where(ov, -m if op == "max" else m, 0.0)
+        else:
+            raise ValueError(f"unknown agg window op: {op}")
+        # scatter back to input row order
+        outs.append((od[inv], ov[inv]))
+    return tuple(outs)

@@ -150,6 +150,54 @@ class BodoGroupBy:
                             [(op, param, out)])
         return BodoSeries(node, ColRef(out), op)
 
+    # pandas transform('first'/'last') skip nulls (unlike SQL
+    # FIRST_VALUE), so only the null-agnostic aggs map onto AggWindow;
+    # sum0 = pandas sum semantics (all-null group sums to 0, not NULL)
+    _TRANSFORM_OPS = {"sum": "sum0", "mean": "mean", "count": "count",
+                      "min": "min", "max": "max"}
+
+    def transform(self, op):
+        """Row-aligned per-group aggregate (groupby.transform('sum') etc.)
+        via the AggWindow whole-partition frame — no gather, same kernel
+        as SQL SUM(...) OVER (PARTITION BY ...)."""
+        if not isinstance(op, str) or op not in self._TRANSFORM_OPS:
+            warn_fallback("groupby.transform", f"op {op!r}")
+            gb = self._df.to_pandas().groupby(self._keys)
+            if self._selection:
+                gb = gb[self._selection[0] if len(self._selection) == 1
+                        else self._selection]
+            return gb.transform(op)
+        cols = self._value_cols()
+        specs = [(self._TRANSFORM_OPS[op], c, ("all",), 0, f"__tf_{c}")
+                 for c in cols]
+        node = L.AggWindow(self._df._plan, self._keys, [], [], specs)
+        if self._single:
+            from bodo_tpu.plan.expr import ColRef
+
+            from bodo_tpu.pandas_api.series import BodoSeries
+            return BodoSeries(node, ColRef(f"__tf_{cols[0]}"), op)
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        out = BodoDataFrame(node)
+        return out[[f"__tf_{c}" for c in cols]].rename(
+            columns={f"__tf_{c}": c for c in cols})
+
+    def shift(self, periods: int = 1):
+        """Within-group shift (LEAD/LAG) in original row order."""
+        cols = self._value_cols()
+        op = "lag" if periods >= 0 else "lead"
+        specs = [(op, c, ("all",), abs(int(periods)), f"__sh_{c}")
+                 for c in cols]
+        node = L.AggWindow(self._df._plan, self._keys, [], [], specs)
+        if self._single:
+            from bodo_tpu.plan.expr import ColRef
+
+            from bodo_tpu.pandas_api.series import BodoSeries
+            return BodoSeries(node, ColRef(f"__sh_{cols[0]}"), "shift")
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        out = BodoDataFrame(node)
+        return out[[f"__sh_{c}" for c in cols]].rename(
+            columns={f"__sh_{c}": c for c in cols})
+
     def size(self):
         res = self._run([(self._keys[0], "size", "size")])
         if isinstance(res, _IndexedAggResult):

@@ -265,6 +265,43 @@ class RankWindow(Node):
                 tuple(self.specs))
 
 
+class AggWindow(Node):
+    """Aggregate/navigation windows: specs = [(op, col, frame, param,
+    out)] with op in sum/mean/count/min/max/lead/lag/first_value/
+    last_value; frame = ("all",) | ("cumrange",) | ("rows", lo, hi)
+    (SQL OVER(... ROWS BETWEEN ...); pandas groupby.transform /
+    groupby.shift)."""
+
+    def __init__(self, child: Node, partition_by, order_by, ascending,
+                 specs):
+        from bodo_tpu.ops.groupby import agg_dtype
+        self.children = [child]
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.ascending = list(ascending)
+        self.specs = [(op, col, tuple(frame), param, out)
+                      for op, col, frame, param, out in specs]
+        sch = dict(child.schema)
+        for op, col, frame, param, out in self.specs:
+            src = sch[col]
+            if op in ("lead", "lag", "first_value", "last_value"):
+                sch[out] = src
+            elif op == "count":
+                sch[out] = dt.INT64
+            else:
+                sch[out] = agg_dtype("sum" if op == "sum0" else op, src)
+        self.schema = sch
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("aggwin", self.child.key(), tuple(self.partition_by),
+                tuple(self.order_by), tuple(self.ascending),
+                tuple(self.specs))
+
+
 class Join(Node):
     def __init__(self, left: Node, right: Node, left_on, right_on,
                  how: str = "inner", suffixes=("_x", "_y"),

@@ -122,6 +122,9 @@ def _exec_inner(node: L.Node) -> Table:
     if isinstance(node, L.RankWindow):
         return R.rank_window(_exec(node.child), node.partition_by,
                              node.order_by, node.specs, node.ascending)
+    if isinstance(node, L.AggWindow):
+        return R.agg_window(_exec(node.child), node.partition_by,
+                            node.order_by, node.specs, node.ascending)
     if isinstance(node, L.Sort):
         return R.sort_table(_exec(node.child), node.by, node.ascending,
                             node.na_last)

@@ -77,7 +77,8 @@ def estimate(node: L.Node) -> Tuple[float, float]:
     if isinstance(node, L.Filter):
         est, raw = estimate(node.child)
         return max(est * selectivity(node.predicate), 1.0), raw
-    if isinstance(node, (L.Projection, L.Window, L.RankWindow, L.Sort)):
+    if isinstance(node, (L.Projection, L.Window, L.RankWindow,
+                         L.AggWindow, L.Sort)):
         return estimate(node.child)
     if isinstance(node, L.Limit):
         est, raw = estimate(node.child)

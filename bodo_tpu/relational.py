@@ -1398,6 +1398,104 @@ def _rank_window_exec(t: Table, partition_by, order_by, specs,
     return res
 
 
+def agg_window(t: Table, partition_by: Sequence[str],
+               order_by: Sequence[str],
+               specs: Sequence[Tuple[str, str, tuple, int, str]],
+               ascending=None, na_last: bool = True) -> Table:
+    """Aggregate/navigation windows: specs = [(op, col, frame, param,
+    outname)] with op in sum/mean/count/min/max/lead/lag/first_value/
+    last_value and frame in ("all",) / ("cumrange",) / ("rows", lo, hi)
+    (reference: bodo/libs/window/_window_aggfuncs.cpp,
+    bodo/libs/_lead_lag.cpp).
+
+    Distributed strategy mirrors rank_window: hash-shuffle whole
+    partitions onto shards, run the sorted-pass kernel locally, restore
+    the original row order via a rowid sample-sort."""
+    partition_by = list(partition_by)
+    order_by = list(order_by)
+    if ascending is None:
+        ascending = [True] * len(order_by)
+    elif isinstance(ascending, bool):
+        ascending = [ascending] * len(order_by)
+
+    local = _as_local(t)
+    if local is not None:
+        t = local
+    if t.distribution == ONED:
+        if not partition_by:
+            return agg_window(t.gather(), partition_by, order_by, specs,
+                              ascending, na_last).shard()
+        keep = t.names
+        t2 = window_table(t, [(t.names[0], "rowid", None, "__rid")])
+        t2 = shuffle_by_key(t2, partition_by)
+        exec_order, exec_asc = list(order_by), list(ascending)
+        if not exec_order and any(
+                op in ("lead", "lag", "first_value", "last_value")
+                or frame[0] != "all"
+                for op, _, frame, *_ in specs):
+            # order-sensitive specs with no ORDER BY follow the original
+            # row order — the shuffle may interleave source shards, so
+            # pin the sort to the global rowid
+            exec_order, exec_asc = ["__rid"], [True]
+        out = _agg_window_exec(t2, partition_by, exec_order, specs,
+                               tuple(exec_asc), na_last)
+        out = sort_table(out, ["__rid"])
+        return out.select(keep + [o for *_, o in specs])
+    return _agg_window_exec(t, partition_by, order_by, specs,
+                            tuple(ascending), na_last)
+
+
+def _agg_window_exec(t: Table, partition_by, order_by, specs,
+                     ascending: Tuple[bool, ...], na_last: bool) -> Table:
+    from bodo_tpu.ops.window import agg_window_local
+
+    val_cols = list(dict.fromkeys(c for _, c, *_ in specs))
+    vidx = {c: i for i, c in enumerate(val_cols)}
+    kspecs = tuple((op, vidx[c], tuple(frame), int(param or 0))
+                   for op, c, frame, param, _ in specs)
+    key = ("aggwin", _mesh_key(mesh_mod.get_mesh()), _sig(t),
+           tuple(partition_by), tuple(order_by), kspecs, ascending,
+           na_last, t.distribution)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        pk, ob, vc = list(partition_by), list(order_by), list(val_cols)
+
+        def body(tree, count):
+            ka = tuple(tree[n] for n in pk)
+            oa = tuple(tree[n] for n in ob)
+            va = tuple(tree[n] for n in vc)
+            return agg_window_local(ka, oa, va, count, kspecs, len(pk),
+                                    ascending, na_last)
+
+        if t.distribution == ONED:
+            m = mesh_mod.get_mesh()
+            ax = config.data_axis
+
+            def sharded(tree, counts):
+                return body(tree, counts[0])
+            fn = jax.jit(C.smap(sharded, in_specs=(P(ax), P(ax)),
+                                out_specs=P(ax), mesh=m))
+        else:
+            fn = jax.jit(body)
+        _jit_cache[key] = fn
+
+    counts = t.counts_device() if t.distribution == ONED \
+        else jnp.asarray(t.nrows)
+    outs = fn(t.device_data(), counts)
+    res = t.with_columns(t.columns)
+    for (op, col, frame, param, oname), (d, v) in zip(specs, outs):
+        src = t.column(col)
+        if op in ("lead", "lag", "first_value", "last_value"):
+            # gather ops carry the source dtype (and dictionary)
+            res.columns[oname] = Column(d, v, src.dtype, src.dictionary)
+        else:
+            # same dtype/descale rules as groupby aggregation outputs
+            # (sum0 = pandas-style sum: 0 over empty frames, same dtype)
+            res.columns[oname] = _agg_out_col(
+                src, "sum" if op == "sum0" else op, d, v)
+    return res
+
+
 # ---------------------------------------------------------------------------
 # whole-column reductions
 # ---------------------------------------------------------------------------

@@ -111,10 +111,13 @@ class Func:
 
 @dataclass
 class WindowA:
-    """fn(...) OVER (PARTITION BY ... ORDER BY ...)."""
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS BETWEEN]).
+    frame: None (default), or ("rows", lo, hi) with lo/hi row offsets
+    (negative = preceding, None = unbounded on that end)."""
     func: "Func"
     partition_by: List[Any]
     order_by: List[Tuple[Any, bool]]  # (expr, ascending)
+    frame: Any = None
 
 
 @dataclass
@@ -476,8 +479,63 @@ class Parser:
                 order.append((e, asc))
                 if not self.try_op(","):
                     break
+        frame = None
+        if self._try_word("ROWS"):
+            if not self.try_kw("BETWEEN"):
+                lo = self._frame_bound()       # ROWS <bound> = .. CURRENT
+                frame = ("rows", lo, 0)
+            else:
+                lo = self._frame_bound()
+                self.eat_kw("AND")
+                hi = self._frame_bound()
+                frame = ("rows", lo, hi)
+        elif self._try_word("RANGE"):
+            # only the default RANGE frame shapes are modeled
+            if not self.try_kw("BETWEEN"):
+                b = self._frame_bound()
+                if b is not None:
+                    raise NotImplementedError("RANGE with a value offset")
+            else:
+                lo = self._frame_bound()
+                self.eat_kw("AND")
+                hi = self._frame_bound()
+                if not (lo is None and hi in (0, None)):
+                    raise NotImplementedError("RANGE with value offsets")
+                if hi is None:
+                    frame = ("rows", None, None)  # whole partition
         self.eat_op(")")
-        return WindowA(fn, partition, order)
+        return WindowA(fn, partition, order, frame)
+
+    def _try_word(self, word: str) -> bool:
+        """Match a non-reserved word token (id or kw) case-insensitively."""
+        t, v = self.peek()
+        if t in ("id", "kw") and v.upper() == word:
+            self.i += 1
+            return True
+        return False
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | n PRECEDING |
+        n FOLLOWING → row offset (None = unbounded, 0 = current row)."""
+        if self._try_word("UNBOUNDED"):
+            if not (self._try_word("PRECEDING")
+                    or self._try_word("FOLLOWING")):
+                raise SyntaxError("expected PRECEDING/FOLLOWING")
+            return None
+        if self._try_word("CURRENT"):
+            if not self._try_word("ROW"):
+                raise SyntaxError("expected CURRENT ROW")
+            return 0
+        t, v = self.peek()
+        if t == "num":
+            self.i += 1
+            n = int(v)
+            if self._try_word("PRECEDING"):
+                return -n
+            if self._try_word("FOLLOWING"):
+                return n
+            raise SyntaxError("expected PRECEDING/FOLLOWING")
+        raise SyntaxError(f"bad frame bound at {self.peek()}")
 
     def or_expr(self):
         e = self.and_expr()
@@ -676,7 +734,10 @@ class Parser:
             if self.try_op("("):           # function call
                 if self.try_op("*"):
                     self.eat_op(")")
-                    return Func(name.lower(), [], star=True)
+                    fn = Func(name.lower(), [], star=True)
+                    if self.kw("OVER"):
+                        return self._over_clause(fn)
+                    return fn
                 distinct = self.try_kw("DISTINCT")
                 args = []
                 if not self.try_op(")"):

@@ -277,10 +277,11 @@ class Planner:
             raise NotImplementedError("SELECT without FROM")
         plan, scope = self._plan_from_where(sel.from_item, sel.where, outer)
 
-        # window-function extraction (ROW_NUMBER/RANK/DENSE_RANK/NTILE
-        # OVER (...)): each becomes a RankWindow node over the FROM/WHERE
-        # plan; the select expr is replaced by its output column
-        plan, scope, sel = self._lower_windows(plan, scope, sel)
+        # window-function extraction: each OVER (...) item is replaced by
+        # a placeholder column now and planned as a RankWindow/AggWindow
+        # node AFTER any GROUP BY aggregation (SQL evaluates window
+        # functions over the grouped rows)
+        windows = self._extract_windows(sel)
 
         # aggregate extraction
         aggs: List[Tuple[Expr, str, str]] = []   # (arg expr, op, temp name)
@@ -349,6 +350,16 @@ class Planner:
             projections = [(lower_aggs(e), a) for e, a in sel.projections]
             having = lower_aggs(sel.having) if sel.having is not None else None
             order_by = [(lower_aggs(e), asc) for e, asc in sel.order_by]
+            # window specs evaluate over the grouped rows: their member
+            # exprs go through the same GROUP-BY matching + agg lowering
+            for w, _ in windows:
+                if gb_markers:
+                    w.partition_by = [sub_group(x) for x in w.partition_by]
+                    w.order_by = [(sub_group(x), a) for x, a in w.order_by]
+                    w.func.args = [sub_group(x) for x in w.func.args]
+                w.partition_by = [lower_aggs(x) for x in w.partition_by]
+                w.order_by = [(lower_aggs(x), a) for x, a in w.order_by]
+                w.func.args = [lower_aggs(x) for x in w.func.args]
 
             # group keys: pre-project complex exprs to temp columns
             pre_cols: List[Tuple[str, Expr]] = \
@@ -389,6 +400,9 @@ class Planner:
                 plan = L.Filter(plan, self._expr(having, scope, None, None))
             sel = P.Select(projections=projections, order_by=order_by,
                            limit=sel.limit, distinct=sel.distinct)
+
+        if windows:
+            plan, scope = self._plan_windows(plan, scope, windows)
 
         # SELECT list
         out_exprs: List[Tuple[str, Expr]] = []
@@ -437,17 +451,31 @@ class Planner:
 
     _WINDOW_FUNCS = {"row_number": "row_number", "rank": "rank",
                      "dense_rank": "dense_rank", "ntile": "ntile"}
+    # aggregate/navigation window functions → AggWindow ops
+    _WINDOW_AGG_FUNCS = {"sum": "sum", "avg": "mean", "min": "min",
+                         "max": "max", "count": "count", "lead": "lead",
+                         "lag": "lag", "first_value": "first_value",
+                         "last_value": "last_value"}
 
-    def _lower_windows(self, plan, scope, sel):
-        """Replace WindowA select items with RankWindow output columns."""
+    def _extract_windows(self, sel):
+        """Replace WindowA select items with placeholder columns; the
+        collected windows are planned AFTER any GROUP BY aggregation
+        (SQL evaluates window functions over the grouped rows)."""
         found: List[Tuple[P.WindowA, str]] = []
 
         def walk_replace(e):
             if isinstance(e, P.WindowA):
-                if e.func.name not in self._WINDOW_FUNCS:
+                name = e.func.name
+                if e.func.star:
+                    if name != "count":
+                        raise NotImplementedError(
+                            f"window function {name}(*) — only COUNT(*)")
+                elif name not in self._WINDOW_FUNCS and \
+                        name not in self._WINDOW_AGG_FUNCS:
                     raise NotImplementedError(
-                        f"window function {e.func.name}() — supported: "
-                        f"{sorted(self._WINDOW_FUNCS)}")
+                        f"window function {name}() — supported: "
+                        f"{sorted(self._WINDOW_FUNCS)} + "
+                        f"{sorted(self._WINDOW_AGG_FUNCS)}")
                 tmp = f"__win{len(found)}"
                 found.append((e, tmp))
                 return P.Col(tmp, qualifier="__agg")
@@ -463,14 +491,10 @@ class Planner:
 
         sel.projections = [(walk_replace(e), a) for e, a in sel.projections]
         sel.order_by = [(walk_replace(e), a) for e, a in sel.order_by]
-        if not found:
-            return plan, scope, sel
-        if sel.group_by or _contains_agg(sel.projections) or \
-                sel.having is not None:
-            raise NotImplementedError(
-                "window functions combined with GROUP BY/aggregates in one "
-                "SELECT — compute the aggregate in a subquery first")
+        return found
 
+    def _plan_windows(self, plan, scope, found):
+        """Plan collected WindowA items as RankWindow/AggWindow nodes."""
         for w, tmp in found:
             pre: List[Tuple[str, Expr]] = [(c, ColRef(c))
                                            for c in plan.schema]
@@ -492,17 +516,60 @@ class Planner:
                     pre.append((f"{tmp}_o{i}", ex))
                     okeys.append(f"{tmp}_o{i}")
                 asc.append(a)
-            if len(pre) > len(plan.schema):
-                plan = L.Projection(plan, pre)
-            op = self._WINDOW_FUNCS[w.func.name]
-            param = 0
-            if op == "ntile":
-                if not (w.func.args and isinstance(w.func.args[0], P.Num)):
-                    raise NotImplementedError("NTILE needs a constant")
-                param = int(w.func.args[0].value)
-            plan = L.RankWindow(plan, pkeys, okeys, asc, [(op, param, tmp)])
+            name = w.func.name
+            if name in self._WINDOW_FUNCS and not w.func.star:
+                if len(pre) > len(plan.schema):
+                    plan = L.Projection(plan, pre)
+                op = self._WINDOW_FUNCS[name]
+                param = 0
+                if op == "ntile":
+                    if not (w.func.args and
+                            isinstance(w.func.args[0], P.Num)):
+                        raise NotImplementedError("NTILE needs a constant")
+                    param = int(w.func.args[0].value)
+                plan = L.RankWindow(plan, pkeys, okeys, asc,
+                                    [(op, param, tmp)])
+            else:
+                op = "count" if w.func.star else \
+                    self._WINDOW_AGG_FUNCS[name]
+                param = 0
+                if op in ("lead", "lag"):
+                    if not okeys:
+                        raise NotImplementedError(f"{name} needs ORDER BY")
+                    if len(w.func.args) > 2:
+                        raise NotImplementedError(
+                            f"{name} with an explicit default value")
+                    param = 1
+                    if len(w.func.args) == 2:
+                        if not isinstance(w.func.args[1], P.Num):
+                            raise NotImplementedError(
+                                f"{name} offset must be a constant")
+                        param = int(w.func.args[1].value)
+                # value column: pre-project non-trivial args
+                if w.func.star:
+                    pre.append((f"{tmp}_v", Lit(1)))
+                    vcol = f"{tmp}_v"
+                else:
+                    vex = self._expr(w.func.args[0], scope, None, None)
+                    if isinstance(vex, ColRef):
+                        vcol = vex.name
+                    else:
+                        pre.append((f"{tmp}_v", vex))
+                        vcol = f"{tmp}_v"
+                if len(pre) > len(plan.schema):
+                    plan = L.Projection(plan, pre)
+                if w.frame is not None:
+                    frame = tuple(w.frame)
+                elif okeys:
+                    frame = ("cumrange",)
+                else:
+                    frame = ("all",)
+                if op in ("lead", "lag"):
+                    frame = ("all",)  # navigation ops ignore the frame
+                plan = L.AggWindow(plan, pkeys, okeys, asc,
+                                   [(op, vcol, frame, param, tmp)])
             scope.add("__agg", tmp, tmp)
-        return plan, scope, sel
+        return plan, scope
 
     # ------------------------------------------------------------------
     # FROM + WHERE: join-graph construction

@@ -164,6 +164,41 @@ def _build_groupby_partial(mesh_key, num_keys: int, specs: Tuple[str, ...]):
     return jax.jit(shd)
 
 
+def shuffle_partials(pk, pv, num_keys: int, S: int, bucket_cap: int,
+                     ng, axis):
+    """Hash-shuffle packed groupby partials to their owner shard.
+
+    pk/pv: key / partial-value (data, valid) pairs packed at the front
+    (ng live rows). Validity masks ride the wire as extra slots next to
+    their data column; keys come back maskless (group keys are
+    canonical). Returns (recv_keys, recv_vals, recv_count, overflow) —
+    the one shared layout convention for every shuffle-partials caller
+    (whole-table two-phase groupby and the streaming accumulator)."""
+    h = hash_columns(pk)
+    dest = dest_shard(h, S)
+    flat: List = [d for d, _ in pk]
+    has_valid: List[bool] = []
+    for d, v in pv:
+        flat.append(d)
+        if v is not None:
+            has_valid.append(True)
+            flat.append(v)
+        else:
+            has_valid.append(False)
+    out, cnt, ovf = shuffle_rows(dest, flat, ng, S, bucket_cap, axis)
+    rk = tuple((out[i], None) for i in range(num_keys))
+    rv = []
+    j = num_keys
+    for hv in has_valid:
+        if hv:
+            rv.append((out[j], out[j + 1].astype(bool)))
+            j += 2
+        else:
+            rv.append((out[j], None))
+            j += 1
+    return rk, tuple(rv), cnt, ovf
+
+
 @lru_cache(maxsize=256)
 def _build_groupby_combine(mesh_key, num_keys: int, specs: Tuple[str, ...],
                            value_dtypes: Tuple, bucket_cap: int,
@@ -179,29 +214,9 @@ def _build_groupby_combine(mesh_key, num_keys: int, specs: Tuple[str, ...],
     def body(partials, ngs):
         pk, pv = partials
         ng = ngs[0]
-        h = hash_columns(pk)
-        dest = dest_shard(h, S)
-        flat: List = [d for d, _ in pk]
-        valmask_slots = []
-        for d, v in pv:
-            flat.append(d)
-            if v is not None:
-                valmask_slots.append(len(flat))
-                flat.append(v)
-            else:
-                valmask_slots.append(None)
-        out, cnt2, ovf = shuffle_rows(dest, flat, ng, S, bucket_cap, axis)
-        rk = tuple((out[i], None) for i in range(num_keys))
-        rv = []
-        j = num_keys
-        for slot in valmask_slots:
-            if slot is None:
-                rv.append((out[j], None))
-                j += 1
-            else:
-                rv.append((out[j], out[j + 1].astype(bool)))
-                j += 2
-        fk, fv, ng2 = groupby_local(rk + tuple(rv), cnt2, combine_specs,
+        rk, rv, cnt2, ovf = shuffle_partials(pk, pv, num_keys, S,
+                                             bucket_cap, ng, axis)
+        fk, fv, ng2 = groupby_local(rk + rv, cnt2, combine_specs,
                                     final_cap, num_keys)
         finals = []
         for i, op in enumerate(specs):

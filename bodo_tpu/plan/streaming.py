@@ -626,8 +626,12 @@ def stream_to_parquet(node: L.Node, path: str) -> bool:
 def try_stream_execute(node: L.Node) -> Optional[Table]:
     """Execute a plan with the streaming batch executor when its shape
     supports it; None → caller falls back to whole-table execution."""
-    if not config.stream_exec or mesh_mod.num_shards() > 1:
+    if not config.stream_exec:
         return None
+    if mesh_mod.num_shards() > 1:
+        from bodo_tpu.plan.streaming_sharded import \
+            try_stream_execute_sharded
+        return try_stream_execute_sharded(node)
 
     if isinstance(node, L.Aggregate):
         from bodo_tpu.table import dtypes as dt_

@@ -1,0 +1,571 @@
+"""Distributed (1D) streaming execution: sharded batches + overlapped
+all_to_all shuffle.
+
+TPU-native redesign of the reference's distributed streaming operators
+(reference: bodo/libs/streaming/_shuffle.h:777 `IncrementalShuffleState`
+async sends overlapping compute, streaming/_groupby.cpp
+`GroupbyState::UpdateGroupsAndCombine`, streaming/_join.h:892). Where the
+reference posts MPI_Ialltoallv per batch and polls completion, here every
+batch runs ONE fused jitted shard_map step —
+
+    local partial aggregation
+      → hash-bucket fixed-capacity `lax.all_to_all` to the owner shard
+      → merge into the per-shard packed state
+
+— and the host never syncs inside the loop: group counts stay on device,
+state capacities are sized from host-known row-count BOUNDS, and the
+shuffle-overflow flag is checked one batch LATE (deferred sync). By the
+time batch k+1 is decoded on host, batch k's device work has already been
+dispatched — XLA's async dispatch gives the same compute/communication
+overlap the reference gets from MPI_Ialltoallv. On overflow the step is
+re-run from a kept pre-state at a larger bucket capacity (the analogue of
+the reference's partition re-splitting, streaming/_join.h:267); the
+always-safe bound is the per-shard batch capacity, so the retry loop
+terminates.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from bodo_tpu import relational as R
+from bodo_tpu.config import config
+from bodo_tpu.ops.groupby import (DECOMPOSE, groupby_local, groupby_merge,
+                                  result_dtype)
+from bodo_tpu.parallel import collectives as C
+from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.parallel.shuffle import (_MESHES, _mesh_key,
+                                       _plan_decomposition, _finalize,
+                                       shuffle_partials)
+from bodo_tpu.plan.streaming import _bucket_cap as _pow2_cap
+from bodo_tpu.table import dtypes as dt
+from bodo_tpu.table.table import Column, ONED, REP, Table
+from bodo_tpu.utils.logging import log
+
+
+# ---------------------------------------------------------------------------
+# sharded re-capacity / slicing (shard_map helpers)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=256)
+def _build_recap(mesh_key, old_per: int, new_per: int):
+    mesh = _MESHES[mesh_key]
+    axis = config.data_axis
+
+    def body(tree):
+        def one(a):
+            if a is None:
+                return None
+            if new_per <= old_per:
+                return a[:new_per]
+            pad = jnp.zeros((new_per - old_per,) + a.shape[1:], a.dtype)
+            return jnp.concatenate([a, pad])
+        return {n: (one(d), one(v)) for n, (d, v) in tree.items()}
+
+    return jax.jit(C.smap(body, in_specs=(P(axis),), out_specs=P(axis),
+                          mesh=mesh))
+
+
+def shard_recapacity(t: Table, new_per: int, mesh=None) -> Table:
+    """Change a 1D table's PER-SHARD capacity (device-side pad/slice, no
+    host transit). Rows stay packed at the front of each shard."""
+    assert t.distribution == ONED
+    m = mesh or mesh_mod.get_mesh()
+    per = t.shard_capacity
+    if per == new_per:
+        return t
+    assert new_per >= int(t.counts.max(initial=0)), (new_per, t.counts)
+    fn = _build_recap(_mesh_key(m), per, new_per)
+    tree = fn(t.device_data())
+    return t.with_device_data(tree, nrows=t.nrows, counts=t.counts)
+
+
+@lru_cache(maxsize=256)
+def _build_slicer(mesh_key, per: int, bcap: int):
+    mesh = _MESHES[mesh_key]
+    axis = config.data_axis
+
+    def body(tree, off):
+        o = off[0]
+
+        def one(a):
+            if a is None:
+                return None
+            return lax.dynamic_slice_in_dim(a, o, bcap)
+        return {n: (one(d), one(v)) for n, (d, v) in tree.items()}
+
+    return jax.jit(C.smap(body, in_specs=(P(axis), P(axis)),
+                          out_specs=P(axis), mesh=mesh))
+
+
+def table_batches_sharded(t: Table, batch_rows: int,
+                          mesh=None) -> Iterator[Table]:
+    """Slice a 1D table into fixed-capacity 1D batches. All shards step in
+    lockstep (a shard that ran out of rows contributes count-0 batches) so
+    every per-batch collective sees the full mesh."""
+    assert t.distribution == ONED
+    m = mesh or mesh_mod.get_mesh()
+    S = mesh_mod.num_shards(m)
+    bcap = _pow2_cap(batch_rows)
+    per = t.shard_capacity
+    if per % bcap != 0:
+        per = ((per + bcap - 1) // bcap) * bcap
+        t = shard_recapacity(t, per, m)
+    fn = _build_slicer(_mesh_key(m), per, bcap)
+    max_count = int(t.counts.max(initial=0))
+    n_batches = max(1, -(-max_count // bcap))
+    off_shard = mesh_mod.row_sharding(m)
+    for b in range(n_batches):
+        off = b * bcap
+        counts_b = np.clip(t.counts - off, 0, bcap).astype(np.int64)
+        off_dev = jax.device_put(
+            np.full((S,), off, dtype=np.int32), off_shard)
+        tree = fn(t.device_data(), off_dev)
+        yield t.with_device_data(tree, nrows=int(counts_b.sum()),
+                                 counts=counts_b)
+
+
+def parquet_batches_sharded(path: str, columns: Optional[Sequence[str]],
+                            batch_rows: int, mesh=None) -> Iterator[Table]:
+    """Stream a parquet dataset as 1D batches: host-read fixed row windows
+    (bounded host memory), scatter each over the mesh at a FIXED per-shard
+    capacity so every downstream kernel compiles once."""
+    from bodo_tpu.plan.streaming import parquet_batches
+    m = mesh or mesh_mod.get_mesh()
+    S = mesh_mod.num_shards(m)
+    bcap_s = _pow2_cap(-(-batch_rows // S))
+    with mesh_mod.use_mesh(m):
+        for rep_batch in parquet_batches(path, columns, batch_rows):
+            sh = rep_batch.shard()
+            yield shard_recapacity(sh, bcap_s, m)
+
+
+# ---------------------------------------------------------------------------
+# sharded streaming groupby
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=256)
+def _build_sharded_step(mesh_key, num_keys: int, specs: Tuple[str, ...],
+                        bucket_cap: int, state_cap: int):
+    """One streamed-groupby step: partial-agg the batch, shuffle partial
+    rows to their hash-owner shard, merge into the per-shard state. The
+    whole step is one jitted shard_map program — XLA overlaps the
+    all_to_all with the surrounding compute, and nothing in it forces a
+    host sync."""
+    mesh = _MESHES[mesh_key]
+    axis = config.data_axis
+    S = mesh.shape[axis]
+    partial_specs, combine_specs, _ = _plan_decomposition(specs)
+
+    def body(batch_arrays, batch_counts, state_arrays, state_counts):
+        count = batch_counts[0]
+        n_state = state_counts[0]
+        cap = batch_arrays[0][0].shape[0]
+        keys = batch_arrays[:num_keys]
+        values = batch_arrays[num_keys:]
+        p_inputs = tuple(keys) + tuple(
+            values[i] for i, op in enumerate(specs)
+            for _ in DECOMPOSE[op])
+        pk, pv, ng = groupby_local(p_inputs, count, partial_specs, cap,
+                                   num_keys)
+        rk, rv, cnt, ovf = shuffle_partials(pk, pv, num_keys, S,
+                                            bucket_cap, ng, axis)
+        state_flat = tuple(state_arrays[0]) + tuple(state_arrays[1])
+        mk, mv, ng2 = groupby_merge(state_flat, rk + rv,
+                                    n_state, cnt, combine_specs,
+                                    state_cap, num_keys)
+        return (mk, mv), ng2[None], ovf[None]
+
+    shd = C.smap(body, in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                 out_specs=(P(axis), P(axis), P(axis)), mesh=mesh)
+    return jax.jit(shd)
+
+
+class ShardedGroupbyAccumulator:
+    """Distributed streaming groupby over 1D batches.
+
+    Per-shard packed state holds the groups HASH-OWNED by that shard
+    (keys + partial-agg columns); finish() finalizes in place, so the
+    result is already a valid 1D table — no gather anywhere.
+
+    Pipelining: push(k) dispatches step k FIRST, then resolves batch
+    k-1's overflow flag and output group counts — both long computed by
+    the time batch k was decoded on host, so neither read stalls the
+    pipe. The host therefore always knows the exact per-shard group count
+    with a one-batch lag, and sizes the state capacity as
+    known_count + 2·recv_window — flat in the number of batches. The
+    rare overflow rewinds to the kept pre-state and replays the affected
+    batches at a larger bucket capacity (O(2 batches + 1 state) extra
+    memory, the price of never blocking on a flag read).
+    """
+
+    def __init__(self, keys: Sequence[str], aggs: Sequence[Tuple],
+                 mesh=None):
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        self.specs = tuple(op for _, op, _ in aggs)
+        self.partial_specs, self.combine_specs, self.layout = \
+            _plan_decomposition(self.specs)
+        self.mesh = mesh or mesh_mod.get_mesh()
+        self.S = mesh_mod.num_shards(self.mesh)
+        self._mk = _mesh_key(self.mesh)
+        self._state: Optional[Tuple] = None   # ((mk, mv), counts_dev)
+        self._state_meta: Optional[List[Tuple]] = None
+        self._known = 0          # exact max per-shard groups, 1 batch stale
+        self._bucket_cap: Optional[int] = None
+        self._state_cap = 0
+        # unresolved dispatches: (pre_state, inputs, ovf, out, bcap)
+        self._queue: List[Tuple] = []
+        self._template: Optional[Table] = None
+        self.peak_state_cap = 0  # observability: max per-shard state rows
+        self.n_retries = 0       # observability: overflow replays
+
+    # -- schema plumbing ----------------------------------------------------
+
+    def _plan_meta(self, batch: Table) -> None:
+        """(name, DType, dictionary, src_column) for state columns: keys
+        then one column per partial spec. src_column tracks which batch
+        column's dictionary a dict-coded state column follows."""
+        meta = []
+        for k in self.keys:
+            c = batch.column(k)
+            meta.append((k, c.dtype, c.dictionary,
+                         k if c.dictionary is not None else None))
+        pi = 0
+        for (cname, op, _), parts in zip(self.aggs,
+                                         (DECOMPOSE[s] for s in self.specs)):
+            src = batch.column(cname)
+            for pop in parts:
+                if pop in ("min", "max", "first", "last"):
+                    meta.append((f"__p{pi}", src.dtype, src.dictionary,
+                                 cname if src.dictionary is not None
+                                 else None))
+                else:
+                    meta.append((f"__p{pi}",
+                                 dt.from_numpy(result_dtype(pop,
+                                                            src.dtype.numpy)),
+                                 None, None))
+                pi += 1
+        self._state_meta = meta
+
+    def _zero_state(self, state_cap: int) -> Tuple:
+        nk = len(self.keys)
+        sh = mesh_mod.row_sharding(self.mesh)
+        cols = []
+        for name, dtype, _, _ in self._state_meta:
+            d = jax.device_put(
+                np.zeros((self.S * state_cap,), dtype=dtype.numpy), sh)
+            v = jax.device_put(np.zeros((self.S * state_cap,), bool), sh)
+            cols.append((d, v))
+        counts = jax.device_put(np.zeros((self.S,), np.int64), sh)
+        return ((tuple(cols[:nk]), tuple(cols[nk:])), counts)
+
+    def _batch_inputs(self, batch: Table):
+        arrays = tuple((batch.column(k).data, batch.column(k).valid)
+                       for k in self.keys)
+        arrays += tuple((batch.column(c).data, batch.column(c).valid)
+                        for c, _, _ in self.aggs)
+        return arrays, batch.counts_device()
+
+    # -- streaming protocol -------------------------------------------------
+
+    def push(self, batch: Table) -> None:
+        assert batch.distribution == ONED
+        if self._template is None:
+            self._template = batch
+            self._plan_meta(batch)
+        if batch.nrows == 0 and self._state is not None:
+            return
+        bcap = batch.shard_capacity
+        if self._bucket_cap is None:
+            tight = int(config.shuffle_skew_factor * bcap / self.S) + 64
+            self._bucket_cap = min(_pow2_cap(tight), _pow2_cap(bcap))
+
+        # re-code sharded state onto any grown dictionaries
+        bdicts = self._batch_dicts(batch)
+        self._absorb_dicts(bdicts)
+
+        # state must hold: last exact count (1 batch stale) + each
+        # unresolved dispatch's OWN recv window + this batch's window
+        recv = min(self.S * self._bucket_cap, self.S * bcap)
+        need = self._known + sum(e["recv"] for e in self._queue) + recv
+        if self._state is None:
+            self._state_cap = _pow2_cap(max(need, 1))
+            self._state = self._zero_state(self._state_cap)
+        elif need > self._state_cap:
+            self._state_cap = _pow2_cap(need)
+            self._state = self._recap_state(self._state, self._state_cap)
+        self._dispatch(self._batch_inputs(batch), bcap, bdicts)
+        # resolve the PREVIOUS dispatch only after launching this one —
+        # its flag/counts are computed by now, so the read doesn't stall
+        while len(self._queue) > 1:
+            self._resolve_oldest()
+
+    def _dispatch(self, inputs, bcap: int, bdicts) -> None:
+        from bodo_tpu.utils import tracing
+        arrays, counts = inputs
+        pre_state = self._state
+        step = _build_sharded_step(self._mk, len(self.keys), self.specs,
+                                   self._bucket_cap, self._state_cap)
+        (st, cnts) = pre_state
+        with tracing.event("stream1d_step"):
+            mkv, ng2, ovf = step(arrays, counts, st, cnts)
+        self._state = (mkv, ng2)
+        self._queue.append({
+            "pre_state": pre_state,
+            "pre_meta": list(self._state_meta),
+            "inputs": inputs, "bdicts": bdicts,
+            "ovf": ovf, "out_counts": ng2, "bcap": bcap,
+            "recv": min(self.S * self._bucket_cap, self.S * bcap)})
+        self.peak_state_cap = max(self.peak_state_cap, self._state_cap)
+
+    def _resolve_oldest(self) -> None:
+        e = self._queue.pop(0)
+        flags = np.asarray(jax.device_get(e["ovf"])).reshape(-1)
+        if not flags.any():
+            cnts = np.asarray(jax.device_get(e["out_counts"])).reshape(-1)
+            self._known = int(cnts.max(initial=0))
+            return
+        # overflow: every dispatch from this one on was built on a state
+        # missing the dropped rows — rewind state AND dictionary metadata
+        # to just before it, then replay them all at a larger bucket
+        # capacity (terminates: per-shard batch capacity is always safe).
+        # Each replayed batch re-applies its own dictionary growth so the
+        # rewound (older-dict) state is re-coded exactly as it was the
+        # first time through.
+        self.n_retries += 1
+        replay = [e] + self._queue
+        self._queue = []
+        self._state = e["pre_state"]
+        self._state_meta = list(e["pre_meta"])
+        safe = max(_pow2_cap(x["bcap"]) for x in replay)
+        self._bucket_cap = min(self._bucket_cap * 4, safe)
+        log(1, f"stream1d shuffle overflow: replaying {len(replay)} "
+               f"batches at bucket_cap={self._bucket_cap}")
+        for x in replay:
+            self._absorb_dicts(x["bdicts"])
+            while True:
+                recv = min(self.S * self._bucket_cap, self.S * x["bcap"])
+                need = self._known + recv
+                if need > self._state_cap:
+                    self._state_cap = _pow2_cap(need)
+                    self._state = self._recap_state(self._state,
+                                                    self._state_cap)
+                self._dispatch(x["inputs"], x["bcap"], x["bdicts"])
+                e2 = self._queue.pop()
+                f2 = np.asarray(jax.device_get(e2["ovf"])).reshape(-1)
+                if not f2.any():
+                    c2 = np.asarray(
+                        jax.device_get(e2["out_counts"])).reshape(-1)
+                    self._known = int(c2.max(initial=0))
+                    break
+                self._state = e2["pre_state"]
+                assert self._bucket_cap < safe, \
+                    "shuffle overflow at safe capacity"
+                self._bucket_cap = min(self._bucket_cap * 4, safe)
+
+    def _recap_state(self, state, new_cap: int):
+        (mk, mv), cnts = state
+        nk = len(self.keys)
+        tree = {}
+        for i, (d, v) in enumerate(tuple(mk) + tuple(mv)):
+            tree[f"c{i:03d}"] = (d, v)
+        fn = _build_recap(self._mk, next(iter(tree.values()))[0].shape[0]
+                          // self.S, new_cap)
+        out = fn(tree)
+        cols = [out[f"c{i:03d}"] for i in range(nk + len(mv))]
+        return ((tuple(cols[:nk]), tuple(cols[nk:])), cnts)
+
+    def _batch_dicts(self, batch: Table) -> List[Optional[np.ndarray]]:
+        """The batch's dictionary per state column (None for non-dict)."""
+        return [batch.column(src).dictionary if src is not None else None
+                for (_, _, _, src) in self._state_meta]
+
+    def _absorb_dicts(self, bdicts: List[Optional[np.ndarray]]) -> None:
+        """Re-code dict-coded state columns when the source dictionary has
+        grown (elementwise LUT gather — sharding-preserving, no
+        collective). Invariant (held by the sources' DictTracker and by
+        batches sliced from one table): a batch's dictionary is always a
+        superset of every earlier batch's, so the state dict is a subset
+        of the incoming one and batch codes never need re-coding here."""
+        if self._state is None:
+            return
+        from bodo_tpu.plan.streaming import remap_codes
+        nk = len(self.keys)
+        (mk, mv), cnts = self._state
+        cols = list(mk) + list(mv)
+        changed = False
+        for i, (name, dtype, sdict, src) in enumerate(self._state_meta):
+            if src is None:
+                continue
+            bdict = bdicts[i]
+            if sdict is None or bdict is None or sdict is bdict or \
+                    len(bdict) == len(sdict):
+                continue
+            d, v = cols[i]
+            col = remap_codes(Column(d, v, dtype, sdict), bdict)
+            cols[i] = (col.data, col.valid)
+            self._state_meta[i] = (name, dtype, bdict, src)
+            changed = True
+        if changed:
+            self._state = ((tuple(cols[:nk]), tuple(cols[nk:])), cnts)
+
+    def finish(self) -> Table:
+        assert self._template is not None, "empty stream"
+        while self._queue:
+            self._resolve_oldest()
+        nk = len(self.keys)
+        (mk, mv), cnts_dev = self._state
+        counts = np.asarray(jax.device_get(cnts_dev)).reshape(-1) \
+            .astype(np.int64)
+        cols: Dict[str, Column] = {}
+        for (name, dtype, dic, _), (d, v) in zip(self._state_meta[:nk],
+                                                 mk):
+            cols[name] = Column(d, v, dtype, dic)
+        # finalize partials → final agg columns (elementwise on the
+        # sharded arrays; sharding-preserving)
+        pcols = list(mv)
+        for i, (cname, op, oname) in enumerate(self.aggs):
+            off, n = self.layout[i]
+            src_dt = self._template.column(cname).dtype
+            d, v = _finalize(op, tuple(pcols[off + j] for j in range(n)),
+                             jnp.dtype(src_dt.numpy))
+            if op in ("min", "max", "first", "last"):
+                rdt, dic = src_dt, self._state_meta[nk + off][2]
+            else:
+                rdt = dt.from_numpy(result_dtype(op, src_dt.numpy))
+                dic = None
+            cols[oname] = Column(d, v, rdt, dic)
+        return Table(cols, int(counts.sum()), ONED, counts)
+
+
+# ---------------------------------------------------------------------------
+# sharded stream compilation (mirrors streaming._build_stream)
+# ---------------------------------------------------------------------------
+
+class ShardedStreamJoin:
+    """Per-batch 1D probe against a replicated build side (the runtime
+    broadcast join over a stream; reference: streaming hash join with a
+    broadcast build, bodo/libs/streaming/_join.h:892)."""
+
+    def __init__(self, build: Table, left_on, right_on, how, suffixes,
+                 null_equal: bool = True):
+        self.left_on, self.right_on = left_on, right_on
+        self.how, self.suffixes = how, suffixes
+        self.null_equal = null_equal
+        self.build = build.gather() if build.distribution != REP else build
+
+    def __call__(self, batch: Table) -> Table:
+        out = R.join_tables(batch, self.build, self.left_on, self.right_on,
+                            self.how, self.suffixes,
+                            null_equal=self.null_equal)
+        if out.distribution != ONED:
+            out = out.shard()
+        cap = _pow2_cap(max(int(out.counts.max(initial=0)), 1))
+        return shard_recapacity(out, cap)
+
+
+def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
+    """Compile a plan subtree into a 1D batch iterator, or None when a
+    node has no sharded streaming form."""
+    from bodo_tpu.plan import logical as L
+    m = mesh or mesh_mod.get_mesh()
+    batch_rows = config.streaming_batch_size
+
+    if isinstance(node, L.ReadParquet):
+        return parquet_batches_sharded(node.path, node.columns, batch_rows,
+                                       m)
+    if isinstance(node, L.FromPandas):
+        t = node.table
+        if t.distribution != ONED:
+            if t.nrows < mesh_mod.num_shards(m):
+                return None
+            t = t.shard()
+        return table_batches_sharded(t, max(batch_rows //
+                                            mesh_mod.num_shards(m), 128), m)
+    if isinstance(node, L.Filter):
+        inner = build_stream_sharded(node.child, m)
+        if inner is None:
+            return None
+        pred = node.predicate
+
+        def gen_filter(src):
+            for b in src:
+                yield R.filter_table(b, pred)
+        return gen_filter(inner)
+    if isinstance(node, L.Projection):
+        inner = build_stream_sharded(node.child, m)
+        if inner is None:
+            return None
+        from bodo_tpu.plan.physical import apply_projection
+        exprs = node.exprs
+
+        def gen_project(src):
+            for b in src:
+                yield apply_projection(b, exprs)
+        return gen_project(inner)
+    if isinstance(node, L.Join):
+        if node.how not in ("inner", "left"):
+            return None
+        inner = build_stream_sharded(node.left, m)
+        if inner is None:
+            return None
+        from bodo_tpu.plan import physical
+        build = physical._exec(node.right)
+        if build.nrows > config.bcast_join_threshold:
+            return None  # build too big to replicate: whole-table path
+        join = ShardedStreamJoin(build, node.left_on, node.right_on,
+                                 node.how, node.suffixes, node.null_equal)
+
+        def gen_join(src):
+            for b in src:
+                yield join(b)
+        return gen_join(inner)
+    return None
+
+
+def try_stream_execute_sharded(node) -> Optional[Table]:
+    """Streaming executor over the full mesh: groupby plans stream 1D
+    batches through the overlapped-shuffle accumulator. None → caller
+    falls back to whole-table execution."""
+    from bodo_tpu.plan import logical as L
+    if not config.stream_exec:
+        return None
+    m = mesh_mod.get_mesh()
+    if mesh_mod.num_shards(m) <= 1:
+        return None
+
+    if isinstance(node, L.Aggregate):
+        if any(dt.is_decimal(node.child.schema[c])
+               for c, _, _ in node.aggs):
+            return None
+        if any(op not in DECOMPOSE for _, op, _ in node.aggs):
+            return None
+        if not node.keys:
+            return None
+        src = build_stream_sharded(node.child, m)
+        if src is None:
+            return None
+        try:
+            acc = ShardedGroupbyAccumulator(node.keys, node.aggs, m)
+        except NotImplementedError:
+            return None
+        nb = 0
+        for b in src:
+            acc.push(b)
+            nb += 1
+        if acc._template is None:
+            return None
+        out = acc.finish()
+        log(1, f"sharded streaming groupby: {nb} batches, "
+               f"{out.nrows} groups over {acc.S} shards")
+        return out
+
+    return None

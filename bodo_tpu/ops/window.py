@@ -333,32 +333,42 @@ def _sorted_segments(key_arrays, order_arrays, count, ascending, na_last,
             newval, peer_end, pos)
 
 
-def _minmax_sparse_table(x_masked, n_levels: int):
+def _minmax_sparse_table(x_masked, n_levels: int, want_max: bool):
     """Sparse-table levels for range-min/max queries: levels[k][i] =
     red(x[i .. i+2^k-1]) (array-clamped; queries stay inside segments so
-    no segment masking is needed at build time)."""
+    no segment masking is needed at build time). Works in the value's own
+    domain dtype (int64 for integers/datetimes/decimals, float for
+    floats) so results are EXACT — no float64 round-trip."""
+    red = jnp.maximum if want_max else jnp.minimum
     cap = x_masked.shape[0]
     levels = [x_masked]
     span = 1
     for _ in range(n_levels - 1):
         prev = levels[-1]
         idx = jnp.minimum(jnp.arange(cap) + span, cap - 1)
-        levels.append(jnp.minimum(prev, prev[idx]))
+        levels.append(red(prev, prev[idx]))
         span *= 2
     return jnp.stack(levels)  # [K, cap]
 
 
-def _range_min(levels, a, b, empty):
-    """min over [a, b] per row from sparse-table levels ([K, cap])."""
+def _range_minmax(levels, a, b, empty, want_max: bool, sentinel):
+    """min/max over [a, b] per row from sparse-table levels ([K, cap]).
+
+    floor(log2(length)) is computed by a static unrolled compare chain
+    over the (few) levels — no frexp/bitcast, which the TPU x64-rewrite
+    pass rejects."""
     length = jnp.maximum(b - a + 1, 1)
-    k = jnp.frexp(length.astype(jnp.float64))[1] - 1  # floor(log2)
-    k = jnp.clip(k, 0, levels.shape[0] - 1)
+    n_levels = levels.shape[0]
+    k = jnp.zeros(length.shape, dtype=jnp.int32)
+    for j in range(1, n_levels):
+        k = jnp.where(length >= (1 << j), j, k)
     cap = levels.shape[1]
     left = levels[k, jnp.clip(a, 0, cap - 1)]
     right = levels[k, jnp.clip(b - (1 << jnp.clip(k, 0, 62)) + 1,
                                0, cap - 1)]
-    out = jnp.minimum(left, right)
-    return jnp.where(empty, jnp.inf, out)
+    red = jnp.maximum if want_max else jnp.minimum
+    out = red(left, right)
+    return jnp.where(empty, sentinel, out)
 
 
 @partial(jax.jit, static_argnames=("specs", "num_keys", "ascending",
@@ -385,9 +395,11 @@ def agg_window_local(key_arrays, order_arrays, val_arrays, count,
                                         row offsets (None = unbounded)
       param — LEAD/LAG offset (ignored otherwise)
 
-    Returns one (data_f64, valid_bool) pair per spec, aligned with input
-    rows (gather ops lead/lag/first/last return data in the SOURCE dtype
-    so dictionary codes and datetimes survive)."""
+    Returns one (data, valid_bool) pair per spec, aligned with input
+    rows: prefix-sum ops (sum/mean/count) in float64; min/max in the
+    value's exact domain (int64 for ints/datetimes/decimals, float64 for
+    floats); gather ops (lead/lag/first/last) in the SOURCE dtype so
+    dictionary codes and datetimes survive."""
     from bodo_tpu.ops import kernels as K
 
     cap = (key_arrays[0][0].shape[0] if key_arrays
@@ -424,12 +436,23 @@ def agg_window_local(key_arrays, order_arrays, val_arrays, count,
     table_cache: dict = {}
 
     def _tables(vi, want_max: bool):
+        """Sparse table + sentinel in the value's exact domain: floats
+        stay float (widened to f64), everything else (ints, bools,
+        datetime ticks, decimal scaled-ints) runs in int64 so min/max
+        round-trip exactly (large ids, timestamps, 18-digit decimals)."""
         key = (vi, want_max)
         if key not in table_cache:
             ds, oks = _sorted_val(vi)
-            xf = ds.astype(jnp.float64)
-            xm = jnp.where(oks, -xf if want_max else xf, jnp.inf)
-            table_cache[key] = _minmax_sparse_table(xm, n_levels)
+            if jnp.issubdtype(ds.dtype, jnp.floating):
+                dom = ds.astype(jnp.float64)
+                sentinel = -jnp.inf if want_max else jnp.inf
+            else:
+                dom = ds.astype(jnp.int64)
+                ii = jnp.iinfo(jnp.int64)
+                sentinel = ii.min if want_max else ii.max
+            xm = jnp.where(oks, dom, sentinel)
+            table_cache[key] = (
+                _minmax_sparse_table(xm, n_levels, want_max), sentinel)
         return table_cache[key]
 
     def _frame_bounds(frame):
@@ -483,17 +506,17 @@ def agg_window_local(key_arrays, order_arrays, val_arrays, count,
                 ov = wcnt > 0
         elif op in ("min", "max"):
             a, b = _frame_bounds(frame)
-            lv = _tables(vi, op == "max")
+            lv, sentinel = _tables(vi, op == "max")
             _, C0 = _prefixes(vi)
             empty = (b < a) | ~padmask_s
-            m = _range_min(lv, a, b, empty)
+            m = _range_minmax(lv, a, b, empty, op == "max", sentinel)
             # validity from the non-null COUNT, not isfinite(m): a real
             # +/-inf data value must survive as inf, not become NULL
             wcnt = jnp.where(empty, 0,
                              C0[jnp.clip(b + 1, 0, cap)]
                              - C0[jnp.clip(a, 0, cap)])
             ov = wcnt > 0
-            od = jnp.where(ov, -m if op == "max" else m, 0.0)
+            od = jnp.where(ov, m, jnp.zeros((), m.dtype))
         else:
             raise ValueError(f"unknown agg window op: {op}")
         # scatter back to input row order

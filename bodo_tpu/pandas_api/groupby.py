@@ -167,36 +167,33 @@ class BodoGroupBy:
                 gb = gb[self._selection[0] if len(self._selection) == 1
                         else self._selection]
             return gb.transform(op)
-        cols = self._value_cols()
-        specs = [(self._TRANSFORM_OPS[op], c, ("all",), 0, f"__tf_{c}")
-                 for c in cols]
-        node = L.AggWindow(self._df._plan, self._keys, [], [], specs)
-        if self._single:
-            from bodo_tpu.plan.expr import ColRef
-
-            from bodo_tpu.pandas_api.series import BodoSeries
-            return BodoSeries(node, ColRef(f"__tf_{cols[0]}"), op)
-        from bodo_tpu.pandas_api.frame import BodoDataFrame
-        out = BodoDataFrame(node)
-        return out[[f"__tf_{c}" for c in cols]].rename(
-            columns={f"__tf_{c}": c for c in cols})
+        return self._agg_window(
+            lambda c, tmp: (self._TRANSFORM_OPS[op], c, ("all",), 0, tmp),
+            "__tf", op)
 
     def shift(self, periods: int = 1):
         """Within-group shift (LEAD/LAG) in original row order."""
-        cols = self._value_cols()
         op = "lag" if periods >= 0 else "lead"
-        specs = [(op, c, ("all",), abs(int(periods)), f"__sh_{c}")
-                 for c in cols]
+        off = abs(int(periods))
+        return self._agg_window(
+            lambda c, tmp: (op, c, ("all",), off, tmp), "__sh", "shift")
+
+    def _agg_window(self, spec_of, prefix: str, label: str):
+        """Shared AggWindow tail for the row-aligned group ops: build one
+        spec per value column, then unwrap to a Series (single selection)
+        or a renamed frame."""
+        cols = self._value_cols()
+        specs = [spec_of(c, f"{prefix}_{c}") for c in cols]
         node = L.AggWindow(self._df._plan, self._keys, [], [], specs)
         if self._single:
             from bodo_tpu.plan.expr import ColRef
 
             from bodo_tpu.pandas_api.series import BodoSeries
-            return BodoSeries(node, ColRef(f"__sh_{cols[0]}"), "shift")
+            return BodoSeries(node, ColRef(f"{prefix}_{cols[0]}"), label)
         from bodo_tpu.pandas_api.frame import BodoDataFrame
         out = BodoDataFrame(node)
-        return out[[f"__sh_{c}" for c in cols]].rename(
-            columns={f"__sh_{c}": c for c in cols})
+        return out[[f"{prefix}_{c}" for c in cols]].rename(
+            columns={f"{prefix}_{c}": c for c in cols})
 
     def size(self):
         res = self._run([(self._keys[0], "size", "size")])

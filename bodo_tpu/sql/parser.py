@@ -482,26 +482,42 @@ class Parser:
         frame = None
         if self._try_word("ROWS"):
             if not self.try_kw("BETWEEN"):
-                lo = self._frame_bound()       # ROWS <bound> = .. CURRENT
-                frame = ("rows", lo, 0)
+                # shorthand <bound> = BETWEEN <bound> AND CURRENT ROW;
+                # SQL requires the bound not to follow the current row
+                lo = self._frame_bound()
+                if lo == "unb_foll" or (isinstance(lo, int) and lo > 0):
+                    raise SyntaxError(
+                        "ROWS <bound> shorthand requires PRECEDING or "
+                        "CURRENT ROW (use ROWS BETWEEN ... AND n "
+                        "FOLLOWING)")
+                frame = ("rows", None if lo == "unb_prec" else lo, 0)
             else:
                 lo = self._frame_bound()
                 self.eat_kw("AND")
                 hi = self._frame_bound()
+                if lo == "unb_foll" or hi == "unb_prec":
+                    raise SyntaxError(
+                        "frame start may not be UNBOUNDED FOLLOWING and "
+                        "frame end may not be UNBOUNDED PRECEDING")
+                lo = None if lo == "unb_prec" else lo
+                hi = None if hi == "unb_foll" else hi
+                if lo is not None and hi is not None and lo > hi:
+                    raise SyntaxError(
+                        f"frame start ({lo}) follows frame end ({hi})")
                 frame = ("rows", lo, hi)
         elif self._try_word("RANGE"):
             # only the default RANGE frame shapes are modeled
             if not self.try_kw("BETWEEN"):
                 b = self._frame_bound()
-                if b is not None:
+                if b != "unb_prec":
                     raise NotImplementedError("RANGE with a value offset")
             else:
                 lo = self._frame_bound()
                 self.eat_kw("AND")
                 hi = self._frame_bound()
-                if not (lo is None and hi in (0, None)):
+                if not (lo == "unb_prec" and hi in (0, "unb_foll")):
                     raise NotImplementedError("RANGE with value offsets")
-                if hi is None:
+                if hi == "unb_foll":
                     frame = ("rows", None, None)  # whole partition
         self.eat_op(")")
         return WindowA(fn, partition, order, frame)
@@ -516,12 +532,14 @@ class Parser:
 
     def _frame_bound(self):
         """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | n PRECEDING |
-        n FOLLOWING → row offset (None = unbounded, 0 = current row)."""
+        n FOLLOWING → row offset (int, 0 = current row) or the markers
+        "unb_prec"/"unb_foll" so the caller can validate direction."""
         if self._try_word("UNBOUNDED"):
-            if not (self._try_word("PRECEDING")
-                    or self._try_word("FOLLOWING")):
-                raise SyntaxError("expected PRECEDING/FOLLOWING")
-            return None
+            if self._try_word("PRECEDING"):
+                return "unb_prec"
+            if self._try_word("FOLLOWING"):
+                return "unb_foll"
+            raise SyntaxError("expected PRECEDING/FOLLOWING")
         if self._try_word("CURRENT"):
             if not self._try_word("ROW"):
                 raise SyntaxError("expected CURRENT ROW")

@@ -550,6 +550,10 @@ class Planner:
                     pre.append((f"{tmp}_v", Lit(1)))
                     vcol = f"{tmp}_v"
                 else:
+                    if not w.func.args:
+                        raise SyntaxError(
+                            f"window function {name.upper()}() needs an "
+                            f"argument (or use COUNT(*))")
                     vex = self._expr(w.func.args[0], scope, None, None)
                     if isinstance(vex, ColRef):
                         vcol = vex.name

@@ -161,3 +161,46 @@ def test_relational_agg_window_decimal_and_int(mesh8):
     got = out.to_pandas()
     exp = df.groupby("g")["v"].transform("sum")
     assert got["s"].tolist() == exp.tolist()
+
+
+def test_minmax_window_exact_int64_and_datetime(mesh8):
+    """MIN/MAX windows must be exact for values float64 can't hold:
+    int64 ids above 2^53 and ns timestamps (review finding: the old
+    kernel routed min/max through float64)."""
+    import bodo_tpu.pandas_api as bd
+    base = (1 << 60) + 12345
+    df = pd.DataFrame({
+        "g": [0, 0, 0, 1, 1],
+        "big": np.array([base + 3, base + 1, base + 7,
+                         base + 5, base + 2], dtype=np.int64),
+        "ts": pd.to_datetime(
+            np.array([1_700_000_000_000_000_003, 1_700_000_000_000_000_001,
+                      1_700_000_000_000_000_007, 1_700_000_000_000_000_005,
+                      1_700_000_000_000_000_002], dtype=np.int64)),
+    })
+    f = bd.from_pandas(df)
+    got_big = f.groupby("g").big.transform("min").to_pandas()
+    exp_big = df.groupby("g").big.transform("min")
+    np.testing.assert_array_equal(got_big.to_numpy(), exp_big.to_numpy())
+    got_ts = f.groupby("g").ts.transform("max").to_pandas()
+    exp_ts = df.groupby("g").ts.transform("max")
+    np.testing.assert_array_equal(got_ts.to_numpy(), exp_ts.to_numpy())
+
+
+def test_invalid_frames_rejected(mesh8):
+    """Reversed/forward-shorthand frames are SQL errors, not silent
+    empty frames (review finding)."""
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.sql import BodoSQLContext
+    df = pd.DataFrame({"g": [0, 1], "o": [1, 2], "v": [1.0, 2.0]})
+    ctx = BodoSQLContext({"t": bd.from_pandas(df)})
+    for q in [
+        "SELECT SUM(v) OVER (ORDER BY o ROWS 2 FOLLOWING) AS s FROM t",
+        "SELECT SUM(v) OVER (ORDER BY o ROWS BETWEEN 1 FOLLOWING AND "
+        "2 PRECEDING) AS s FROM t",
+        "SELECT SUM(v) OVER (ORDER BY o ROWS BETWEEN 3 PRECEDING AND "
+        "UNBOUNDED PRECEDING) AS s FROM t",
+        "SELECT SUM() OVER (PARTITION BY g) AS s FROM t",
+    ]:
+        with pytest.raises(SyntaxError):
+            ctx.sql(q)

@@ -446,10 +446,17 @@ def agg_window_local(key_arrays, order_arrays, val_arrays, count,
             if jnp.issubdtype(ds.dtype, jnp.floating):
                 dom = ds.astype(jnp.float64)
                 sentinel = -jnp.inf if want_max else jnp.inf
+            elif ds.dtype == jnp.uint64:
+                # int64 would wrap values >= 2^63 negative — stay unsigned
+                dom = ds
+                ii = jnp.iinfo(jnp.uint64)
+                sentinel = jnp.asarray(ii.min if want_max else ii.max,
+                                       dtype=jnp.uint64)
             else:
                 dom = ds.astype(jnp.int64)
                 ii = jnp.iinfo(jnp.int64)
-                sentinel = ii.min if want_max else ii.max
+                sentinel = jnp.asarray(ii.min if want_max else ii.max,
+                                       dtype=jnp.int64)
             xm = jnp.where(oks, dom, sentinel)
             table_cache[key] = (
                 _minmax_sparse_table(xm, n_levels, want_max), sentinel)

@@ -339,6 +339,37 @@ class Join(Node):
                 self.suffixes, self.null_equal)
 
 
+class NonEquiJoin(Node):
+    """Join under an arbitrary predicate with no equality conjunct
+    (tiled nested-loop / interval join; reference:
+    bodo/libs/_nested_loop_join_impl.cpp, _interval_join.cpp). Column
+    names must already be disjoint (the SQL planner's qualified names
+    are); the predicate references the combined schema."""
+
+    def __init__(self, left: Node, right: Node, pred, how: str = "inner"):
+        assert how in ("inner", "left"), how
+        self.children = [left, right]
+        self.pred = pred
+        self.how = how
+        overlap = set(left.schema) & set(right.schema)
+        assert not overlap, f"NonEquiJoin needs disjoint names: {overlap}"
+        sch: Schema = dict(left.schema)
+        sch.update(right.schema)
+        self.schema = sch
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def key(self):
+        return ("nejoin", self.left.key(), self.right.key(),
+                self.pred.key(), self.how)
+
+
 class Sort(Node):
     def __init__(self, child: Node, by, ascending, na_last: bool = True):
         self.children = [child]

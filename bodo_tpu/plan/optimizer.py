@@ -98,6 +98,18 @@ def push_filters(node: L.Node) -> L.Node:
                 return L.Join(push_filters(child.left), nr, child.left_on,
                               child.right_on, child.how, child.suffixes,
                               child.null_equal)
+        if isinstance(child, L.NonEquiJoin):
+            # names are disjoint by construction; push into a preserved
+            # side (inner: both; left: probe side only)
+            cols = expr_columns(pred)
+            if cols <= set(child.left.schema):
+                nl = push_filters(L.Filter(child.left, pred))
+                return L.NonEquiJoin(nl, push_filters(child.right),
+                                     child.pred, child.how)
+            if cols <= set(child.right.schema) and child.how == "inner":
+                nr = push_filters(L.Filter(child.right, pred))
+                return L.NonEquiJoin(push_filters(child.left), nr,
+                                     child.pred, child.how)
         return L.Filter(push_filters(child), pred)
     # recurse
     return _rebuild(node, [push_filters(c) for c in node.children])
@@ -170,6 +182,15 @@ def prune_columns(node: L.Node, required: Optional[Set[str]]) -> L.Node:
                       prune_columns(node.right, rneed),
                       node.left_on, node.right_on, node.how, node.suffixes,
                       node.null_equal)
+    if isinstance(node, L.NonEquiJoin):
+        lneed = rneed = None
+        if required is not None:
+            need = set(required) | expr_columns(node.pred)
+            lneed = {n for n in node.left.schema if n in need}
+            rneed = {n for n in node.right.schema if n in need}
+        return L.NonEquiJoin(prune_columns(node.left, lneed),
+                             prune_columns(node.right, rneed),
+                             node.pred, node.how)
     if isinstance(node, L.Sort):
         need = None if required is None else \
             (set(required) | set(node.by))

@@ -114,6 +114,18 @@ def _exec_inner(node: L.Node) -> Table:
         return R.join_tables(left, right, node.left_on, node.right_on,
                              node.how, node.suffixes,
                              null_equal=node.null_equal)
+    if isinstance(node, L.NonEquiJoin):
+        from bodo_tpu.ops import nonequi
+        left = _exec(node.left).gather()
+        right = _exec(node.right).gather()
+        iv = nonequi.match_interval_pattern(
+            node.pred, set(node.left.schema), set(node.right.schema))
+        if iv is not None:
+            out = nonequi.nl_join_interval(left, right, node.pred,
+                                           iv[0], iv[1], node.how)
+        else:
+            out = nonequi.nl_join_rep(left, right, node.pred, node.how)
+        return _maybe_shard(out)
     if isinstance(node, L.Union):
         return _maybe_shard(R.concat_tables(
             [_exec(c) for c in node.children]))

@@ -186,7 +186,8 @@ class Planner:
             if residual is not None and how in ("left", "right"):
                 # outer-join ON residuals restrict the null-padded side
                 # BEFORE the join (a post-filter would turn preserved rows
-                # into dropped ones — the Q13 pattern)
+                # into dropped ones — the Q13 pattern); residuals touching
+                # BOTH sides fall through to the nested-loop join below
                 from bodo_tpu.plan.expr import expr_columns
                 cols = expr_columns(residual)
                 inner_side = set(rs.by_qual.values()) if how == "left" \
@@ -197,15 +198,27 @@ class Planner:
                     else:
                         lp = L.Filter(lp, residual)
                     residual = None
+                elif eq_l:
+                    raise NotImplementedError(
+                        "outer-join ON mixing equality keys with a "
+                        "residual touching the preserved side")
+            if not eq_l:
+                if residual is None:
+                    raise NotImplementedError(
+                        f"{how} join with no usable ON condition")
+                # pure non-equi condition → tiled nested-loop /
+                # interval join (reference:
+                # bodo/libs/_nested_loop_join_impl.cpp, _interval_join)
+                if how == "inner":
+                    plan = L.NonEquiJoin(lp, rp, residual, "inner")
+                elif how == "left":
+                    plan = L.NonEquiJoin(lp, rp, residual, "left")
+                elif how == "right":
+                    plan = L.NonEquiJoin(rp, lp, residual, "left")
                 else:
                     raise NotImplementedError(
-                        "outer-join ON condition touching the preserved side")
-            if not eq_l:
-                if how != "inner":
-                    # cross+filter lowering has inner semantics only
-                    raise NotImplementedError(
-                        f"non-equi {how} join needs an equality conjunct")
-                plan = self._cross_join(lp, rp)
+                        "FULL JOIN with a pure non-equi condition")
+                residual = None
             else:
                 if how == "right":
                     plan = L.Join(rp, lp, eq_r, eq_l, "left", null_equal=False)

@@ -145,7 +145,6 @@ def jit(fn: Optional[Callable] = None, *, distributed=None, replicated=None,
         # dataframe path → trace through the lazy frontend
         import bodo_tpu.pandas_api as bd
         from bodo_tpu.pandas_api.frame import BodoDataFrame
-        from bodo_tpu.pandas_api.groupby import _IndexedAggResult
         from bodo_tpu.pandas_api.series import BodoSeries
 
         def lift(v):
@@ -156,7 +155,7 @@ def jit(fn: Optional[Callable] = None, *, distributed=None, replicated=None,
         def lower(v):
             if isinstance(v, BodoDataFrame):
                 return v.to_pandas()
-            if isinstance(v, (BodoSeries, _IndexedAggResult)):
+            if isinstance(v, BodoSeries):
                 return v.to_pandas()
             if isinstance(v, tuple):
                 return tuple(lower(x) for x in v)

@@ -103,10 +103,10 @@ def nl_join_rep(left: Table, right: Table, pred: Expr,
     right = R.shrink_to_fit(right)
     B = max(right.capacity, 1)
     T = _pow2(max(min(left.capacity, max(_GRID_BUDGET // B, 1)), 1))
-    sig = (tuple((n, c.dtype.name, c.valid is not None)
-                 for n, c in left.columns.items()),
-           tuple((n, c.dtype.name, c.valid is not None)
-                 for n, c in right.columns.items()))
+    # _sig fingerprints dictionaries too: string predicates bake the
+    # host dictionary LUT into the trace, so same-shaped tables with
+    # different dictionaries must not share a cached kernel
+    sig = (R._sig(left), R._sig(right))
     schema = {n: c.dtype for n, c in left.columns.items()}
     schema.update({n: c.dtype for n, c in right.columns.items()})
     dicts = {n: c.dictionary for n, c in left.columns.items()

@@ -21,53 +21,92 @@ from bodo_tpu.utils.logging import warn_fallback
 
 
 class BodoDataFrame:
-    def __init__(self, plan: L.Node):
+    def __init__(self, plan: L.Node, index=None):
         object.__setattr__(self, "_plan", plan)
+        # index: [(plan_column, display_name)] — the engine's index is an
+        # ordinary device column threaded through the plan; only the
+        # final to_pandas() turns it into a pandas index (reference
+        # analogue: bodo/hiframes/pd_index_ext.py index objects, redesigned
+        # as index-as-column so kernels never special-case it)
+        object.__setattr__(self, "_index", list(index) if index else [])
         # plans this frame has pointed at (mutated by __setitem__), with the
         # columns overwritten since: a Series captured from an older plan
         # stays usable as long as none of its referenced columns changed
         object.__setattr__(self, "_history", {id(plan): set()})
 
+    def _index_cols(self) -> List[str]:
+        return [c for c, _ in self._index]
+
+    def _data_cols(self) -> List[str]:
+        idx = set(self._index_cols())
+        return [n for n in self._plan.schema if n not in idx]
+
     # ---- schema ----------------------------------------------------------
     @property
     def columns(self) -> pd.Index:
-        return pd.Index(list(self._plan.schema))
+        return pd.Index(self._data_cols())
 
     @property
     def dtypes(self) -> pd.Series:
         out = {}
-        for n, t in self._plan.schema.items():
+        for n in self._data_cols():
+            t = self._plan.schema[n]
             out[n] = np.dtype("O") if t is dt.STRING else np.dtype(t.np_dtype)
         return pd.Series(out)
 
     @property
     def shape(self):
-        return (len(self), len(self._plan.schema))
+        return (len(self), len(self._data_cols()))
 
     # ---- selection -------------------------------------------------------
     def __getitem__(self, key):
         if isinstance(key, str):
-            if key not in self._plan.schema:
+            if key not in self._plan.schema or \
+                    key in set(self._index_cols()):
                 raise KeyError(key)
-            return BodoSeries(self._plan, ColRef(key), key)
+            return BodoSeries(self._plan, ColRef(key), key,
+                              index=self._index)
         if isinstance(key, list):
             exprs = [(n, ColRef(n)) for n in key]
-            return BodoDataFrame(L.Projection(self._plan, exprs))
+            exprs += [(c, ColRef(c)) for c in self._index_cols()
+                      if c not in key]
+            return BodoDataFrame(L.Projection(self._plan, exprs),
+                                 index=self._index)
         if isinstance(key, BodoSeries):
             try:
                 e = self._expr_of(key)
             except ValueError:
                 raise ValueError("boolean mask must come from this frame")
-            return BodoDataFrame(L.Filter(self._plan, e))
+            return BodoDataFrame(L.Filter(self._plan, e),
+                                 index=self._index)
         raise TypeError(f"unsupported key: {key!r}")
 
     def __setitem__(self, name: str, value):
+        if name in set(self._index_cols()):
+            # pandas creates a data column distinct from the index; move
+            # the index to a reserved backing column first so the assign
+            # can't corrupt it
+            exprs = []
+            new_index = []
+            for c, disp in self._index:
+                if c == name:
+                    exprs.append((f"__idx_{c}", ColRef(c)))
+                    new_index.append((f"__idx_{c}", disp))
+                else:
+                    exprs.append((c, ColRef(c)))
+                    new_index.append((c, disp))
+            exprs += [(n, ColRef(n)) for n in self._data_cols()]
+            object.__setattr__(self, "_plan",
+                               L.Projection(self._plan, exprs))
+            object.__setattr__(self, "_index", new_index)
+            hist = object.__getattribute__(self, "_history")
+            hist[id(self._plan)] = set()
         if isinstance(value, (list, np.ndarray, pd.Series)) and \
                 not isinstance(value, BodoSeries):
             # positional data needs host alignment — fallback semantics
             warn_fallback("DataFrame.__setitem__", "raw array value")
-            pdf = self.to_pandas()
-            pdf[name] = value
+            pdf = self._execute().to_pandas()  # raw cols incl. index
+            pdf[name] = np.asarray(value)
             plan = L.FromPandas(pdf)
         else:
             plan = None
@@ -83,8 +122,10 @@ class BodoDataFrame:
 
     def __getattr__(self, name):
         plan = object.__getattribute__(self, "_plan")
-        if name in plan.schema:
-            return BodoSeries(plan, ColRef(name), name)
+        index = object.__getattribute__(self, "_index")
+        if name in plan.schema and \
+                name not in {c for c, _ in index}:
+            return BodoSeries(plan, ColRef(name), name, index=index)
         if not name.startswith("_") and hasattr(pd.DataFrame, name):
             warn_fallback(f"DataFrame.{name}", "not yet lazy")
             attr = getattr(self.to_pandas(), name)
@@ -180,7 +221,7 @@ class BodoDataFrame:
             exprs.append((n, e))
             plan = L.Projection(plan, exprs)
             allowed.add(id(plan))
-        return BodoDataFrame(plan)
+        return BodoDataFrame(plan, index=self._index)
 
     def melt(self, id_vars=None, value_vars=None, var_name="variable",
              value_name="value") -> "BodoDataFrame":
@@ -315,15 +356,70 @@ class BodoDataFrame:
         asc = [ascending] * len(by) if isinstance(ascending, bool) \
             else list(ascending)
         return BodoDataFrame(L.Sort(self._plan, by, asc,
-                                    na_last=(na_position == "last")))
+                                    na_last=(na_position == "last")),
+                             index=self._index)
 
     def drop_duplicates(self, subset=None) -> "BodoDataFrame":
         subset = [subset] if isinstance(subset, str) else \
             (list(subset) if subset else None)
-        return BodoDataFrame(L.Distinct(self._plan, subset))
+        return BodoDataFrame(L.Distinct(self._plan, subset),
+                             index=self._index)
 
     def head(self, n: int = 5) -> "BodoDataFrame":
-        return BodoDataFrame(L.Limit(self._plan, n))
+        return BodoDataFrame(L.Limit(self._plan, n), index=self._index)
+
+    # ---- index -------------------------------------------------------------
+    def set_index(self, keys, drop: bool = True,
+                  append: bool = False) -> "BodoDataFrame":
+        """Designate column(s) as the index. The data stays a device
+        column in the plan; nothing materializes (reference analogue:
+        bodo/hiframes/pd_index_ext.py set_index)."""
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        for k in keys:
+            if k not in self._plan.schema or k in set(self._index_cols()):
+                raise KeyError(k)
+        if not drop:
+            # keep the column as data too: alias a copy for the index
+            exprs = [(n, ColRef(n)) for n in self._plan.schema]
+            exprs += [(f"__idx_{k}", ColRef(k)) for k in keys]
+            index = (self._index if append else []) + \
+                [(f"__idx_{k}", k) for k in keys]
+            return BodoDataFrame(L.Projection(self._plan, exprs),
+                                 index=index)
+        if self._index and not append:
+            # pandas drops the previous index entirely — project it away
+            # so it doesn't resurface as a data column
+            exprs = [(n, ColRef(n)) for n in self._data_cols()]
+            return BodoDataFrame(L.Projection(self._plan, exprs),
+                                 index=[(k, k) for k in keys])
+        index = (self._index if append else []) + [(k, k) for k in keys]
+        return BodoDataFrame(self._plan, index=index)
+
+    def reset_index(self, drop: bool = False) -> "BodoDataFrame":
+        if not self._index:
+            return BodoDataFrame(self._plan)
+        if drop:
+            exprs = [(n, ColRef(n)) for n in self._data_cols()]
+            return BodoDataFrame(L.Projection(self._plan, exprs))
+        exprs = []
+        for i, (c, disp) in enumerate(self._index):
+            name = disp if disp is not None else (
+                "index" if len(self._index) == 1 else f"level_{i}")
+            exprs.append((name, ColRef(c)))
+        exprs += [(n, ColRef(n)) for n in self._data_cols()]
+        return BodoDataFrame(L.Projection(self._plan, exprs))
+
+    def sort_index(self, ascending: bool = True) -> "BodoDataFrame":
+        if not self._index:
+            return self
+        by = self._index_cols()
+        return BodoDataFrame(
+            L.Sort(self._plan, by, [ascending] * len(by)),
+            index=self._index)
+
+    @property
+    def index(self) -> pd.Index:
+        return self.to_pandas().index
 
     # ---- materialization ---------------------------------------------------
     def _execute(self):
@@ -331,15 +427,21 @@ class BodoDataFrame:
         return execute(self._plan)
 
     def to_pandas(self) -> pd.DataFrame:
-        return self._execute().to_pandas()
+        pdf = self._execute().to_pandas()
+        if not self._index:
+            return pdf
+        icols = self._index_cols()
+        pdf = pdf.set_index(icols)[self._data_cols()]
+        pdf.index.names = [d for _, d in self._index]
+        return pdf
 
     def __len__(self) -> int:
         return self._execute().nrows
 
     def __repr__(self) -> str:  # pragma: no cover
-        head = BodoDataFrame(L.Limit(self._plan, 10)).to_pandas()
+        head = self.head(10).to_pandas()
         n = len(self)
-        return repr(head) + f"\n[{n} rows x {len(self._plan.schema)} columns]"
+        return repr(head) + f"\n[{n} rows x {len(self._data_cols())} columns]"
 
     def __setattr__(self, name, value):  # guard accidental attr writes
         if name.startswith("_"):

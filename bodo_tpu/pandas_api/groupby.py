@@ -69,11 +69,18 @@ class BodoGroupBy:
     def _run(self, aggs):
         from bodo_tpu.pandas_api.frame import BodoDataFrame
         node = L.Aggregate(self._df._plan, self._keys, aggs)
-        out = BodoDataFrame(node)
         single = aggs[0][2] if (self._single and len(aggs) == 1) else None
         if self._as_index:
-            return _IndexedAggResult(out, self._keys, single)
-        return out
+            # key columns become the result's index — still ordinary
+            # device columns in the plan, converted only at to_pandas()
+            index = [(k, k) for k in self._keys]
+            if single is not None:
+                from bodo_tpu.plan.expr import ColRef
+                from bodo_tpu.pandas_api.series import BodoSeries
+                return BodoSeries(node, ColRef(single), single,
+                                  index=index)
+            return BodoDataFrame(node, index=index)
+        return BodoDataFrame(node)
 
     def _simple(self, op):
         if op == "size":
@@ -197,8 +204,16 @@ class BodoGroupBy:
 
     def size(self):
         res = self._run([(self._keys[0], "size", "size")])
-        if isinstance(res, _IndexedAggResult):
-            return res.to_pandas()["size"]
+        if self._as_index:
+            from bodo_tpu.plan.expr import ColRef
+            from bodo_tpu.pandas_api.series import BodoSeries
+            # pandas: SeriesGroupBy.size keeps the column name,
+            # DataFrameGroupBy.size is unnamed
+            name = self._selection[0] if self._single else None
+            if not isinstance(res, BodoSeries):
+                res = BodoSeries(res._plan, ColRef("size"), "size",
+                                 index=res._index)
+            return res.to_pandas().rename(name)
         return res
 
     def __getattr__(self, name):
@@ -213,47 +228,6 @@ class BodoGroupBy:
                 else self._selection
             gb = gb[sel]
         return getattr(gb, name)
-
-
-class _IndexedAggResult:
-    """as_index=True result: behaves like the frame but sets the key index
-    on materialization (our Tables are always index-free). With a single
-    selected column it materializes as a pandas Series."""
-
-    def __init__(self, frame, keys, single_col: Optional[str] = None):
-        self._frame = frame
-        self._keys = keys
-        self._single = single_col
-
-    def to_pandas(self):
-        df = self._frame.to_pandas().set_index(self._keys)
-        if self._single is not None:
-            return df[self._single]
-        return df
-
-    def reset_index(self):
-        return self._frame
-
-    def __array__(self, dtype=None, copy=None):
-        import numpy as np
-        return np.asarray(self.to_pandas(), dtype=dtype)
-
-    def __getitem__(self, key):
-        return self.to_pandas()[key]
-
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        if name in ("to_numpy", "sort_index", "sort_values", "index",
-                    "values", "loc", "iloc", "equals"):
-            return getattr(self.to_pandas(), name)
-        return getattr(self._frame, name)
-
-    def __len__(self):
-        return len(self._frame)
-
-    def __repr__(self):  # pragma: no cover
-        return repr(self.to_pandas().head(10))
 
 
 def _numericish(t) -> bool:

@@ -52,9 +52,13 @@ def _ddof_op(op: str, ddof: int) -> str:
 
 
 class BodoSeries:
-    def __init__(self, plan: L.Node, expr: Expr, name: str = None):
+    def __init__(self, plan: L.Node, expr: Expr, name: str = None,
+                 index=None):
         self._plan = plan
         self._expr = expr
+        # [(plan_column, display_name)] — same index-as-column threading
+        # as BodoDataFrame (set by indexed frames / groupby as_index)
+        self._index = list(index) if index else []
         self._name = name if name is not None else (
             expr.name if isinstance(expr, ColRef) else None)
 
@@ -74,7 +78,8 @@ class BodoSeries:
 
     # ---- expression building ----------------------------------------------
     def _wrap(self, expr: Expr, name=None) -> "BodoSeries":
-        return BodoSeries(self._plan, expr, name or self._name)
+        return BodoSeries(self._plan, expr, name or self._name,
+                          index=self._index)
 
     def _coerce(self, other):
         """Other operand → Expr (string literals become predicates at the
@@ -271,19 +276,56 @@ class BodoSeries:
     # ---- materialization ------------------------------------------------
     def _as_projection(self, name: Optional[str] = None) -> L.Node:
         name = name or self._name or "_val"
-        return L.Projection(self._plan, [(name, self._expr)])
+        exprs = [(name, self._expr)]
+        exprs += [(c, ColRef(c)) for c, _ in self._index if c != name]
+        return L.Projection(self._plan, exprs)
+
+    def _finish(self, t, name: str) -> pd.Series:
+        pdf = t.to_pandas()
+        if self._index:
+            icols = [c for c, _ in self._index if c != name]
+            if icols:
+                pdf = pdf.set_index(icols)
+                pdf.index.names = [d for (c, d) in self._index if c != name]
+        return pdf[name].rename(self._name)
 
     def to_pandas(self) -> pd.Series:
         from bodo_tpu.plan.physical import execute
         name = self._name or "_val"
-        t = execute(self._as_projection(name))
-        return t.to_pandas()[name].rename(self._name)
+        return self._finish(execute(self._as_projection(name)), name)
 
     def head(self, n: int = 5) -> pd.Series:
         from bodo_tpu.plan.physical import execute
         name = self._name or "_val"
-        t = execute(L.Limit(self._as_projection(name), n))
-        return t.to_pandas()[name].rename(self._name)
+        return self._finish(execute(L.Limit(self._as_projection(name), n)),
+                            name)
+
+    def reset_index(self, drop: bool = False):
+        if drop or not self._index:
+            return BodoSeries(self._plan, self._expr, self._name)
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        name = self._name or "_val"
+        exprs = []
+        for i, (c, disp) in enumerate(self._index):
+            out = disp if disp is not None else (
+                "index" if len(self._index) == 1 else f"level_{i}")
+            exprs.append((out, ColRef(c)))
+        exprs.append((name, self._expr))
+        return BodoDataFrame(L.Projection(self._plan, exprs))
+
+    def sort_index(self, ascending: bool = True) -> "BodoSeries":
+        if not self._index:
+            return self
+        by = [c for c, _ in self._index]
+        node = L.Sort(self._plan, by, [ascending] * len(by))
+        return BodoSeries(node, self._expr, self._name, index=self._index)
+
+    @property
+    def index(self) -> pd.Index:
+        return self.to_pandas().index
+
+    def __array__(self, dtype=None, copy=None):
+        return np.asarray(self.to_pandas(), dtype=dtype)
 
     def __len__(self):
         from bodo_tpu.plan.physical import execute

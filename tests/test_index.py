@@ -1,0 +1,195 @@
+"""Index semantics: index-as-column threading through set_index /
+reset_index / sort_index / filters / sorts / groupby(as_index=True),
+round-tripped by to_pandas (reference: bodo/hiframes/pd_index_ext.py,
+pd_multi_index_ext.py — redesigned as a designated device column, so no
+kernel special-cases the index and nothing materializes early)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bodo_tpu.pandas_api as bd
+
+
+def _df(n=200, seed=0):
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": r.integers(0, 8, n),
+        "u": np.arange(n) * 3 + 1,
+        "v": r.normal(size=n),
+        "c": r.choice(["x", "yy", "zzz"], n),
+    })
+
+
+def test_set_index_roundtrip(mesh8):
+    df = _df()
+    got = bd.from_pandas(df).set_index("u").to_pandas()
+    exp = df.set_index("u")
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_set_index_preserved_through_filter_sort(mesh8):
+    df = _df()
+    b = bd.from_pandas(df).set_index("u")
+    got = b[b["v"] > 0].sort_values("v").to_pandas()
+    exp = df.set_index("u")
+    exp = exp[exp["v"] > 0].sort_values("v")
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_reset_index(mesh8):
+    df = _df()
+    b = bd.from_pandas(df).set_index("u")
+    got = b.reset_index().to_pandas()
+    exp = df.set_index("u").reset_index()
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False)
+    got_d = b.reset_index(drop=True).to_pandas()
+    exp_d = df.set_index("u").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got_d, exp_d, check_dtype=False)
+
+
+def test_sort_index(mesh8):
+    df = _df()
+    b = bd.from_pandas(df).set_index("u").sort_values("v")
+    got = b.sort_index().to_pandas()
+    exp = df.set_index("u").sort_values("v").sort_index()
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_string_index(mesh8):
+    df = _df(50)
+    got = bd.from_pandas(df).set_index("c").sort_values("u").to_pandas()
+    exp = df.set_index("c").sort_values("u")
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_multi_index(mesh8):
+    df = _df(100)
+    got = (bd.from_pandas(df).set_index(["k", "c"]).sort_values("u")
+           .to_pandas())
+    exp = df.set_index(["k", "c"]).sort_values("u")
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_groupby_as_index_frame(mesh8):
+    df = _df()
+    got = bd.from_pandas(df).groupby("k").agg(
+        v_sum=("v", "sum"), v_mean=("v", "mean")).to_pandas()
+    exp = df.groupby("k").agg(v_sum=("v", "sum"), v_mean=("v", "mean"))
+    pd.testing.assert_frame_equal(got.sort_index(), exp.sort_index(),
+                                  check_dtype=False)
+
+
+def test_groupby_as_index_series(mesh8):
+    df = _df()
+    got = bd.from_pandas(df).groupby("k")["v"].sum().to_pandas()
+    exp = df.groupby("k")["v"].sum()
+    pd.testing.assert_series_equal(got.sort_index(), exp.sort_index(),
+                                   check_dtype=False)
+
+
+def test_groupby_as_index_multikey(mesh8):
+    df = _df()
+    got = bd.from_pandas(df).groupby(["k", "c"]).agg(
+        s=("v", "sum")).to_pandas()
+    exp = df.groupby(["k", "c"]).agg(s=("v", "sum"))
+    pd.testing.assert_frame_equal(got.sort_index(), exp.sort_index(),
+                                  check_dtype=False)
+
+
+def test_groupby_result_reset_index(mesh8):
+    df = _df()
+    got = (bd.from_pandas(df).groupby("k").agg(s=("v", "sum"))
+           .reset_index().to_pandas())
+    exp = df.groupby("k").agg(s=("v", "sum")).reset_index()
+    pd.testing.assert_frame_equal(
+        got.sort_values("k").reset_index(drop=True),
+        exp.sort_values("k").reset_index(drop=True), check_dtype=False)
+
+
+def test_groupby_series_sort_index_and_ops(mesh8):
+    df = _df()
+    s = bd.from_pandas(df).groupby("k")["v"].mean()
+    got = (s * 2).sort_index().to_pandas()
+    exp = (df.groupby("k")["v"].mean() * 2).sort_index()
+    pd.testing.assert_series_equal(got, exp, check_dtype=False)
+
+
+def test_column_selection_keeps_index(mesh8):
+    df = _df()
+    b = bd.from_pandas(df).set_index("u")
+    got = b[["v", "k"]].to_pandas()
+    exp = df.set_index("u")[["v", "k"]]
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    got_s = b["v"].to_pandas()
+    exp_s = df.set_index("u")["v"]
+    pd.testing.assert_series_equal(got_s, exp_s, check_dtype=False)
+
+
+def test_index_excluded_from_columns(mesh8):
+    b = bd.from_pandas(_df()).set_index("u")
+    assert "u" not in list(b.columns)
+    with pytest.raises(KeyError):
+        b["u"]
+
+
+def test_head_keeps_index(mesh8):
+    df = _df()
+    got = bd.from_pandas(df).set_index("u").head(7).to_pandas()
+    exp = df.set_index("u").head(7)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_series_index_property(mesh8):
+    df = _df(40)
+    b = bd.from_pandas(df).set_index("u")
+    assert list(b["v"].index) == list(df.set_index("u")["v"].index)
+
+
+def test_chained_set_index_drops_previous(mesh8):
+    df = _df(60)
+    got = bd.from_pandas(df).set_index("u").set_index("k").to_pandas()
+    exp = df.set_index("u").set_index("k")
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False)
+
+
+def test_set_index_drop_false(mesh8):
+    df = _df(60)
+    got = bd.from_pandas(df).set_index("u", drop=False).to_pandas()
+    exp = df.set_index("u", drop=False)
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False)
+
+
+def test_assign_to_index_name_keeps_index(mesh8):
+    df = _df(60)
+    b = bd.from_pandas(df).set_index("u")
+    b["u"] = b["v"] * 0 + 7.0
+    got = b.to_pandas()
+    exp = df.set_index("u")
+    exp["u"] = 7.0
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False)
+
+
+def test_attr_access_matches_getitem(mesh8):
+    df = _df(60)
+    b = bd.from_pandas(df).set_index("u")
+    pd.testing.assert_series_equal(b.v.to_pandas(), b["v"].to_pandas(),
+                                   check_dtype=False)
+    with pytest.raises(AttributeError):
+        b.u  # index column hidden on the attribute path too
+
+
+def test_groupby_size_naming(mesh8):
+    df = _df(60)
+    got = bd.from_pandas(df).groupby("k")["v"].size()
+    exp = df.groupby("k")["v"].size()
+    pd.testing.assert_series_equal(got.sort_index(), exp.sort_index(),
+                                   check_dtype=False)
+    got_f = bd.from_pandas(df).groupby("k").size()
+    exp_f = df.groupby("k").size()
+    pd.testing.assert_series_equal(got_f.sort_index(), exp_f.sort_index(),
+                                   check_dtype=False)

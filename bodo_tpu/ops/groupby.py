@@ -42,6 +42,12 @@ from bodo_tpu.ops import sort_encoding as SE
 # composite "chan_m2" (exact delta-form Chan combine) which reads the two
 # preceding columns (count, sum) — the triple MUST stay in this order.
 _VAR_PARTS = ["count", "sum64", "m2"]
+# skew/kurt partials extend the stable-moments triple with the centered
+# third/fourth moments; their combines are the exact delta-form Chan
+# transforms (see chan_m3/chan_m4 in _groupby_local_impl) which read the
+# preceding columns — the order here is load-bearing.
+_SKEW_PARTS = ["count", "sum64", "m2", "m3"]
+_KURT_PARTS = ["count", "sum64", "m2", "m3", "m4"]
 DECOMPOSE: Dict[str, List[str]] = {
     "sum": ["sum"],
     "sumnull": ["sumnull"],
@@ -57,9 +63,11 @@ DECOMPOSE: Dict[str, List[str]] = {
     "std": _VAR_PARTS,
     "var0": _VAR_PARTS,
     "std0": _VAR_PARTS,
+    "skew": _SKEW_PARTS,
+    "kurt": _KURT_PARTS,
 }
 COMBINE_OF = {"sum": "sum", "sumnull": "sumnull", "sum64": "sum",
-              "m2": "chan_m2",
+              "m2": "chan_m2", "m3": "chan_m3", "m4": "chan_m4",
               "count": "sum", "size": "sum",
               "min": "min", "max": "max", "first": "first", "last": "last",
               "prod": "prod"}
@@ -71,7 +79,9 @@ def agg_dtype(op: str, src) -> "object":
     from bodo_tpu.table import dtypes as dt
     if op in ("count", "size", "nunique"):
         return dt.INT64
-    if op in ("min", "max", "first", "last"):
+    if op.startswith(("listagg", "listaggd")):
+        return dt.STRING
+    if op in ("min", "max", "first", "last", "mode"):
         return src
     if dt.is_decimal(src):
         if op == "prod":
@@ -93,7 +103,8 @@ def agg_descale_factor(op: str, src) -> float:
     if not dt.is_decimal(src):
         return 1.0
     if op in ("sum", "sumnull", "prod", "min", "max", "first", "last",
-              "count", "size", "nunique"):
+              "count", "size", "nunique", "skew", "kurt", "mode"):
+        # skew/kurt are standardized (scale cancels); mode keeps the dtype
         return 1.0
     if op in ("var", "var0"):
         return 10.0 ** (2 * src.scale)
@@ -104,7 +115,7 @@ def result_dtype(op: str, dtype):
     d = jnp.dtype(dtype)
     if op in ("count", "size", "nunique"):
         return jnp.dtype(jnp.int64)
-    if op in ("sum64", "m2"):
+    if op in ("sum64", "m2", "m3", "m4", "skew", "kurt"):
         return jnp.dtype(jnp.float64)  # stable moments always accumulate f64
     if op in ("mean", "var", "std", "var0", "std0", "median") or \
             op.startswith(("quantile_", "q:")):
@@ -229,6 +240,25 @@ def _segment_agg(op: str, v_s, valid_s, seg, padmask_s, out_cap: int):
         if op.startswith("std"):
             out = jnp.sqrt(out)
         return out.astype(rdt), None
+    if op in ("m3", "m4", "skew", "kurt"):
+        # centered higher moments, two-pass like m2 (reference:
+        # bodo/libs/groupby/ skew/kurt ftypes)
+        v = v_s.astype(jnp.float64)
+        s = jax.ops.segment_sum(jnp.where(ok, v, 0.0), seg,
+                                num_segments=out_cap)
+        mean = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+        d = jnp.where(ok, v - mean[seg], 0.0)
+        m2 = jax.ops.segment_sum(d * d, seg, num_segments=out_cap)
+        m3 = jax.ops.segment_sum(d * d * d, seg, num_segments=out_cap)
+        if op == "m3":
+            return m3, None
+        if op == "skew":
+            return _skew_from_moments(cnt, m2, m3), None
+        m4 = jax.ops.segment_sum(d * d * d * d, seg,
+                                 num_segments=out_cap)
+        if op == "m4":
+            return m4, None
+        return _kurt_from_moments(cnt, m2, m4), None
     if op == "nunique":
         raise NotImplementedError("nunique handled in groupby_local")
     raise ValueError(f"unknown agg op: {op}")
@@ -239,6 +269,34 @@ def _var_from_m2(m2, cnt, ddof: int = 1):
     cntf = cnt.astype(m2.dtype)
     var = m2 / jnp.maximum(cntf - ddof, 1)
     return jnp.where(cnt > ddof, jnp.maximum(var, 0), jnp.nan)
+
+
+def _skew_from_moments(cnt, m2, m3):
+    """pandas-adjusted (Fisher-Pearson) skew from centered moments:
+    g1·sqrt(n(n−1))/(n−2) with g1 = (M3/n)/(M2/n)^1.5. Matches pandas
+    nanskew: NaN for n<3; 0.0 for zero-variance (constant) groups."""
+    n = cnt.astype(jnp.float64)
+    safe_m2 = jnp.maximum(m2, 1e-300)
+    g1 = (m3 / jnp.maximum(n, 1)) / (safe_m2 / jnp.maximum(n, 1)) ** 1.5
+    adj = jnp.sqrt(n * (n - 1)) / jnp.maximum(n - 2, 1)
+    out = g1 * adj
+    # pandas nanskew: constant groups (m2 == 0) are 0, not NaN
+    out = jnp.where(m2 > 0, out, 0.0)
+    return jnp.where(cnt >= 3, out, jnp.nan)
+
+
+def _kurt_from_moments(cnt, m2, m4):
+    """pandas-adjusted (Fisher, excess) kurtosis from centered moments:
+    [n(n+1)(n−1)·M4/((n−2)(n−3)·M2²)] − 3(n−1)²/((n−2)(n−3)); NaN for
+    n<4 or zero variance."""
+    n = cnt.astype(jnp.float64)
+    safe_m2 = jnp.maximum(m2, 1e-300)
+    den = jnp.maximum((n - 2) * (n - 3), 1)
+    out = n * (n + 1) * (n - 1) * m4 / (den * safe_m2 * safe_m2) \
+        - 3.0 * (n - 1) * (n - 1) / den
+    # pandas nankurt: constant groups (m2 == 0) are 0, not NaN
+    out = jnp.where(m2 > 0, out, 0.0)
+    return jnp.where(cnt >= 4, out, jnp.nan)
 
 
 def _groupby_local_impl(arrays, count, specs: Tuple[str, ...],
@@ -262,6 +320,9 @@ def _groupby_local_impl(arrays, count, specs: Tuple[str, ...],
         if op == "nunique":
             out_vals.append(_nunique(keys, (data, valid), perm, seg,
                                      padmask_s, out_capacity))
+        elif op == "mode":
+            out_vals.append(_mode((data, valid), perm, seg, padmask_s,
+                                  out_capacity))
         elif op.startswith("q:"):  # quantile/median: "q:<float>"
             out_vals.append(_quantile_seg((data, valid), perm, seg,
                                           padmask_s, out_capacity,
@@ -288,6 +349,35 @@ def _groupby_local_impl(arrays, count, specs: Tuple[str, ...],
             m2 = jax.ops.segment_sum(jnp.where(okr, m2_s, 0.0), seg,
                                      num_segments=out_capacity)
             out_vals.append((m2 + cross, None))
+        elif op in ("chan_m3", "chan_m4"):
+            # exact delta-form combine of centered higher moments: with
+            # d_i = mean_i − mean,
+            #   M3 = Σ m3_i + 3 d_i m2_i + n_i d_i³
+            #   M4 = Σ m4_i + 4 d_i m3_i + 6 d_i² m2_i + n_i d_i⁴
+            # reads the preceding partial columns pinned by
+            # _SKEW_PARTS/_KURT_PARTS order (count, sum64, m2[, m3]).
+            back = 3 if op == "chan_m3" else 4
+            n_s = values[i - back][0][perm].astype(jnp.float64)
+            s_s = values[i - back + 1][0][perm].astype(jnp.float64)
+            m2_s = values[i - back + 2][0][perm].astype(jnp.float64)
+            m3_s = (values[i - 1][0][perm].astype(jnp.float64)
+                    if op == "chan_m4" else v_s.astype(jnp.float64))
+            mk_s = v_s.astype(jnp.float64)
+            okr = K.value_ok(mk_s, valid_s, padmask_s)
+            n_tot = jax.ops.segment_sum(jnp.where(okr, n_s, 0.0), seg,
+                                        num_segments=out_capacity)
+            s_tot = jax.ops.segment_sum(jnp.where(okr, s_s, 0.0), seg,
+                                        num_segments=out_capacity)
+            mean = s_tot / jnp.maximum(n_tot, 1.0)
+            d = s_s / jnp.maximum(n_s, 1.0) - mean[seg]
+            if op == "chan_m3":
+                term = mk_s + 3.0 * d * m2_s + n_s * d * d * d
+            else:
+                term = mk_s + 4.0 * d * m3_s + 6.0 * d * d * m2_s \
+                    + n_s * d * d * d * d
+            out_vals.append((jax.ops.segment_sum(
+                jnp.where(okr, term, 0.0), seg,
+                num_segments=out_capacity), None))
         else:
             out_vals.append(_segment_agg(op, v_s, valid_s, seg, padmask_s,
                                          out_capacity))
@@ -373,6 +463,46 @@ def _quantile_seg(value, perm, seg, padmask_s, out_cap: int, q: float):
     v_hi = s_val[jnp.clip(start + hi, 0, cap - 1)]
     out = v_lo + (v_hi - v_lo) * frac
     return jnp.where(cnt > 0, out, jnp.nan), None
+
+
+def _mode(value, perm, seg, padmask_s, out_cap: int):
+    """Per-group mode (most frequent value; smallest on ties — the
+    reference's deterministic mode, bodo/libs/groupby/ mode ftype):
+    re-sort by (group, value), run-length the equal-value runs, then a
+    two-stage argmax (max run length per group, then min value among
+    max-length runs)."""
+    data, valid = value
+    cap = data.shape[0]
+    v_s = data[perm]
+    valid_s = valid[perm] if valid is not None else None
+    ok = K.value_ok(v_s, valid_s, padmask_s)
+    enc_v = SE.encode_value(v_s)
+    seg_key = jnp.where(ok, seg, cap).astype(jnp.int64)
+    s_seg, s_enc = lax.sort((seg_key.view(jnp.uint64), enc_v),
+                            num_keys=2, is_stable=False)
+    pos = jnp.arange(cap)
+    okrow = s_seg < jnp.uint64(cap)
+    newrun = (s_seg != jnp.roll(s_seg, 1)) | (s_enc != jnp.roll(s_enc, 1)) \
+        | (pos == 0)
+    run_id = jnp.cumsum(newrun) - 1
+    run_len = jax.ops.segment_sum(okrow.astype(jnp.int64), run_id,
+                                  num_segments=cap)
+    this_len = run_len[run_id]
+    seg_i = jnp.where(okrow, jnp.minimum(s_seg, jnp.uint64(out_cap))
+                      .astype(jnp.int64), out_cap)
+    best_len = jax.ops.segment_max(jnp.where(okrow, this_len, 0), seg_i,
+                                   num_segments=out_cap + 1)[:out_cap]
+    is_best = okrow & (this_len == best_len[jnp.clip(seg_i, 0, out_cap - 1)])
+    big = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    best_enc = jax.ops.segment_min(jnp.where(is_best, s_enc, big), seg_i,
+                                   num_segments=out_cap + 1)[:out_cap]
+    cnt = jax.ops.segment_sum(okrow.astype(jnp.int64), seg_i,
+                              num_segments=out_cap + 1)[:out_cap]
+    has = cnt > 0
+    # exact inverse of the order-preserving encoding — no f64 round-trip
+    out = jnp.where(has, SE.decode_value(best_enc, data.dtype),
+                    jnp.zeros((), data.dtype))
+    return out, has
 
 
 def _nunique(keys, value, perm, seg, padmask_s, out_cap: int):

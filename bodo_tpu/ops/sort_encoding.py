@@ -46,6 +46,25 @@ def encode_value(data, ascending: bool = True):
     return ~enc if not ascending else enc
 
 
+def decode_value(enc, dtype):
+    """Inverse of encode_value (ascending form): uint64 codes back to
+    values of `dtype` — exact for every supported dtype (the encoding is
+    bijective)."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.floating):
+        sign = (enc & _SIGN64) != 0
+        bits = jnp.where(sign, enc ^ _SIGN64, ~enc)
+        if dt == jnp.float32:
+            return (bits >> np.uint64(32)).astype(jnp.uint32) \
+                .view(jnp.float32)
+        return bits.view(jnp.float64)
+    if dt == jnp.bool_:
+        return enc != 0
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        return enc.astype(dt)
+    return (enc ^ _SIGN64).view(jnp.int64).astype(dt)
+
+
 def null_flag(data, valid=None):
     """Boolean null indicator (explicit mask OR float NaN)."""
     null = None

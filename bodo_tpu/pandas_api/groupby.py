@@ -87,7 +87,7 @@ class BodoGroupBy:
             aggs = [(self._keys[0], "size", "size")]
         else:
             aggs = [(c, op, c) for c in self._value_cols()
-                    if op in ("count", "nunique", "first", "last")
+                    if op in ("count", "nunique", "first", "last", "mode")
                     or _numericish(self._df._plan.schema[c])]
         return self._run(aggs)
 
@@ -109,6 +109,9 @@ class BodoGroupBy:
     def nunique(self): return self._simple("nunique")
     def prod(self): return self._simple("prod")
     def median(self): return self._simple("median")
+    def skew(self): return self._simple("skew")
+    def kurt(self): return self._simple("kurt")
+    kurtosis = kurt
 
     def quantile(self, q=0.5):
         if not isinstance(q, (int, float)):

@@ -136,6 +136,14 @@ def _finalize(op: str, cols, orig_dtype):
         out = _var_from_m2(m2, cnt, ddof=ddof)
         return (jnp.sqrt(out) if op.startswith("std")
                 else out).astype(rdt), None
+    if op == "skew":
+        from bodo_tpu.ops.groupby import _skew_from_moments
+        (cnt, _), _s, (m2, _), (m3, _) = cols
+        return _skew_from_moments(cnt, m2, m3), None
+    if op == "kurt":
+        from bodo_tpu.ops.groupby import _kurt_from_moments
+        (cnt, _), _s, (m2, _), _m3, (m4, _) = cols
+        return _kurt_from_moments(cnt, m2, m4), None
     return cols[0]
 
 

@@ -387,6 +387,9 @@ def groupby_agg(t: Table, keys: Sequence[str],
         return op
     aggs = [(c, _norm(op), o) for c, op, o in aggs]
 
+    if any(op.startswith(("listagg", "listaggd")) for _, op, _ in aggs):
+        return _groupby_agg_with_listagg(t, keys, aggs)
+
     local = _as_local(t)
     if local is not None:
         return groupby_agg(local, keys, aggs)
@@ -400,7 +403,7 @@ def groupby_agg(t: Table, keys: Sequence[str],
 
     # cheap host gates first: _key_ranges does a blocking device reduce
     dense_ok = (t.distribution == REP and config.dense_groupby_max_slots > 0
-                and not any(op == "nunique" or op.startswith("q:")
+                and not any(op in ("nunique", "mode") or op.startswith("q:")
                             for _, op, _ in aggs))
     want_ranges = bool(keys) and (
         dense_ok or (config.pack_keys and len(keys) >= 2))
@@ -450,6 +453,49 @@ def groupby_agg(t: Table, keys: Sequence[str],
         src = t.column(cname)
         cols[oname] = _agg_out_col(src, op, vd, vv)
     return shrink_to_fit(Table(cols, nrows, dist, counts))
+
+
+def _groupby_agg_with_listagg(t: Table, keys, aggs) -> Table:
+    """Groupby containing LISTAGG ("listagg[:<sep>]"): the concatenated
+    per-group strings are host objects by construction (string data lives
+    in host dictionaries), so the listagg columns finalize on host after
+    the native aggs run, aligned to the native output's group order
+    (reference: BodoSQL listagg kernel,
+    BodoSQL/bodosql/kernels/listagg.py)."""
+    la = [(c, op, o) for c, op, o in aggs
+          if op.startswith(("listagg", "listaggd"))]
+    rest = [(c, op, o) for c, op, o in aggs
+            if not op.startswith(("listagg", "listaggd"))]
+    # native part (a size placeholder keeps the group keys when listagg
+    # is the only agg)
+    base = rest or [(keys[0], "size", "__la_size")]
+    out = groupby_agg(t, keys, base)
+    gout = out.gather() if out.distribution == ONED else out
+    okeys = gout.to_pandas()[list(keys)]
+    # host finalize: within-group original row order (pandas groupby
+    # preserves it, matching LISTAGG without WITHIN GROUP)
+    src = t.gather() if t.distribution == ONED else t
+    need = list(dict.fromkeys(list(keys) + [c for c, _, _ in la]))
+    pdf = src.select(need).to_pandas()
+    cols: Dict[str, Column] = dict(gout.columns)
+    for c, op, o in la:
+        sep = op.split(":", 1)[1] if ":" in op else ","
+        dedup = op.startswith("listaggd")
+
+        def _cat(v, s=sep, d=dedup):
+            it = dict.fromkeys(v) if d else v
+            return s.join(str(x) for x in it)
+        ser = (pdf.dropna(subset=[c]).groupby(keys, sort=False)[c]
+               .agg(_cat))
+        aligned = okeys.merge(ser.rename(o), left_on=keys,
+                              right_index=True, how="left")[o]
+        vals = aligned.to_numpy(dtype=object)
+        cols[o] = Column.from_numpy(vals, capacity=gout.capacity)
+    if "__la_size" in cols and not any(o == "__la_size" for _, _, o in aggs):
+        del cols["__la_size"]
+    ordered = {o: cols[o] for _, _, o in
+               [(k, None, k) for k in keys] + list(aggs)}
+    return Table(ordered, gout.nrows, REP, None)
 
 
 def _packed_key_table(t: Table, pack, with_valid: bool = True) -> Table:
@@ -1529,6 +1575,27 @@ def reduce_table(t: Table, aggs: Sequence[Tuple[str, str, str]]) -> Dict:
         for c, op, o in qaggs:
             q = 0.5 if op == "median" else float(op[len("quantile_"):])
             out[o] = _reduce_quantile(t, c, q)
+        return out
+
+    # ops with no scalar-partial form (skew/kurt/mode/listagg/nunique)
+    # reduce via a constant-key groupby — one group, same kernels
+    gaggs = [(c, op, o) for c, op, o in aggs
+             if op not in _REDUCE_PARTIALS]
+    if gaggs:
+        aggs = [(c, op, o) for c, op, o in aggs
+                if op in _REDUCE_PARTIALS]
+        out = reduce_table(t, aggs) if aggs else {}
+        zeros = np.zeros((t.capacity,), np.int32)
+        if t.distribution == ONED:
+            kd = jax.device_put(zeros, mesh_mod.row_sharding())
+        else:
+            kd = jnp.asarray(zeros)
+        tk = t.with_columns(dict(t.columns))
+        tk.columns["__one"] = Column(kd, None, dt.INT32, None)
+        g = groupby_agg(tk, ["__one"], gaggs)
+        gp = g.to_pandas()
+        for _, _, o in gaggs:
+            out[o] = gp[o].iloc[0] if len(gp) else None
         return out
 
     specs = []

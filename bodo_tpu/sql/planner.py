@@ -33,7 +33,10 @@ from bodo_tpu.table import dtypes as dt
 
 _AGG_MAP = {"sum": "sumnull", "avg": "mean", "min": "min", "max": "max",
             "count": "count", "stddev": "std", "variance": "var",
-            "var_samp": "var", "stddev_samp": "std"}
+            "var_samp": "var", "stddev_samp": "std",
+            "var_pop": "var0", "stddev_pop": "std0",
+            "median": "median", "mode": "mode",
+            "skew": "skew", "kurtosis": "kurt"}
 
 
 class Scope:
@@ -302,13 +305,24 @@ class Planner:
         def lower_aggs(e):
             """Replace agg Func nodes with placeholder Cols __agg<N>."""
             if isinstance(e, P.Func) and (e.star or e.name in _AGG_MAP or
-                                          e.name == "count"):
+                                          e.name in ("count", "listagg",
+                                                     "string_agg")):
                 if e.star:
                     op, arg = "size", None
                 elif e.name == "count" and e.distinct:
                     op, arg = "nunique", e.args[0]
                 elif e.name == "count":
                     op, arg = "count", e.args[0]
+                elif e.name in ("listagg", "string_agg"):
+                    sep = ","
+                    if len(e.args) == 2:
+                        if not isinstance(e.args[1], P.Str):
+                            raise NotImplementedError(
+                                "LISTAGG separator must be a string "
+                                "literal")
+                        sep = e.args[1].value
+                    kind = "listaggd" if e.distinct else "listagg"
+                    op, arg = f"{kind}:{sep}", e.args[0]
                 else:
                     op, arg = _AGG_MAP[e.name], e.args[0]
                 tmp = f"__agg{len(aggs)}"

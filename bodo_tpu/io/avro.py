@@ -1,0 +1,293 @@
+"""Minimal pure-Python Avro object-container codec.
+
+Iceberg's manifest lists and manifest files are Avro container files
+(reference reads them through pyiceberg/fastavro —
+bodo/io/iceberg/read_metadata.py); neither package exists in this
+environment, so this module implements the small, stable subset of the
+Avro 1.x spec those files use: zigzag-varint primitives, records,
+arrays, maps, unions, enums, fixed, null/deflate codecs. The DECODER is
+schema-driven from the schema embedded in each file, so real Iceberg
+metadata written by other engines parses fully; the ENCODER writes the
+schemas this engine emits.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# primitive codecs
+# ---------------------------------------------------------------------------
+
+def _read_long(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        v = b[0]
+        acc |= (v & 0x7F) << shift
+        if not (v & 0x80):
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    return buf.read(n)
+
+
+def _write_bytes(out: io.BytesIO, b: bytes) -> None:
+    _write_long(out, len(b))
+    out.write(b)
+
+
+# ---------------------------------------------------------------------------
+# schema-driven value codec
+# ---------------------------------------------------------------------------
+
+def _decode(schema, buf: io.BytesIO, names: Dict[str, Any]):
+    if isinstance(schema, list):  # union
+        idx = _read_long(buf)
+        return _decode(schema[idx], buf, names)
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            if schema.get("name"):
+                names[schema["name"]] = schema
+            out = {}
+            for f in schema["fields"]:
+                out[f["name"]] = _decode(f["type"], buf, names)
+            return out
+        if t == "array":
+            items = []
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)  # block byte size (skippable form)
+                    n = -n
+                for _ in range(n):
+                    items.append(_decode(schema["items"], buf, names))
+            return items
+        if t == "map":
+            out = {}
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    k = _read_bytes(buf).decode()
+                    out[k] = _decode(schema["values"], buf, names)
+            return out
+        if t == "enum":
+            if schema.get("name"):
+                names[schema["name"]] = schema
+            return schema["symbols"][_read_long(buf)]
+        if t == "fixed":
+            if schema.get("name"):
+                names[schema["name"]] = schema
+            return buf.read(schema["size"])
+        return _decode(t, buf, names)  # {"type": "string"} wrapper / alias
+    if schema in names:
+        return _decode(names[schema], buf, names)
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) != b"\x00"
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema == "bytes":
+        return _read_bytes(buf)
+    if schema == "string":
+        return _read_bytes(buf).decode()
+    raise ValueError(f"unsupported avro schema: {schema!r}")
+
+
+def _encode(schema, value, out: io.BytesIO, names: Dict[str, Any]) -> None:
+    if isinstance(schema, list):  # union: first matching branch
+        for i, br in enumerate(schema):
+            if _matches(br, value, names):
+                _write_long(out, i)
+                _encode(br, value, out, names)
+                return
+        raise TypeError(f"value {value!r} matches no union branch "
+                        f"{schema!r}")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            if schema.get("name"):
+                names[schema["name"]] = schema
+            for f in schema["fields"]:
+                _encode(f["type"], value.get(f["name"]), out, names)
+            return
+        if t == "array":
+            if value:
+                _write_long(out, len(value))
+                for v in value:
+                    _encode(schema["items"], v, out, names)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if value:
+                _write_long(out, len(value))
+                for k, v in value.items():
+                    _write_bytes(out, k.encode())
+                    _encode(schema["values"], v, out, names)
+            _write_long(out, 0)
+            return
+        if t == "enum":
+            _write_long(out, schema["symbols"].index(value))
+            return
+        if t == "fixed":
+            out.write(value)
+            return
+        _encode(t, value, out, names)
+        return
+    if schema in names:
+        _encode(names[schema], value, out, names)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+        return
+    if schema in ("int", "long"):
+        _write_long(out, int(value))
+        return
+    if schema == "float":
+        out.write(struct.pack("<f", float(value)))
+        return
+    if schema == "double":
+        out.write(struct.pack("<d", float(value)))
+        return
+    if schema == "bytes":
+        _write_bytes(out, bytes(value))
+        return
+    if schema == "string":
+        _write_bytes(out, str(value).encode())
+        return
+    raise ValueError(f"unsupported avro schema: {schema!r}")
+
+
+def _matches(schema, value, names) -> bool:
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return isinstance(value, dict)
+        if t == "array":
+            return isinstance(value, list)
+        if t == "map":
+            return isinstance(value, dict)
+        if t in ("enum",):
+            return isinstance(value, str)
+        if t == "fixed":
+            return isinstance(value, (bytes, bytearray))
+        return _matches(t, value, names)
+    if schema in names:
+        return _matches(names[schema], value, names)
+    if schema == "null":
+        return value is None
+    if schema == "boolean":
+        return isinstance(value, bool)
+    if schema in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if schema in ("float", "double"):
+        return isinstance(value, (int, float)) and \
+            not isinstance(value, bool)
+    if schema == "bytes":
+        return isinstance(value, (bytes, bytearray))
+    if schema == "string":
+        return isinstance(value, str)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# container file read / write
+# ---------------------------------------------------------------------------
+
+def read_avro(path: str) -> Tuple[Dict[str, Any], List[Any]]:
+    """Read an Avro container file → (parsed schema, records)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta_schema = {"type": "map", "values": "bytes"}
+    meta = _decode(meta_schema, buf, {})
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"{path}: unsupported avro codec {codec}")
+    sync = buf.read(16)
+    records: List[Any] = []
+    while buf.tell() < len(data):
+        try:
+            n = _read_long(buf)
+        except EOFError:
+            break
+        blen = _read_long(buf)
+        block = buf.read(blen)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        bbuf = io.BytesIO(block)
+        names: Dict[str, Any] = {}
+        for _ in range(n):
+            records.append(_decode(schema, bbuf, names))
+        if buf.read(16) != sync:
+            raise ValueError(f"{path}: bad avro sync marker")
+    return schema, records
+
+
+def write_avro(path: str, schema: Dict[str, Any], records: List[Any],
+               metadata: Optional[Dict[str, bytes]] = None) -> None:
+    """Write an Avro container file (null codec)."""
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": b"null"}
+    if metadata:
+        meta.update(metadata)
+    _encode({"type": "map", "values": "bytes"}, meta, out, {})
+    sync = os.urandom(16)
+    out.write(sync)
+    if records:
+        body = io.BytesIO()
+        names: Dict[str, Any] = {}
+        for r in records:
+            _encode(schema, r, body, names)
+        _write_long(out, len(records))
+        _write_bytes(out, body.getvalue())
+        out.write(sync)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())

@@ -95,7 +95,10 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     import jax
     pi = process_index if process_index is not None else jax.process_index()
     pc_ = process_count if process_count is not None else jax.process_count()
-    files = _dataset_files(path)
+    # an explicit file list (e.g. resolved from Iceberg manifests) skips
+    # directory discovery but keeps the striping/remote machinery
+    files = list(path) if isinstance(path, (list, tuple)) \
+        else _dataset_files(path)
 
     if pc_ == 1:
         if not _is_remote(files[0]):

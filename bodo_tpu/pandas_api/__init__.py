@@ -28,6 +28,16 @@ def from_pandas(df) -> BodoDataFrame:
     return BodoDataFrame(L.FromPandas(df))
 
 
+def read_iceberg(table_path, columns=None, snapshot_id=None
+                 ) -> BodoDataFrame:
+    """Local-warehouse Iceberg table → lazy frame (reference:
+    bodo/pandas/base.py:313 read_iceberg; filesystem catalogs only —
+    io/iceberg.py)."""
+    from bodo_tpu.io.iceberg import read_iceberg as _ri
+    return BodoDataFrame(L.FromPandas(
+        _ri(table_path, columns=columns, snapshot_id=snapshot_id)))
+
+
 def concat(frames, ignore_index: bool = True) -> BodoDataFrame:
     """Row-wise concat of schema-compatible lazy frames (pd.concat
     analogue; UNION ALL underneath)."""

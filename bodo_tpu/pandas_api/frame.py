@@ -289,6 +289,12 @@ class BodoDataFrame:
                 return
         write_parquet(execute(plan, optimize_first=False), path)
 
+    def to_iceberg(self, table_path: str, mode: str = "append") -> int:
+        """Write to a local-warehouse Iceberg table (reference:
+        bodo/pandas/frame.py:507 to_iceberg). Returns the snapshot id."""
+        from bodo_tpu.io.iceberg import write_iceberg
+        return write_iceberg(self._execute(), table_path, mode=mode)
+
     def drop(self, columns=None, **kw) -> "BodoDataFrame":
         if columns is None:
             warn_fallback("DataFrame.drop", "only columns= supported")

@@ -25,8 +25,111 @@ from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DictMap, DtField, Expr,
 
 def optimize(node: L.Node) -> L.Node:
     node = push_filters(node)
+    node = reorder_joins(node)
     node = prune_columns(node, None)
     return node
+
+
+# ---------------------------------------------------------------------------
+# join reordering (frame-path merge chains)
+# ---------------------------------------------------------------------------
+
+def reorder_joins(node: L.Node) -> L.Node:
+    """Greedy stats-driven reordering of left-deep INNER equi-join
+    chains — the frame-path analogue of the SQL planner's join-graph
+    ordering (reference: the vendored DuckDB join-order optimizer the
+    frame path gets via bodo/pandas/plan.py get_plan_cardinality).
+    pandas `merge` chains run in user order otherwise.
+
+    Conservative: only chains of >= 3 relations, all inner, same
+    null_equal, where every cross-relation shared column name is a
+    consumed equal-name join key (so suffix logic can never fire
+    differently under a new order). A final projection restores the
+    original column order."""
+    node = _rebuild(node, [reorder_joins(c) for c in node.children])
+    if not (isinstance(node, L.Join) and node.how == "inner"):
+        return node
+
+    rels: list = []
+    edges: list = []  # (ri, rj, key_i, key_j)
+    null_eq = node.null_equal
+    orig_schema = list(node.schema)
+
+    def collect(n) -> bool:
+        if isinstance(n, L.Join) and n.how == "inner" and \
+                n.null_equal == null_eq and \
+                n.suffixes == node.suffixes:
+            if not collect(n.left):
+                return False
+            ridx = len(rels)
+            rels.append(n.right)
+            for lk, rk in zip(n.left_on, n.right_on):
+                # attribute the left key to its single owning relation
+                # in the left subtree (suffixed/ambiguous names bail)
+                cand = [i for i in range(ridx) if lk in rels[i].schema]
+                if len(cand) != 1:
+                    return False
+                edges.append((cand[0], ridx, lk, rk))
+            return True
+        rels.append(n)
+        return True
+
+    if not collect(node) or len(rels) < 3:
+        return node
+
+    # suffix-safety: a name shared by two relations must be an
+    # equal-name join key on an edge between exactly those relations
+    key_names = {(e[0], e[1], e[2]) for e in edges if e[2] == e[3]}
+    for i in range(len(rels)):
+        for j in range(i + 1, len(rels)):
+            shared = set(rels[i].schema) & set(rels[j].schema)
+            for name in shared:
+                if (i, j, name) not in key_names and \
+                        (j, i, name) not in key_names:
+                    return node
+
+    from bodo_tpu.plan.stats import estimate, join_estimate
+    ests = [estimate(r) for r in rels]
+    start = min(range(len(rels)), key=lambda i: ests[i][0])
+    used = {start}
+    plan = rels[start]
+    cur_est, cur_raw = ests[start]
+    consumed: set = set()
+    while len(used) < len(rels):
+        best = None
+        for i in range(len(rels)):
+            if i in used:
+                continue
+            kl, kr, ids = [], [], []
+            for eid, (ri, rj, fi, fj) in enumerate(edges):
+                if eid in consumed:
+                    continue
+                if ri in used and rj == i:
+                    kl.append(fi)
+                    kr.append(fj)
+                    ids.append(eid)
+                elif rj in used and ri == i:
+                    kl.append(fj)
+                    kr.append(fi)
+                    ids.append(eid)
+            if kl:
+                out = join_estimate(cur_est, cur_raw, *ests[i])
+                if best is None or out < best[0]:
+                    best = (out, i, kl, kr, ids)
+        if best is None:
+            return node  # disconnected chain: keep user order
+        out, i, kl, kr, ids = best
+        plan = L.Join(plan, rels[i], kl, kr, "inner",
+                      suffixes=node.suffixes, null_equal=null_eq)
+        cur_est, cur_raw = out, max(cur_raw, ests[i][1])
+        used.add(i)
+        consumed.update(ids)
+
+    if set(plan.schema) != set(orig_schema):
+        return node  # suffix/drop divergence — bail to user order
+    if list(plan.schema) != orig_schema:
+        plan = L.Projection(plan, [(c, ColRef(c)) for c in orig_schema])
+    return plan
 
 
 # ---------------------------------------------------------------------------

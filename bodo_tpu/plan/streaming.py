@@ -476,50 +476,75 @@ class ReduceAccumulator:
 
 class SortAccumulator:
     """Streaming sort input: batches park in the native host pool
-    (spillable) during accumulation; the sort itself runs on the restored
-    whole table (device peak during accumulate is O(batch))."""
+    (spillable, arbitrated by the operator comptroller) during
+    accumulation; the sort itself runs on the restored whole table
+    (device peak during accumulate is O(batch))."""
 
     def __init__(self, by, ascending, na_last: bool):
-        from bodo_tpu.runtime.offload import offload_table
-        self._offload = offload_table
+        from bodo_tpu.runtime.comptroller import default_comptroller
+        self._comp = default_comptroller()
+        self._op = self._comp.register("stream_sort")
         self.by, self.ascending, self.na_last = by, ascending, na_last
         self.parts: List = []
 
     def push(self, batch: Table) -> None:
         if batch.nrows:
-            self.parts.append(self._offload(
+            self.parts.append(self._comp.park(
+                self._op,
                 _with_capacity(batch, _bucket_cap(max(batch.nrows, 1)))))
 
     def finish(self) -> Table:
         assert self.parts, "empty stream — caller must fall back"
         tables = [p.restore() for p in self.parts]
         self.parts = []
+        self._comp.unregister(self._op)
         t = R.concat_tables(tables) if len(tables) > 1 else tables[0]
         return R.sort_table(t, self.by, self.ascending, self.na_last)
+
+    def close(self) -> None:
+        """Abandon without sorting (empty-stream fallback): free parked
+        buffers and drop the comptroller registration."""
+        for p in self.parts:
+            p.free()
+        self.parts = []
+        self._comp.unregister(self._op)
 
 
 class StreamJoin:
     """Per-batch probe against a fully-built (offloaded) build side —
     the reference's streaming hash join with the build table parked in
-    the buffer pool (bodo/libs/streaming/_join.cpp HashJoinState)."""
+    the buffer pool (bodo/libs/streaming/_join.cpp HashJoinState),
+    accounted to this operator by the comptroller."""
 
     def __init__(self, build: Table, left_on, right_on, how, suffixes,
                  null_equal: bool = True):
-        from bodo_tpu.runtime.offload import offload_table
+        from bodo_tpu.runtime.comptroller import default_comptroller
         self.left_on, self.right_on = left_on, right_on
         self.how, self.suffixes = how, suffixes
         self.null_equal = null_equal
-        self._off = offload_table(build.gather()
-                                  if build.distribution != REP else build)
+        self._comp = default_comptroller()
+        self._op = self._comp.register("stream_join_build")
+        self._off = self._comp.park(
+            self._op,
+            build.gather() if build.distribution != REP else build)
         self._build: Optional[Table] = None
 
     def __call__(self, batch: Table) -> Table:
         if self._build is None:
             self._build = self._off.restore()
+            self._comp.unregister(self._op)
         out = R.join_tables(batch, self._build, self.left_on, self.right_on,
                             self.how, self.suffixes,
                             null_equal=self.null_equal)
         return _with_capacity(out, _bucket_cap(max(out.nrows, 1)))
+
+    def close(self) -> None:
+        """Release the parked build side if it was never probed (empty
+        probe stream) — otherwise the comptroller would account a dead
+        build table forever."""
+        if self._build is None and not self._off._closed:
+            self._off.free()
+            self._comp.unregister(self._op)
 
 
 # ---------------------------------------------------------------------------
@@ -579,8 +604,11 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
             return None
 
         def gen_join(src):
-            for b in src:
-                yield join(b)
+            try:
+                for b in src:
+                    yield join(b)
+            finally:
+                join.close()  # releases the build if never probed
         return gen_join(inner)
     return None
 
@@ -674,10 +702,16 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
         src = _build_stream(node.child)
         if src is None:
             return None
-        acc = SortAccumulator(node.by, node.ascending, node.na_last)
+        try:
+            acc = SortAccumulator(node.by, node.ascending, node.na_last)
+        except RuntimeError as e:
+            # native host pool unavailable: whole-table fallback
+            log(1, f"stream sort disabled, falling back: {e}")
+            return None
         for b in src:
             acc.push(b)
         if not acc.parts:
+            acc.close()
             return None  # empty stream: fall back (handles the 0-row case)
         return acc.finish()
 

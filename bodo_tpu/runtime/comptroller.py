@@ -1,0 +1,150 @@
+"""Operator memory comptroller: per-operator budgets over the host pool.
+
+TPU-native analogue of the reference's OperatorComptroller +
+OperatorBufferPool (reference: bodo/libs/memory_budget.py:28
+OperatorComptroller, bodo/libs/_operator_pool.h OperatorBufferPool).
+Where the reference threads budget hints through its C++ streaming
+operators, here every streaming operator that parks state in the native
+host pool registers with the comptroller; on allocation pressure the
+comptroller spills the LARGEST unpinned parked state first (best
+bytes-freed-per-restore-cost policy) and records the event in the
+tracing profile, instead of leaving eviction order to the pool's
+arbitrary scan.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from bodo_tpu.runtime.offload import OffloadedTable, offload_table
+from bodo_tpu.runtime.pool import HostBufferPool, default_pool
+from bodo_tpu.table.table import Table
+from bodo_tpu.utils.logging import log
+
+
+class OperatorComptroller:
+    """Arbitrates host-pool bytes across concurrently-running operators.
+
+    Operators register(), then park()/release() spillable state. When a
+    park would push the pool past its limit, the largest unpinned parked
+    state (any operator) spills to disk first — so one operator's build
+    side can't starve another's accumulation."""
+
+    def __init__(self, pool: Optional[HostBufferPool] = None,
+                 limit_bytes: Optional[int] = None):
+        self.pool = pool or default_pool()
+        self.limit = limit_bytes if limit_bytes is not None else \
+            getattr(self.pool, "limit_bytes", 4 << 30)
+        self._mu = threading.Lock()
+        self._next_op = 1
+        self._ops: Dict[int, str] = {}
+        # op_id -> list of (OffloadedTable, nbytes, spilled?)
+        self._parked: Dict[int, List] = {}
+        self.n_spills = 0
+        self.bytes_spilled = 0
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, name: str) -> int:
+        with self._mu:
+            op = self._next_op
+            self._next_op += 1
+            self._ops[op] = name
+            self._parked[op] = []
+            return op
+
+    def unregister(self, op_id: int) -> None:
+        with self._mu:
+            self._ops.pop(op_id, None)
+            self._parked.pop(op_id, None)
+
+    # -- parking ------------------------------------------------------------
+
+    @staticmethod
+    def _table_bytes(t: Table) -> int:
+        n = 0
+        for c in t.columns.values():
+            n += c.data.size * c.data.dtype.itemsize
+            if c.valid is not None:
+                n += c.valid.size
+        return n
+
+    def park(self, op_id: int, t: Table) -> OffloadedTable:
+        """Offload a table into the pool under this operator's account,
+        making room by spilling other parked state if needed."""
+        need = self._table_bytes(t)
+        self.ensure_room(need)
+        ot = offload_table(t, pool=self.pool)
+        with self._mu:
+            if op_id in self._parked:
+                self._parked[op_id].append([ot, need, False])
+        return ot
+
+    def release(self, op_id: int, ot: OffloadedTable) -> None:
+        with self._mu:
+            lst = self._parked.get(op_id)
+            if lst is not None:
+                self._parked[op_id] = [e for e in lst if e[0] is not ot]
+
+    # -- pressure -----------------------------------------------------------
+
+    def _in_use(self) -> int:
+        s = self.pool.stats()
+        return int(s.get("bytes_in_use", 0)) - int(s.get("bytes_spilled",
+                                                         0))
+
+    def ensure_room(self, nbytes: int) -> None:
+        """Spill largest-first until `nbytes` fits under the limit (best
+        effort — stops when nothing unpinned remains)."""
+        from bodo_tpu.utils import tracing
+        while self._in_use() + nbytes > self.limit:
+            victim = None
+            with self._mu:
+                for op, lst in self._parked.items():
+                    for e in lst:
+                        if not e[2] and (victim is None
+                                         or e[1] > victim[1][1]):
+                            victim = (op, e)
+            if victim is None:
+                return
+            op, e = victim
+            with tracing.event("comptroller_spill",
+                               operator=self._ops.get(op, "?"),
+                               bytes=e[1]):
+                spilled = e[0].spill()
+            e[2] = True  # marked even on failure so the loop advances
+            if spilled == 0:
+                continue  # pinned/already-freed victim: try next largest
+            self.n_spills += 1
+            self.bytes_spilled += e[1]
+            log(1, f"comptroller: spilled {e[1]} bytes of "
+                   f"{self._ops.get(op, '?')} ({spilled} buffers)")
+
+    def stats(self) -> dict:
+        with self._mu:
+            per_op = {self._ops[op]: sum(e[1] for e in lst)
+                      for op, lst in self._parked.items()
+                      if op in self._ops}
+        return {"n_spills": self.n_spills,
+                "bytes_spilled": self.bytes_spilled,
+                "parked_bytes": per_op,
+                "pool": self.pool.stats()}
+
+
+_default_comptroller: Optional[OperatorComptroller] = None
+_dc_lock = threading.Lock()
+
+
+def default_comptroller() -> OperatorComptroller:
+    global _default_comptroller
+    with _dc_lock:
+        if _default_comptroller is None:
+            _default_comptroller = OperatorComptroller()
+        return _default_comptroller
+
+
+def set_default_comptroller(c: Optional[OperatorComptroller]) -> None:
+    global _default_comptroller
+    with _dc_lock:
+        _default_comptroller = c

@@ -149,6 +149,7 @@ class HostBufferPool:
         os.makedirs(spill_dir, exist_ok=True)
         self._pool = lib.btpu_pool_create(limit_bytes, spill_dir.encode())
         self.spill_dir = spill_dir
+        self.limit_bytes = limit_bytes
 
     def allocate(self, nbytes: int) -> PooledBuffer:
         out = ctypes.c_void_p()

@@ -72,10 +72,10 @@ def test_fsspec_memory_parquet(mesh8):
                                rtol=1e-12)
 
 
-def test_iceberg_gated(mesh8):
+def test_iceberg_missing_table(mesh8, tmp_path):
     from bodo_tpu.io.iceberg import read_iceberg
-    with pytest.raises(ImportError, match="pyiceberg"):
-        read_iceberg("db.table")
+    with pytest.raises(FileNotFoundError, match="metadata"):
+        read_iceberg(str(tmp_path / "nope"))
 
 
 def test_hdf5_datetime_roundtrip_and_mixed_datasets(mesh8, tmp_path):

@@ -152,3 +152,72 @@ def test_select_star_keeps_from_order(mesh8):
     got = ctx.sql("select * from fact, dim where fact.k = dim.k2"
                   ).to_pandas()
     assert list(got.columns) == ["k", "v", "k2", "w"]
+
+
+def test_frame_merge_chain_reorders(mesh8, tmp_path):
+    """A 3-table pandas merge chain reorders by estimated cardinality:
+    the big fact table joins the SMALLER filtered dimension first
+    (VERDICT: the frame path used to run merges in user order)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.plan.optimizer import optimize
+
+    r = np.random.default_rng(0)
+    fact = pd.DataFrame({"k1": r.integers(0, 50, 5000),
+                         "k2": r.integers(0, 5, 5000),
+                         "v": r.normal(size=5000)})
+    dim_big = pd.DataFrame({"k1": np.arange(50),
+                            "a": r.normal(size=50)})
+    dim_small = pd.DataFrame({"k2": np.arange(5),
+                              "b": r.normal(size=5)})
+    pf, pb, ps = (str(tmp_path / f"{n}.pq")
+                  for n in ("fact", "big", "small"))
+    pq.write_table(pa.Table.from_pandas(fact), pf)
+    pq.write_table(pa.Table.from_pandas(dim_big), pb)
+    pq.write_table(pa.Table.from_pandas(dim_small), ps)
+
+    f = (bd.read_parquet(pf)
+         .merge(bd.read_parquet(pb), on="k1")
+         .merge(bd.read_parquet(ps), on="k2"))
+    opt = optimize(f._plan)
+
+    joins = []
+
+    def walk(n):
+        if isinstance(n, L.Join):
+            joins.append(n)
+        for c in n.children:
+            walk(c)
+    walk(opt)
+    assert len(joins) == 2
+    # the innermost (first-executed) join must involve the small dim
+    inner = joins[-1]
+    schemas = [set(inner.left.schema), set(inner.right.schema)]
+    assert any("b" in s for s in schemas), \
+        "expected the 5-row dimension joined first"
+    # and the result still matches pandas
+    got = f.to_pandas().sort_values(["k1", "k2", "v"]) \
+        .reset_index(drop=True)
+    exp = (fact.merge(dim_big, on="k1").merge(dim_small, on="k2")
+           .sort_values(["k1", "k2", "v"]).reset_index(drop=True))
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False)
+
+
+def test_frame_merge_chain_suffix_guard(mesh8):
+    """Chains where suffixes fire must NOT reorder (column meaning would
+    change) — result must equal pandas user-order semantics."""
+    import bodo_tpu.pandas_api as bd
+    r = np.random.default_rng(1)
+    a = pd.DataFrame({"k": np.arange(20), "v": r.normal(size=20)})
+    b = pd.DataFrame({"k": np.arange(20), "v": r.normal(size=20)})
+    c = pd.DataFrame({"k": np.arange(3), "w": r.normal(size=3)})
+    f = (bd.from_pandas(a).merge(bd.from_pandas(b), on="k")
+         .merge(bd.from_pandas(c), on="k"))
+    got = f.to_pandas().sort_values("k").reset_index(drop=True)
+    exp = (a.merge(b, on="k").merge(c, on="k")
+           .sort_values("k").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False)

@@ -29,11 +29,19 @@ def train(loss_fn: Callable, params, df, feature_cols: Sequence[str],
     """
     import optax
 
-    to_pandas = getattr(df, "to_pandas", None)
-    pdf = to_pandas() if callable(to_pandas) else df
-    X = pdf[list(feature_cols)].to_numpy(dtype=np.float64)
-    y = pdf[label_col].to_numpy(dtype=np.float64)
-    Xd, yd, mask, n = to_device_xy(X, y)
+    from bodo_tpu.ml._data import _is_lazy, table_to_device_xy
+
+    if _is_lazy(df):
+        # worker/device-resident path: the executed Table's columns cast
+        # + realign on device — no to_pandas() gather (reference:
+        # bodo/ai/train.py:104 feeds training from worker-resident data)
+        t = df._execute()
+        Xd, yd, mask, n = table_to_device_xy(t, list(feature_cols),
+                                             label_col)
+    else:
+        X = df[list(feature_cols)].to_numpy(dtype=np.float64)
+        y = df[label_col].to_numpy(dtype=np.float64)
+        Xd, yd, mask, n = to_device_xy(X, y)
     opt = optimizer or optax.adam(learning_rate)
     opt_state = opt.init(params)
     # permute REAL rows only — padding rows must never enter a batch

@@ -156,7 +156,8 @@ def _iceberg_schema(t: Table) -> dict:
         if c.dtype is dt.STRING:
             ty = "string"
         elif c.dtype.kind == "dec":
-            ty = f"decimal(18, {c.dtype.scale})"
+            prec = getattr(c.dtype, "precision", 18) or 18
+            ty = f"decimal({prec}, {c.dtype.scale})"
         elif c.dtype is dt.DATETIME:
             ty = "timestamp"
         else:
@@ -253,9 +254,15 @@ def write_iceberg(t: Table, table_path: str, mode: str = "append") -> int:
         _, prev_entries = read_avro(
             _local_path(prev["manifest-list"], table_path))
         for e in prev_entries:
-            entries.append({k: e.get(k, 0)
-                            for k in [f["name"] for f in
-                                      _MANIFEST_LIST_SCHEMA["fields"]]})
+            # optional fields from other engines may decode to None —
+            # coerce to this writer's non-null schema
+            row = {}
+            for f in _MANIFEST_LIST_SCHEMA["fields"]:
+                v = e.get(f["name"])
+                if v is None:
+                    v = "" if f["type"] == "string" else 0
+                row[f["name"]] = v
+            entries.append(row)
     entries.append({
         "manifest_path": mpath, "manifest_length": os.path.getsize(mpath),
         "partition_spec_id": 0, "content": 0, "sequence_number": seq,

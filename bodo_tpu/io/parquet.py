@@ -36,7 +36,10 @@ def _fs_of(path: str):
     return fs
 
 
-def _dataset_files(path: str):
+def _dataset_files(path):
+    if isinstance(path, (list, tuple)):
+        # pre-resolved file list (Iceberg manifests, multi-file scans)
+        return list(path)
     if _is_remote(path):
         fs = _fs_of(path)
         scheme = path.split("://", 1)[0]

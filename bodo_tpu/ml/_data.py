@@ -1,16 +1,129 @@
 """Shared helpers: accept numpy / jax / BodoSeries / BodoDataFrame inputs
-and produce row-sharded device arrays + a padding mask."""
+and produce row-sharded device arrays + a padding mask.
+
+Lazy frames/series take the DEVICE-RESIDENT path: the executed Table's
+columns cast+stack on device with sharding preserved — no to_pandas()
+gather anywhere (reference: bodo/ai/train.py:104 feeds training from
+worker-resident data; bodo/ml_support/ runs fit/metrics on each rank's
+shard)."""
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from bodo_tpu.parallel import mesh as mesh_mod
-from bodo_tpu.table.table import round_capacity
+from bodo_tpu.table.table import ONED, Table, round_capacity
+
+
+def _is_lazy(v) -> bool:
+    from bodo_tpu.pandas_api.frame import BodoDataFrame
+    from bodo_tpu.pandas_api.series import BodoSeries
+    return isinstance(v, (BodoDataFrame, BodoSeries))
+
+
+def _exec_lazy(v) -> Tuple[Table, list]:
+    """Execute a lazy frame/series to a Table + its value column names."""
+    from bodo_tpu.pandas_api.frame import BodoDataFrame
+    from bodo_tpu.pandas_api.series import BodoSeries
+    from bodo_tpu.plan.physical import execute
+    if isinstance(v, BodoSeries):
+        name = v._name or "_val"
+        t = execute(v._as_projection(name))
+        return t, [name]
+    assert isinstance(v, BodoDataFrame)
+    t = v._execute()
+    cols = [c for c in t.names if c in v._data_cols()]
+    return t, cols
+
+
+def table_mask(t: Table):
+    """Device-side live-row mask [capacity] for a Table (no host
+    transit: per-shard iota < count under shard_map)."""
+    if t.distribution != ONED:
+        return jnp.arange(t.capacity) < t.nrows
+    from bodo_tpu.parallel import collectives as C
+    from bodo_tpu.config import config
+    per = t.shard_capacity
+    m = mesh_mod.get_mesh()
+    ax = config.data_axis
+
+    def body(c):
+        return jnp.arange(per) < c[0]
+    fn = jax.jit(C.smap(body, in_specs=(P(ax),), out_specs=P(ax),
+                        mesh=m))
+    return fn(t.counts_device())
+
+
+def table_to_device_xy(t: Table, feature_cols: Sequence[str],
+                       label_col: Optional[str] = None):
+    """1D/REP Table → (X [cap,D] f64, y [cap] f64 or None, mask, n)
+    entirely on device; sharding (and therefore the cross-shard psum in
+    whatever reduction consumes these) is preserved.
+
+    Real rows are realigned contiguous at the front (the host-path
+    layout every estimator's predict[:n] slice assumes) by a DEVICE
+    gather — only the tiny int64 index vector is host-built from the
+    already-host-known shard counts; the feature/label data never
+    transits the host."""
+    if t.distribution != ONED and mesh_mod.num_shards() > 1:
+        t = t.shard()
+    X = jnp.stack([t.column(c).data.astype(jnp.float64)
+                   for c in feature_cols], axis=1) if feature_cols \
+        else None
+    mask = table_mask(t)
+    for c in feature_cols:
+        v = t.column(c).valid
+        if v is not None:
+            mask = mask & v
+    yd = None
+    if label_col is not None:
+        yc = t.column(label_col)
+        yd = yc.data.astype(jnp.float64)
+        if yc.valid is not None:
+            mask = mask & yc.valid
+    n = t.nrows
+    if t.distribution == ONED:
+        per = t.shard_capacity
+        cap = t.capacity
+        real = np.concatenate(
+            [i * per + np.arange(int(c)) for i, c in
+             enumerate(t.counts)] or [np.zeros(0, np.int64)])
+        idx = np.full(cap, max(cap - 1, 0), dtype=np.int64)
+        idx[:n] = real
+        idx_d = jax.device_put(idx, mesh_mod.row_sharding())
+        X = None if X is None else X[idx_d]
+        yd = None if yd is None else yd[idx_d]
+        mask = mask[idx_d] & (jnp.arange(cap) < n)
+    return X, yd, mask, n
+
+
+def _no_dict_cols(t: Table, cols) -> bool:
+    """Device numeric paths must not touch dict-coded string columns:
+    codes from independently-built dictionaries are not comparable."""
+    return all(t.column(c).dictionary is None for c in cols)
+
+
+def lazy_pair_device(a, b):
+    """Two lazy series with aligned layouts → (a_dev, b_dev, mask) for
+    device reductions, or None when no gather-free path exists (layouts
+    diverge, non-lazy inputs, or dict-coded strings)."""
+    if not (_is_lazy(a) and _is_lazy(b)):
+        return None
+    ta, ca = _exec_lazy(a)
+    tb, cb = _exec_lazy(b)
+    if not (ta.distribution == tb.distribution
+            and ta.capacity == tb.capacity and ta.nrows == tb.nrows):
+        return None
+    if not (_no_dict_cols(ta, ca) and _no_dict_cols(tb, cb)):
+        return None
+    _, ad, ma, _ = table_to_device_xy(ta, [], ca[0])
+    _, bd, mb, _ = table_to_device_xy(tb, [], cb[0])
+    return ad, bd, ma & mb
 
 
 def to_device_xy(X, y=None):
@@ -18,7 +131,23 @@ def to_device_xy(X, y=None):
 
     Arrays are padded to a shard-divisible capacity and row-sharded over
     the mesh (the reference's OneD distribution for ML inputs,
-    bodo/transforms/distributed_analysis.py TwoD for matrices)."""
+    bodo/transforms/distributed_analysis.py TwoD for matrices). Lazy
+    frame/series inputs stay device-resident end to end."""
+    if _is_lazy(X) and (y is None or _is_lazy(y)):
+        tx, xcols = _exec_lazy(X)
+        if y is None and _no_dict_cols(tx, xcols):
+            return table_to_device_xy(tx, xcols)
+        if y is not None:
+            ty, ycols = _exec_lazy(y)
+            if tx.distribution == ty.distribution and \
+                    tx.capacity == ty.capacity and \
+                    tx.nrows == ty.nrows and \
+                    _no_dict_cols(tx, xcols) and \
+                    _no_dict_cols(ty, ycols):
+                Xd, _, mask, n = table_to_device_xy(tx, xcols)
+                _, yd, ymask, _ = table_to_device_xy(ty, [], ycols[0])
+                return Xd, yd, mask & ymask, n
+        # layouts diverge / dict-coded strings: host path realigns
     X = _to_numpy_2d(X)
     n = X.shape[0]
     S = mesh_mod.num_shards()

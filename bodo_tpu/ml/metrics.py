@@ -1,30 +1,63 @@
-"""Metrics (reference bodo/ml_support/sklearn_metrics_ext.py —
-distributed confusion/r2/mse via allreduce; here host-side over gathered
-predictions, device reductions when inputs are sharded arrays)."""
+"""Metrics (reference: bodo/ml_support/sklearn_metrics_ext.py —
+distributed confusion/r2/mse via MPI allreduce).
+
+Lazy-series / device-array inputs reduce ON DEVICE: jnp reductions over
+row-sharded arrays let XLA insert the cross-shard psum (the allreduce
+analogue), and only the final scalar reaches the host. Plain
+numpy/pandas inputs take the host path.
+"""
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-from bodo_tpu.ml._data import _materialize
+from bodo_tpu.ml._data import _materialize, lazy_pair_device
 
 
-def _np(v):
-    return np.asarray(_materialize(v)).reshape(-1)
+def _pair(y_true, y_pred):
+    """→ (a, b, mask) device arrays when a no-gather path exists, else
+    (a, b, None) host numpy. String labels always take the host path —
+    dict codes from independent dictionaries are not comparable."""
+    dev = lazy_pair_device(y_true, y_pred)
+    if dev is not None:
+        return dev
+    a = np.asarray(_materialize(y_true)).reshape(-1)
+    b = np.asarray(_materialize(y_pred)).reshape(-1)
+    return a, b, None
 
 
 def accuracy_score(y_true, y_pred) -> float:
-    a, b = _np(y_true), _np(y_pred)
-    return float((a == b).mean()) if len(a) else 0.0
+    a, b, mask = _pair(y_true, y_pred)
+    if mask is None:
+        return float((a == b).mean()) if len(a) else 0.0
+    n = jnp.maximum(jnp.sum(mask), 1)
+    return float(jax.device_get(jnp.sum((a == b) & mask) / n))
 
 
 def mean_squared_error(y_true, y_pred) -> float:
-    a, b = _np(y_true).astype(float), _np(y_pred).astype(float)
-    return float(((a - b) ** 2).mean()) if len(a) else 0.0
+    a, b, mask = _pair(y_true, y_pred)
+    if mask is None:
+        a, b = a.astype(float), b.astype(float)
+        return float(((a - b) ** 2).mean()) if len(a) else 0.0
+    d = jnp.where(mask, a - b, 0.0)
+    n = jnp.maximum(jnp.sum(mask), 1)
+    return float(jax.device_get(jnp.sum(d * d) / n))
 
 
 def r2_score(y_true, y_pred) -> float:
-    a, b = _np(y_true).astype(float), _np(y_pred).astype(float)
-    ss_res = ((a - b) ** 2).sum()
-    ss_tot = ((a - a.mean()) ** 2).sum()
-    return float(1.0 - ss_res / ss_tot) if ss_tot > 0 else 0.0
+    a, b, mask = _pair(y_true, y_pred)
+    if mask is None:
+        a, b = a.astype(float), b.astype(float)
+        ss_res = ((a - b) ** 2).sum()
+        ss_tot = ((a - a.mean()) ** 2).sum()
+        return float(1.0 - ss_res / ss_tot) if ss_tot > 0 else 0.0
+    n = jnp.maximum(jnp.sum(mask), 1)
+    d = jnp.where(mask, a - b, 0.0)
+    ss_res = jnp.sum(d * d)
+    mean_a = jnp.sum(jnp.where(mask, a, 0.0)) / n
+    c = jnp.where(mask, a - mean_a, 0.0)
+    ss_tot = jnp.sum(c * c)
+    out = jnp.where(ss_tot > 0, 1.0 - ss_res / ss_tot, 0.0)
+    return float(jax.device_get(out))

@@ -32,10 +32,14 @@ def read_iceberg(table_path, columns=None, snapshot_id=None
                  ) -> BodoDataFrame:
     """Local-warehouse Iceberg table → lazy frame (reference:
     bodo/pandas/base.py:313 read_iceberg; filesystem catalogs only —
-    io/iceberg.py)."""
-    from bodo_tpu.io.iceberg import read_iceberg as _ri
-    return BodoDataFrame(L.FromPandas(
-        _ri(table_path, columns=columns, snapshot_id=snapshot_id)))
+    io/iceberg.py). Only the METADATA is read here: the snapshot's data
+    files become a lazy parquet scan, so column pruning and filter
+    pushdown still reach the file reads."""
+    from bodo_tpu.io.iceberg import (_current_metadata, _data_files,
+                                     _snapshot)
+    meta, _ = _current_metadata(table_path)
+    files = _data_files(table_path, _snapshot(meta, snapshot_id))
+    return BodoDataFrame(L.ReadParquet(tuple(files), columns))
 
 
 def concat(frames, ignore_index: bool = True) -> BodoDataFrame:

@@ -37,13 +37,18 @@ class Node:
 
 
 class ReadParquet(Node):
-    def __init__(self, path: str, columns: Optional[Sequence[str]] = None):
+    """Parquet scan. `path` may be a directory/glob/file or a
+    pre-resolved TUPLE of data files (the Iceberg snapshot path — keeps
+    the scan lazy so pruning/pushdown reach it)."""
+
+    def __init__(self, path, columns: Optional[Sequence[str]] = None):
         import pyarrow.parquet as pq
 
         from bodo_tpu.io.parquet import _dataset_files, _opened
-        self.path = path
+        self.path = tuple(path) if isinstance(path, (list, tuple)) \
+            else path
         self.children = []
-        f = _dataset_files(path)[0]
+        f = _dataset_files(self.path)[0]
         with _opened(f) as src:
             arrow_schema = pq.read_schema(src)
         names = list(columns) if columns else arrow_schema.names

@@ -45,10 +45,14 @@ def reorder_joins(node: L.Node) -> L.Node:
     null_equal, where every cross-relation shared column name is a
     consumed equal-name join key (so suffix logic can never fire
     differently under a new order). A final projection restores the
-    original column order."""
-    node = _rebuild(node, [reorder_joins(c) for c in node.children])
+    original column order.
+
+    The MAXIMAL chain is collected top-down BEFORE recursing, so a
+    4-relation merge chain reorders as one unit (recursing first would
+    reorder the inner 3-chain, wrap it in an order-restoring projection,
+    and hide it from the outer pass)."""
     if not (isinstance(node, L.Join) and node.how == "inner"):
-        return node
+        return _rebuild(node, [reorder_joins(c) for c in node.children])
 
     rels: list = []
     edges: list = []  # (ri, rj, key_i, key_j)
@@ -74,8 +78,13 @@ def reorder_joins(node: L.Node) -> L.Node:
         rels.append(n)
         return True
 
+    def bail():
+        # not reorderable as a unit: recurse into children normally
+        # (sub-chains may still reorder on their own)
+        return _rebuild(node, [reorder_joins(c) for c in node.children])
+
     if not collect(node) or len(rels) < 3:
-        return node
+        return bail()
 
     # suffix-safety: a name shared by two relations must be an
     # equal-name join key on an edge between exactly those relations
@@ -86,7 +95,10 @@ def reorder_joins(node: L.Node) -> L.Node:
             for name in shared:
                 if (i, j, name) not in key_names and \
                         (j, i, name) not in key_names:
-                    return node
+                    return bail()
+
+    # recurse into the chain LEAVES only (they are not part of the chain)
+    rels = [reorder_joins(r) for r in rels]
 
     from bodo_tpu.plan.stats import estimate, join_estimate
     ests = [estimate(r) for r in rels]
@@ -117,7 +129,7 @@ def reorder_joins(node: L.Node) -> L.Node:
                 if best is None or out < best[0]:
                     best = (out, i, kl, kr, ids)
         if best is None:
-            return node  # disconnected chain: keep user order
+            return bail()  # disconnected chain: keep user order
         out, i, kl, kr, ids = best
         plan = L.Join(plan, rels[i], kl, kr, "inner",
                       suffixes=node.suffixes, null_equal=null_eq)
@@ -126,7 +138,7 @@ def reorder_joins(node: L.Node) -> L.Node:
         consumed.update(ids)
 
     if set(plan.schema) != set(orig_schema):
-        return node  # suffix/drop divergence — bail to user order
+        return bail()  # suffix/drop divergence — bail to user order
     if list(plan.schema) != orig_schema:
         plan = L.Projection(plan, [(c, ColRef(c)) for c in orig_schema])
     return plan

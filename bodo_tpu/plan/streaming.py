@@ -592,23 +592,31 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
         inner = _build_stream(node.left)
         if inner is None:
             return None
+        from bodo_tpu.runtime.pool import has_native_pool
+        if not has_native_pool():
+            # no C++ toolchain: whole-table fallback is correct, just
+            # not memory-bounded
+            log(1, "stream join disabled: native host pool unavailable")
+            return None
         from bodo_tpu.plan import physical
         build = physical._exec(node.right)
-        try:
-            join = StreamJoin(build, node.left_on, node.right_on,
-                              node.how, node.suffixes, node.null_equal)
-        except RuntimeError as e:
-            # native host pool unavailable (no C++ toolchain): whole-table
-            # fallback is correct, just not memory-bounded
-            log(1, f"stream join disabled, falling back: {e}")
-            return None
+        lo, ro = node.left_on, node.right_on
+        how, suf, ne = node.how, node.suffixes, node.null_equal
 
         def gen_join(src):
+            # the build side parks in the pool only once the generator
+            # actually RUNS: a caller that abandons a never-started
+            # generator skips `finally` blocks entirely (PEP 342), so an
+            # eager park here would leak in the comptroller
+            join = None
             try:
                 for b in src:
+                    if join is None:
+                        join = StreamJoin(build, lo, ro, how, suf, ne)
                     yield join(b)
             finally:
-                join.close()  # releases the build if never probed
+                if join is not None:
+                    join.close()  # releases the build if never probed
         return gen_join(inner)
     return None
 

@@ -118,3 +118,27 @@ def test_iceberg_relative_path_roundtrip(mesh8, tmp_path, monkeypatch):
     write_iceberg(Table.from_pandas(df), "wh/tbl", mode="append")
     got = read_iceberg("wh/tbl").to_pandas()
     assert len(got) == 60
+
+
+def test_read_iceberg_is_lazy_with_pruning(mesh8, tmp_path):
+    """bd.read_iceberg plans a lazy parquet scan over the snapshot's
+    files (review finding: it used to materialize eagerly), so column
+    pruning reaches the file reads."""
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.plan.optimizer import optimize
+    wh = str(tmp_path / "tbl")
+    write_iceberg(Table.from_pandas(_df(40, seed=11)), wh, mode="create")
+    f = bd.read_iceberg(wh)
+    assert isinstance(f._plan, L.ReadParquet)
+    sel = f[["a"]]
+    opt = optimize(sel._plan)
+
+    def scans(n):
+        out = [n] if isinstance(n, L.ReadParquet) else []
+        for c in n.children:
+            out += scans(c)
+        return out
+    (scan,) = scans(opt)
+    assert list(scan.columns) == ["a"]
+    got = sel.to_pandas()
+    assert list(got.columns) == ["a"] and len(got) == 40

@@ -1,0 +1,139 @@
+"""Distributed ML data path (ml/_data.py table_to_device_xy): lazy
+frames feed training/estimators/metrics device-resident — NO
+to_pandas() gather anywhere in the path (reference: bodo/ai/train.py:104
+worker-resident feeding, bodo/ml_support/sklearn_metrics_ext.py
+allreduced metrics)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bodo_tpu
+import bodo_tpu.pandas_api as bd
+from bodo_tpu.config import config, set_config
+
+
+@pytest.fixture
+def sharded(mesh8):
+    old = config.shard_min_rows
+    set_config(shard_min_rows=0)  # everything 1D
+    yield
+    set_config(shard_min_rows=old)
+
+
+class _NoGather:
+    """Context manager that makes any to_pandas() in the covered code
+    path an assertion failure."""
+
+    def __enter__(self):
+        from bodo_tpu.pandas_api import frame, series
+        self._f = frame.BodoDataFrame.to_pandas
+        self._s = series.BodoSeries.to_pandas
+
+        def boom(self_, *a, **k):
+            raise AssertionError("to_pandas() gather in device path")
+        frame.BodoDataFrame.to_pandas = boom
+        series.BodoSeries.to_pandas = boom
+        return self
+
+    def __exit__(self, *exc):
+        from bodo_tpu.pandas_api import frame, series
+        frame.BodoDataFrame.to_pandas = self._f
+        series.BodoSeries.to_pandas = self._s
+
+
+def test_train_no_gather_on_1d_frame(sharded, rng):
+    import jax.numpy as jnp
+    from bodo_tpu.ai import train
+
+    n = 3000
+    df = pd.DataFrame({"x1": rng.normal(size=n),
+                       "x2": rng.normal(size=n)})
+    df["y"] = 2.0 * df.x1 + 0.5 * df.x2 - 1.0
+    f = bd.from_pandas(df)
+
+    def loss(params, X, y):
+        pred = X @ params["w"] + params["b"]
+        return (pred - y) ** 2
+
+    params0 = {"w": jnp.zeros(2), "b": jnp.zeros(())}
+    with _NoGather():
+        params, hist = train(loss, params0, f, ["x1", "x2"], "y",
+                             epochs=30, batch_size=256,
+                             learning_rate=0.05)
+    assert hist[-1] < hist[0]
+    np.testing.assert_allclose(np.asarray(params["w"]), [2.0, 0.5],
+                               atol=0.05)
+
+
+def test_estimator_fit_predict_no_gather(sharded, rng):
+    from bodo_tpu.ml.linear import LinearRegression
+
+    n = 2000
+    df = pd.DataFrame({"a": rng.normal(size=n),
+                       "b": rng.normal(size=n)})
+    df["y"] = 4.0 * df.a - 2.0 * df.b + 1.0
+    f = bd.from_pandas(df)
+    with _NoGather():
+        m = LinearRegression().fit(f[["a", "b"]], f["y"])
+        pred = m.predict(f[["a", "b"]])
+    np.testing.assert_allclose(np.asarray(m.coef_), [4.0, -2.0],
+                               atol=1e-6)
+    assert len(np.asarray(pred)) == n
+    np.testing.assert_allclose(
+        np.asarray(pred), df.y.to_numpy(), atol=1e-6)
+
+
+def test_metrics_device_path_matches_sklearn(sharded, rng):
+    from bodo_tpu.ml import metrics as M
+
+    n = 2500
+    df = pd.DataFrame({"t": rng.normal(size=n)})
+    df["p"] = df.t + rng.normal(size=n) * 0.3
+    df["tc"] = (df.t > 0).astype(np.int64)
+    df["pc"] = (df.p > 0.1).astype(np.int64)
+    f = bd.from_pandas(df)
+
+    with _NoGather():
+        mse = M.mean_squared_error(f["t"], f["p"])
+        r2 = M.r2_score(f["t"], f["p"])
+        acc = M.accuracy_score(f["tc"], f["pc"])
+
+    from sklearn import metrics as SK
+    np.testing.assert_allclose(
+        mse, SK.mean_squared_error(df.t, df.p), rtol=1e-9)
+    np.testing.assert_allclose(r2, SK.r2_score(df.t, df.p), rtol=1e-9)
+    np.testing.assert_allclose(
+        acc, SK.accuracy_score(df.tc, df.pc), rtol=1e-9)
+
+
+def test_metrics_mixed_inputs_fall_back(mesh8, rng):
+    """numpy + lazy mixes still work (host path)."""
+    from bodo_tpu.ml import metrics as M
+    a = rng.normal(size=100)
+    b = a + 0.1
+    df = pd.DataFrame({"a": a})
+    got = M.mean_squared_error(df["a"], b)
+    np.testing.assert_allclose(got, ((a - b) ** 2).mean(), rtol=1e-9)
+
+
+def test_table_realign_uneven_shards(sharded):
+    """Realigned device layout puts real rows contiguous even when shard
+    counts are uneven (filter makes them so)."""
+    from bodo_tpu.ml._data import to_device_xy
+    import jax
+
+    n = 1000
+    df = pd.DataFrame({"x": np.arange(n, dtype=np.float64),
+                       "y": np.arange(n, dtype=np.float64) * 2})
+    f = bd.from_pandas(df)
+    g = f[f["x"] % 3 == 0]  # uneven survivors per shard
+    with _NoGather():
+        Xd, yd, mask, m = to_device_xy(g[["x"]], g["y"])
+    exp = df[df.x % 3 == 0]
+    assert m == len(exp)
+    X_host = np.asarray(jax.device_get(Xd))[:m, 0]
+    y_host = np.asarray(jax.device_get(yd))[:m]
+    np.testing.assert_array_equal(np.sort(X_host), exp.x.to_numpy())
+    np.testing.assert_array_equal(y_host, X_host * 2)
+    assert bool(np.asarray(jax.device_get(mask))[:m].all())

@@ -221,3 +221,51 @@ def test_frame_merge_chain_suffix_guard(mesh8):
            .sort_values("k").reset_index(drop=True))
     pd.testing.assert_frame_equal(got[exp.columns], exp,
                                   check_dtype=False)
+
+
+def test_four_table_chain_reorders_as_one_unit(mesh8, tmp_path):
+    """4-relation merge chains must reorder as a whole (review finding:
+    bottom-up recursion used to hide the inner chain behind a
+    projection)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.plan.optimizer import optimize
+
+    r = np.random.default_rng(3)
+    fact = pd.DataFrame({"k1": r.integers(0, 40, 4000),
+                         "k2": r.integers(0, 30, 4000),
+                         "k3": r.integers(0, 4, 4000),
+                         "v": r.normal(size=4000)})
+    d1 = pd.DataFrame({"k1": np.arange(40), "a": np.arange(40) * 1.0})
+    d2 = pd.DataFrame({"k2": np.arange(30), "b": np.arange(30) * 1.0})
+    d3 = pd.DataFrame({"k3": np.arange(4), "c": np.arange(4) * 1.0})
+    paths = {}
+    for name, df in (("fact", fact), ("d1", d1), ("d2", d2), ("d3", d3)):
+        p = str(tmp_path / f"{name}.pq")
+        pq.write_table(pa.Table.from_pandas(df), p)
+        paths[name] = p
+    f = (bd.read_parquet(paths["fact"])
+         .merge(bd.read_parquet(paths["d1"]), on="k1")
+         .merge(bd.read_parquet(paths["d2"]), on="k2")
+         .merge(bd.read_parquet(paths["d3"]), on="k3"))
+    opt = optimize(f._plan)
+
+    joins = []
+
+    def walk(n):
+        if isinstance(n, L.Join):
+            joins.append(n)
+        for c in n.children:
+            walk(c)
+    walk(opt)
+    assert len(joins) == 3
+    # innermost join (executed first) must involve the 4-row dimension
+    inner = joins[-1]
+    assert any("c" in set(s.schema)
+               for s in (inner.left, inner.right)), \
+        "4-row dim should join first in the reordered chain"
+    got = f.to_pandas()
+    exp = (fact.merge(d1, on="k1").merge(d2, on="k2").merge(d3, on="k3"))
+    assert len(got) == len(exp)

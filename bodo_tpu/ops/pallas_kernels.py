@@ -27,6 +27,8 @@ import numpy as np
 _BLK = 512
 MAX_MATMUL_SLOTS = 4096
 
+_I0 = np.int32(0)  # int32 BlockSpec index constant (see in_specs comment)
+
 # test hook: run kernels through the pallas interpreter on CPU
 FORCE_INTERPRET = False
 
@@ -87,6 +89,10 @@ def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
         vals = jnp.concatenate(
             [vals, jnp.zeros((vals.shape[0], c_pad - vals.shape[1]),
                              vals.dtype)], axis=1)
+    # codes ride as a 2-D [N, 1] block: 1-D BlockSpecs fail Mosaic
+    # legalization on current libtpu toolchains (func.return on the
+    # implicit scalar layout), and TPU vregs are 2-D (8x128) anyway
+    codes2 = codes[:, None]
 
     def kernel(codes_ref, vals_ref, out_ref, acc_ref):
         step = pl.program_id(0)
@@ -95,15 +101,19 @@ def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
         def _init():
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        codes_blk = codes_ref[:]                      # [BLK]
-        onehot = (codes_blk[:, None] ==
+        codes_blk = codes_ref[:]                      # [BLK, 1]
+        onehot = (codes_blk ==
                   jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
                   ).astype(jnp.float32)               # [BLK, K]
-        # [C, BLK] @ [BLK, K] -> [C, K] on the MXU
+        # [C, BLK] @ [BLK, K] -> [C, K] on the MXU. HIGHEST precision:
+        # the default bf16 MXU pass rounds the f32 values (~0.4% rel
+        # error on sums); the one-hot side is exact either way, so the
+        # bf16x3 decomposition restores ~f32 accuracy for the val side
         acc_ref[:] += jax.lax.dot_general(
             vals_ref[:].T, onehot,
             dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
 
         @pl.when(step == pl.num_programs(0) - 1)
         def _flush():
@@ -112,15 +122,18 @@ def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // _BLK,),
+        # index-map constants must be int32: under jax_enable_x64 (which
+        # the engine needs for int64 ticks) a bare Python 0 becomes an
+        # i64, and Mosaic fails to legalize the mixed (i32, i64) return
         in_specs=[
-            pl.BlockSpec((_BLK,), lambda i: (i,)),
-            pl.BlockSpec((_BLK, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLK, c_pad), lambda i: (i, _I0)),
         ],
-        out_specs=pl.BlockSpec((c_pad, k_pad), lambda i: (0, 0)),
+        out_specs=pl.BlockSpec((c_pad, k_pad), lambda i: (_I0, _I0)),
         out_shape=jax.ShapeDtypeStruct((c_pad, k_pad), jnp.float32),
         scratch_shapes=[pltpu.VMEM((c_pad, k_pad), jnp.float32)],
         interpret=interpret,
-    )(codes, vals)
+    )(codes2, vals)
     return out[:n_cols, :n_slots].T                   # [n_slots, n_cols]
 
 

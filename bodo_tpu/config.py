@@ -57,6 +57,12 @@ class Config:
     )
     # Mesh axis used for row sharding.
     data_axis: str = field(default_factory=lambda: _env_str("BODO_TPU_DATA_AXIS", "d"))
+    # Max compiled kernels pinned per kernel cache (LRU eviction beyond
+    # this — unbounded pinning exhausts XLA:CPU JIT code memory and
+    # segfaults the compiler after thousands of distinct compilations).
+    kernel_cache_size: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_KERNEL_CACHE_SIZE", 512)
+    )
     # Skew headroom factor for all_to_all shuffle bucket capacity.
     shuffle_skew_factor: float = field(
         default_factory=lambda: _env_float("BODO_TPU_SHUFFLE_SKEW", 2.0)

@@ -27,6 +27,18 @@ def _pad(arr: np.ndarray, cap: int) -> np.ndarray:
     return out
 
 
+def _arrow_scalar_dtype(typ: pa.DataType) -> dt.DType:
+    if pa.types.is_string(typ) or pa.types.is_large_string(typ):
+        return dt.STRING
+    if pa.types.is_boolean(typ):
+        return dt.BOOL
+    if pa.types.is_floating(typ):
+        return dt.FLOAT64
+    if pa.types.is_integer(typ):
+        return dt.INT64
+    return dt.FLOAT64
+
+
 def _arrow_column(arr: pa.ChunkedArray, cap: int) -> Column:
     arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
     typ = arr.type
@@ -56,6 +68,26 @@ def _arrow_column(arr: pa.ChunkedArray, cap: int) -> Column:
         data = jnp.asarray(_pad(codes, cap))
         v = jnp.asarray(_pad(valid_np, cap)) if valid_np is not None else None
         return Column(data, v, dt.STRING, sorted_dict)
+
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ) or \
+            pa.types.is_struct(typ) or pa.types.is_map(typ):
+        # nested types dict-encode host-side (table/nested.py design)
+        from bodo_tpu.table import nested as _nested
+        pyvals = arr.to_pylist()
+        if pa.types.is_struct(typ):
+            fields = [(f.name, _arrow_scalar_dtype(f.type))
+                      for f in typ]
+            ndt = dt.struct_of(fields)
+            vals = [None if v is None else
+                    tuple(v.get(fn) for fn, _ in fields) for v in pyvals]
+        elif pa.types.is_map(typ):
+            ndt = dt.map_of(_arrow_scalar_dtype(typ.key_type),
+                            _arrow_scalar_dtype(typ.item_type))
+            vals = pyvals
+        else:
+            ndt = dt.list_of(_arrow_scalar_dtype(typ.value_type))
+            vals = pyvals
+        return _nested.encode_values(vals, ndt, capacity=cap)
 
     if pa.types.is_timestamp(typ):
         a64 = arr.cast(pa.timestamp("ns")).to_numpy(zero_copy_only=False)
@@ -158,9 +190,26 @@ def table_to_arrow(t: Table) -> pa.Table:
             arrays[name] = _decimal_from_int64(
                 data, col.dtype.scale, mask,
                 precision=col.dtype.precision)
+        elif dt.is_nested(col.dtype):
+            from bodo_tpu.table import nested as _nested
+            objs = _nested.decode_column(col, t.nrows)
+            if col.dtype.kind == "map":
+                typ = pa.map_(_arrow_pa_type(col.dtype.key),
+                              _arrow_pa_type(col.dtype.value))
+            elif col.dtype.kind == "struct":
+                typ = pa.struct([(fn, _arrow_pa_type(ft))
+                                 for fn, ft in col.dtype.fields])
+            else:
+                typ = pa.list_(_arrow_pa_type(col.dtype.elem))
+            arrays[name] = pa.array(list(objs), type=typ)
         else:
             arrays[name] = pa.array(data, mask=mask)
     return pa.table(arrays)
+
+
+def _arrow_pa_type(t: dt.DType) -> pa.DataType:
+    return {"str": pa.string(), "b": pa.bool_(), "f": pa.float64(),
+            "i": pa.int64(), "u": pa.int64()}.get(t.kind, pa.float64())
 
 
 def _decimal_from_int64(ints: np.ndarray, scale: int, mask,

@@ -27,29 +27,60 @@ import numpy as np
 import pandas as pd
 
 
+import threading
+
+_redirect_tls = threading.local()          # .depth: per-thread jit nesting
+_redirect_lock = threading.Lock()
+_redirect_active = [0]                     # process-wide active redirects
+_redirect_originals: dict = {}
+
+
+def _in_jit() -> bool:
+    return getattr(_redirect_tls, "depth", 0) > 0
+
+
 class _PandasRedirect:
     """Context that redirects pandas module-level entry points used inside
     jitted functions to the lazy frontend (read_parquet/read_csv/merge).
     Unsupported kwargs route to the genuine pandas function (host read)
     with a fallback warning instead of being silently dropped.
 
-    NOTE: the patch is process-global for the duration of the call — like
-    the reference's spawn model, jitted execution is assumed
-    single-threaded on the driver; concurrent pandas use from other
-    threads during a jitted call would see the redirect."""
+    The installed wrappers are THREAD-AWARE: only the thread(s) currently
+    inside a jitted call see the redirect; concurrent host pandas use
+    from other threads reaches the genuine functions (the reference has
+    no such hazard because its JIT rewrites call sites at compile time
+    rather than patching the module)."""
 
     _PATCHED = ("read_parquet", "read_csv", "merge")
 
-    def __init__(self):
-        self._saved = {}
-
     def __enter__(self):
+        with _redirect_lock:
+            if _redirect_active[0] == 0:
+                self._install()
+            _redirect_active[0] += 1
+        _redirect_tls.depth = getattr(_redirect_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _redirect_tls.depth -= 1
+        with _redirect_lock:
+            _redirect_active[0] -= 1
+            if _redirect_active[0] == 0:
+                for n, f in _redirect_originals.items():
+                    setattr(pd, n, f)
+                _redirect_originals.clear()
+        return False
+
+    @staticmethod
+    def _install():
         import bodo_tpu.pandas_api as bd
         from bodo_tpu.utils.logging import warn_fallback
-        self._saved = {n: getattr(pd, n) for n in self._PATCHED}
-        orig = self._saved
+        orig = {n: getattr(pd, n) for n in _PandasRedirect._PATCHED}
+        _redirect_originals.update(orig)
 
         def _read_parquet(path, **kw):
+            if not _in_jit():
+                return orig["read_parquet"](path, **kw)
             extra = set(kw) - {"columns", "engine"}
             if extra:  # unsupported kwargs → genuine pandas (host) read
                 warn_fallback("jit pd.read_parquet", f"kwargs {sorted(extra)}")
@@ -58,6 +89,8 @@ class _PandasRedirect:
         pd.read_parquet = _read_parquet
 
         def _read_csv(path, **kw):
+            if not _in_jit():
+                return orig["read_csv"](path, **kw)
             extra = set(kw) - {"usecols", "parse_dates"}
             if extra:
                 warn_fallback("jit pd.read_csv", f"kwargs {sorted(extra)}")
@@ -67,7 +100,8 @@ class _PandasRedirect:
         pd.read_csv = _read_csv
 
         def _merge(left, right, **kw):
-            from bodo_tpu.pandas_api.frame import BodoDataFrame
+            if not _in_jit():
+                return orig["merge"](left, right, **kw)
             l_ = bd.from_pandas(left) if isinstance(left, pd.DataFrame) else left
             r_ = bd.from_pandas(right) if isinstance(right, pd.DataFrame) \
                 else right
@@ -80,12 +114,6 @@ class _PandasRedirect:
                     else right.to_pandas()
                 return bd.from_pandas(orig["merge"](lp, rp, **kw))
         pd.merge = _merge
-        return self
-
-    def __exit__(self, *exc):
-        for n, f in self._saved.items():
-            setattr(pd, n, f)
-        return False
 
 
 def _is_numeric_args(args, kwargs) -> bool:

@@ -96,9 +96,68 @@ def quarter(ns):
     return jnp.floor_divide(month(ns) - 1, 3) + 1
 
 
+def week(ns):
+    """ISO 8601 week number (1-53), branch-free: the ISO week of a date
+    is the week containing its Thursday."""
+    days = days_from_ns(ns)
+    # Thursday of this date's ISO week (Monday=0 convention)
+    thu = days - dayofweek(ns) + 3
+    y, _, _ = _civil(thu)
+    jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (jnp.floor_divide(thu - jan1, 7) + 1).astype(jnp.int64)
+
+
+def _month_len(y, m):
+    """Days in civil month (y, m)."""
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    base = jnp.asarray(
+        np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                 dtype=np.int64))[m - 1]
+    return jnp.where((m == 2) & leap, 29, base)
+
+
+def add_months(ns, n):
+    """Calendar month addition with day-of-month clamping (SQL DATEADD
+    semantics: Jan 31 + 1 month = Feb 28/29)."""
+    y, m, d = _civil(days_from_ns(ns))
+    tod = ns - days_from_ns(ns) * NS_PER_DAY
+    tot = (y * 12 + (m - 1)) + n
+    y2 = jnp.floor_divide(tot, 12)
+    m2 = tot - y2 * 12 + 1
+    d2 = jnp.minimum(d, _month_len(y2, m2))
+    return _days_from_civil(y2, m2, d2) * NS_PER_DAY + tod
+
+
+def trunc(unit: str, ns):
+    """DATE_TRUNC to ns ticks at the start of the unit."""
+    if unit in ("second", "minute", "hour", "day"):
+        step = {"second": NS_PER_SEC, "minute": NS_PER_MIN,
+                "hour": NS_PER_HOUR, "day": NS_PER_DAY}[unit]
+        return jnp.floor_divide(ns, step) * step
+    if unit == "week":  # ISO week start (Monday)
+        days = days_from_ns(ns)
+        return (days - dayofweek(ns)) * NS_PER_DAY
+    y, m, _ = _civil(days_from_ns(ns))
+    one = jnp.ones_like(y)
+    if unit == "month":
+        return _days_from_civil(y, m, one) * NS_PER_DAY
+    if unit == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        return _days_from_civil(y, qm, one) * NS_PER_DAY
+    if unit == "year":
+        return _days_from_civil(y, one, one) * NS_PER_DAY
+    raise ValueError(f"unknown trunc unit {unit}")
+
+
+def month_index(ns):
+    """Absolute month number (year*12 + month-1) — datediff building block."""
+    y, m, _ = _civil(days_from_ns(ns))
+    return y * 12 + (m - 1)
+
+
 FIELDS = {
     "year": year, "month": month, "day": day, "hour": hour,
     "minute": minute, "second": second, "dayofweek": dayofweek,
     "weekday": dayofweek, "dayofyear": dayofyear, "quarter": quarter,
-    "date": date,
+    "date": date, "week": week, "weekofyear": week,
 }

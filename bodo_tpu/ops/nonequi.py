@@ -36,7 +36,9 @@ from bodo_tpu.table.table import Column, REP, Table, round_capacity
 # pair-grid budget: tile_rows * build_cap <= this (elements per pred col)
 _GRID_BUDGET = 1 << 22
 
-_jit_cache: Dict = {}
+from bodo_tpu.utils.kernel_cache import KernelCache
+
+_jit_cache = KernelCache(maxsize=config.kernel_cache_size)
 
 
 def _pow2(n: int) -> int:

@@ -175,6 +175,63 @@ def tail_rows(x, valid, count, k: int):
             have & ok[idx])
 
 
+def multi_hop_halo(x, valid, count, k: int, axis: str):
+    """Last k rows across ALL predecessor shards (not just the immediate
+    neighbour): every shard all-gathers its k-row tail, and each shard
+    selects the trailing k rows among shards before it. Row EXISTENCE
+    (position past padding) is tracked separately from value validity —
+    a null predecessor row still occupies its halo slot so shift/rolling
+    see its null, exactly as a local previous row would. Handles short
+    and empty predecessor shards — the case that used to force a gather
+    fallback. Cost: one all_gather of [S, k] doubles + flags."""
+    cap = x.shape[0]
+    idx = jnp.clip(count - k + jnp.arange(k), 0, cap - 1)
+    exists = (count - k + jnp.arange(k)) >= 0          # row present
+    padmask = K.row_mask(count, cap)
+    okv = _ok(x, valid, padmask)
+    tx = jnp.where(exists, x.astype(jnp.float64)[idx], 0.0)
+    tok = exists & okv[idx]                            # value also valid
+    all_tx = lax.all_gather(tx, axis)                  # [S, k]
+    all_tex = lax.all_gather(exists, axis)
+    all_tok = lax.all_gather(tok, axis)
+    S = all_tx.shape[0]
+    r = lax.axis_index(axis)
+    shard_ids = jnp.repeat(jnp.arange(S), k)     # [S*k], shard of each row
+    flat_x = all_tx.reshape(-1)
+    flat_ex = all_tex.reshape(-1) & (shard_ids < r)
+    flat_ok = all_tok.reshape(-1) & (shard_ids < r)
+    # j-th existing row counted from the END goes to halo slot k - j
+    rev = jnp.cumsum(flat_ex[::-1])[::-1]
+    slot = jnp.where(flat_ex & (rev <= k), k - rev, k)  # k = dropped
+    halo_x = jnp.zeros(k, flat_x.dtype).at[slot].set(flat_x, mode="drop")
+    halo_ok = jnp.zeros(k, bool).at[slot].set(flat_ok, mode="drop")
+    return halo_x, halo_ok
+
+
+def prev_last_value(x, valid, count, axis: str):
+    """The last real row's (value, value_ok, exists) from the nearest
+    non-empty predecessor shard, in the ORIGINAL dtype (no float64
+    round-trip — int64 ticks stay exact). Used for cross-shard tie
+    detection in global ranking."""
+    cap = x.shape[0]
+    last_i = jnp.clip(count - 1, 0, cap - 1)
+    lv = x[last_i]
+    padmask = K.row_mask(count, cap)
+    lok = _ok(x, valid, padmask)[last_i] & (count > 0)
+    have = count > 0
+    all_v = lax.all_gather(lv, axis)         # [S]
+    all_ok = lax.all_gather(lok, axis)
+    all_have = lax.all_gather(have, axis)
+    S = all_v.shape[0]
+    r = lax.axis_index(axis)
+    ids = jnp.arange(S)
+    cand = all_have & (ids < r)
+    best = jnp.max(jnp.where(cand, ids, -1))
+    exists = best >= 0
+    sel = jnp.clip(best, 0, S - 1)
+    return all_v[sel], all_ok[sel] & exists, exists
+
+
 # ---------------------------------------------------------------------------
 # shift / diff
 # ---------------------------------------------------------------------------

@@ -244,7 +244,211 @@ class DictMap(Expr):
             return s.capitalize()
         if self.kind == "zfill":
             return s.zfill(self.params[0])
+        if self.kind == "lpad":
+            n, fill = self.params
+            if len(s) >= n:
+                return s[:n]
+            pad = (fill * n)[: n - len(s)] if fill else ""
+            return pad + s
+        if self.kind == "rpad":
+            n, fill = self.params
+            if len(s) >= n:
+                return s[:n]
+            return s + (fill * n)[: n - len(s)] if fill else s
+        if self.kind == "left":
+            n = self.params[0]
+            return s[:n] if n > 0 else ""
+        if self.kind == "right":
+            n = self.params[0]
+            return s[-n:] if n > 0 else ""
+        if self.kind == "reverse":
+            return s[::-1]
+        if self.kind == "repeat":
+            return s * self.params[0]
+        if self.kind == "split_part":
+            delim, n = self.params
+            parts = s.split(delim) if delim else [s]
+            return parts[n - 1] if 1 <= n <= len(parts) else ""
+        if self.kind == "initcap":
+            return re.sub(r"[A-Za-z0-9]+",
+                          lambda m: m.group(0).capitalize(), s)
+        if self.kind == "translate":
+            src, dst = self.params
+            return s.translate(str.maketrans(src, dst))
+        if self.kind == "prepend":
+            return self.params[0] + s
+        if self.kind == "append":
+            return s + self.params[0]
+        if self.kind == "regexp_replace":
+            pat, repl = self.params
+            return re.sub(pat, repl, s)
+        if self.kind == "regexp_substr":
+            # no-match rows become NULL (validity handled by the
+            # assign_columns host pass, relational._str_part)
+            m = re.search(self.params[0], s)
+            return m.group(0) if m else ""
+        if self.kind == "ljust":
+            n, fill = self.params
+            return s.ljust(n, fill)
+        if self.kind == "rjust":
+            n, fill = self.params
+            return s.rjust(n, fill)
+        if self.kind == "center":
+            n, fill = self.params
+            return s.center(n, fill)
+        if self.kind == "get":
+            i = self.params[0]
+            return s[i] if -len(s) <= i < len(s) else ""
+        if self.kind == "md5":
+            import hashlib
+            return hashlib.md5(s.encode()).hexdigest()
+        if self.kind == "sha1":
+            import hashlib
+            return hashlib.sha1(s.encode()).hexdigest()
+        if self.kind == "sha2":
+            import hashlib
+            bits = self.params[0] if self.params else 256
+            h = {224: hashlib.sha224, 256: hashlib.sha256,
+                 384: hashlib.sha384, 512: hashlib.sha512}[bits]
+            return h(s.encode()).hexdigest()
         raise ValueError(self.kind)
+
+    def host_null(self, s: str) -> bool:
+        """Whether this transform yields NULL for input `s` (applied by
+        the assign_columns host pass; eval-side predicates ignore it)."""
+        if self.kind == "regexp_substr":
+            return re.search(self.params[0], s) is None
+        if self.kind == "get":
+            i = self.params[0]
+            return not (-len(s) <= i < len(s))
+        return False
+
+
+@_frozen
+class MathFn(Expr):
+    """Element-wise math function on the VPU (SQL kernel library analogue
+    of the reference's numeric kernels, BodoSQL/bodosql/kernels/
+    numeric_array_kernels.py). kind: ceil|floor|sqrt|exp|ln|log10|log2|
+    sign|sin|cos|tan|asin|acos|atan|degrees|radians|round|round_even|
+    trunc. `round`/`trunc` take (digits,) in params; SQL `round` is
+    half-away-from-zero, `round_even` is banker's (pandas)."""
+    kind: str
+    params: Tuple
+    operand: Expr
+    def key(self): return ("math", self.kind, self.params, self.operand.key())
+
+
+@_frozen
+class MaskNull(Expr):
+    """Null out rows where `cond` holds (NULLIF building block): data
+    passes through, validity becomes valid & ~cond."""
+    cond: Expr
+    operand: Expr
+    def key(self): return ("masknull", self.cond.key(), self.operand.key())
+
+
+@_frozen
+class CodeLUT(Expr):
+    """String column from a small static vocabulary indexed by an integer
+    expression (MONTHNAME/DAYNAME analogue of the reference's
+    bodosql/kernels/datetime_array_kernels.py monthname). `operand` must
+    produce codes in [0, len(strings)); the device only sees the
+    remapping into the sorted dictionary."""
+    strings: Tuple
+    operand: Expr
+    def key(self): return ("codelut", self.strings, self.operand.key())
+
+    def sorted_dict(self) -> np.ndarray:
+        return np.sort(np.asarray(self.strings, dtype=str))
+
+    def rank_lut(self) -> np.ndarray:
+        """rank_lut[i] = position of strings[i] in the sorted dictionary."""
+        return np.argsort(np.argsort(np.asarray(self.strings, dtype=str))
+                          ).astype(np.int32)
+
+
+@_frozen
+class StrHostFn(Expr):
+    """Numeric function of a string column, evaluated per dictionary
+    entry on host → device gather through the LUT (same trick as StrLen).
+    kind: position(sub) 1-based 0-if-absent | ascii | to_number |
+    to_date | regexp_count(pat). to_number/to_date entries that fail to
+    parse become null."""
+    kind: str
+    params: Tuple
+    operand: Expr
+    def key(self): return ("strhost", self.kind, self.params,
+                           self.operand.key())
+
+    def apply_host(self, s: str):
+        """Returns (value, ok)."""
+        if self.kind == "position":
+            return s.find(self.params[0]) + 1, True
+        if self.kind == "ascii":
+            return (ord(s[0]) if s else 0), True
+        if self.kind == "to_number":
+            try:
+                return float(s), True
+            except ValueError:
+                return 0.0, False
+        if self.kind == "to_date":
+            try:
+                d = np.datetime64(s.strip()[:10], "D")
+            except ValueError:
+                return 0, False
+            if np.isnat(d):  # np.datetime64('') parses to NaT, no raise
+                return 0, False
+            return int(d.astype(np.int64)), True
+        if self.kind == "regexp_count":
+            return len(re.findall(self.params[0], s)), True
+        raise ValueError(self.kind)
+
+
+@_frozen
+class StrConcat(Expr):
+    """Concatenation of string columns and literal fragments into one
+    dict-encoded column. parts: str literals and string-producing Exprs.
+    With k column parts the combined dictionary is the cross product of
+    the part dictionaries (mixed-radix codes on device), gated by
+    MAX_CONCAT_DICT — the dict-encoded analogue of the reference's
+    concat kernel (BodoSQL/bodosql/kernels/string_array_kernels.py)."""
+    parts: Tuple
+    def key(self):
+        return ("strcat", tuple(p if isinstance(p, str) else p.key()
+                                for p in self.parts))
+
+
+MAX_CONCAT_DICT = 1 << 20
+
+
+@_frozen
+class DateTrunc(Expr):
+    """DATE_TRUNC(unit, x): start of the containing unit."""
+    unit: str
+    operand: Expr
+    def key(self): return ("dtrunc", self.unit, self.operand.key())
+
+
+@_frozen
+class DateAdd(Expr):
+    """DATEADD(unit, n, x) — calendar-correct for month/quarter/year
+    (day-of-month clamped), tick arithmetic for fixed-width units."""
+    unit: str
+    amount: Expr
+    operand: Expr
+    def key(self): return ("dadd", self.unit, self.amount.key(),
+                           self.operand.key())
+
+
+@_frozen
+class DateDiff(Expr):
+    """DATEDIFF(unit, a, b) = boundary count from a to b (Snowflake
+    semantics: year diff is year(b)-year(a), etc.)."""
+    unit: str
+    left: Expr
+    right: Expr
+    def key(self): return ("ddiff", self.unit, self.left.key(),
+                           self.right.key())
 
 
 @_frozen
@@ -256,6 +460,31 @@ class StrLen(Expr):
 
     def key(self):
         return ("strlen", self.operand.key())
+
+
+@_frozen
+class StrToList(Expr):
+    """str.split(expand=False) → list<string> column; the split runs
+    once per distinct dictionary entry on host (table/nested.py design;
+    reference: bodo/libs/dict_arr_ext.py str_split + array_item repr).
+    Must sit at the top level of a projection like DictMap."""
+    params: Tuple      # (pat, maxsplit)
+    operand: Expr
+    def key(self): return ("strtolist", self.params, self.operand.key())
+
+    def split_host(self, s: str):
+        pat, n = self.params
+        return tuple(s.split(pat) if n <= 0 else s.split(pat, n))
+
+
+@_frozen
+class StrCodes(Expr):
+    """Dictionary codes of a string column as int32 (pandas .cat.codes
+    analogue: the dictionary is sorted, so codes equal the categorical
+    codes of `astype('category')`; nulls become -1). Reference:
+    bodo/hiframes/pd_categorical_ext.py get_categorical_arr_codes."""
+    operand: Expr
+    def key(self): return ("strcodes", self.operand.key())
 
 
 @_frozen
@@ -298,9 +527,39 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
         return dt.DATE if e.field == "date" else dt.INT64
     if isinstance(e, (IsIn, StrPredicate)):
         return dt.BOOL
-    if isinstance(e, DictMap):
+    if isinstance(e, (DictMap, CodeLUT, StrConcat)):
         return dt.STRING
+    if isinstance(e, StrToList):
+        return dt.list_of(dt.STRING)
     if isinstance(e, StrLen):
+        return dt.INT64
+    if isinstance(e, StrCodes):
+        return dt.INT32
+    if isinstance(e, StrHostFn):
+        if e.kind == "to_number":
+            return dt.FLOAT64
+        if e.kind == "to_date":
+            return dt.DATE
+        return dt.INT64
+    if isinstance(e, MathFn):
+        if e.kind == "sign":
+            return dt.INT64
+        if e.kind in ("ceil", "floor", "round", "round_even", "trunc"):
+            src = infer_dtype(e.operand, schema)
+            if dt.is_decimal(src):
+                return dt.FLOAT64
+            return src if src.kind in ("i", "u") else dt.FLOAT64
+        return dt.FLOAT64
+    if isinstance(e, MaskNull):
+        return infer_dtype(e.operand, schema)
+    if isinstance(e, DateTrunc):
+        return infer_dtype(e.operand, schema)
+    if isinstance(e, DateAdd):
+        src = infer_dtype(e.operand, schema)
+        if src is dt.DATE and e.unit in ("hour", "minute", "second"):
+            return dt.DATETIME
+        return src
+    if isinstance(e, DateDiff):
         return dt.INT64
     if isinstance(e, RowUDF):
         if e.out_dtype is not None:
@@ -321,6 +580,16 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
     if isinstance(e, BinOp):
         if e.op in ("==", "!=", "<", "<=", ">", ">=", "&", "|"):
             return dt.BOOL
+        if e.op in ("max2", "min2"):
+            lt = infer_dtype(e.left, schema)
+            rt = infer_dtype(e.right, schema)
+            if dt.is_decimal(lt) or dt.is_decimal(rt):
+                ls = lt.scale if dt.is_decimal(lt) else 0
+                rs = rt.scale if dt.is_decimal(rt) else 0
+                return dt.decimal(max(ls, rs))
+            if dt.is_numeric(lt) and dt.is_numeric(rt):
+                return dt.common_numeric(lt, rt)
+            return lt
         lt = infer_dtype(e.left, schema)
         rt = infer_dtype(e.right, schema)
         if dt.is_decimal(lt) or dt.is_decimal(rt):
@@ -355,11 +624,24 @@ def expr_columns(e: Expr) -> set:
             return expr_columns(e.operand)
         return {"*"}  # may touch any column — disables pruning above it
     if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate, DictMap,
-                      StrLen)):
+                      StrLen, MathFn, StrHostFn, CodeLUT, DateTrunc,
+                      StrCodes, StrToList)):
         return expr_columns(e.operand)
     if isinstance(e, Where):
         return (expr_columns(e.cond) | expr_columns(e.iftrue)
                 | expr_columns(e.iffalse))
+    if isinstance(e, MaskNull):
+        return expr_columns(e.cond) | expr_columns(e.operand)
+    if isinstance(e, DateAdd):
+        return expr_columns(e.amount) | expr_columns(e.operand)
+    if isinstance(e, DateDiff):
+        return expr_columns(e.left) | expr_columns(e.right)
+    if isinstance(e, StrConcat):
+        out = set()
+        for p in e.parts:
+            if isinstance(p, Expr):
+                out |= expr_columns(p)
+        return out
     return set()
 
 
@@ -469,35 +751,181 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
             if v is not None:
                 valid = v if valid is None else (valid & v)
         return out, valid
-    if isinstance(e, StrLen):
+    if isinstance(e, MathFn):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        src = infer_dtype(e.operand, schema)
+        if dt.is_decimal(src):
+            d = d.astype(jnp.float64) / (10.0 ** src.scale)
+            src = dt.FLOAT64
+        k = e.kind
+        if k == "sign":
+            return jnp.sign(d).astype(jnp.int64), v
+        if k in ("ceil", "floor", "round", "round_even", "trunc"):
+            if src.kind in ("i", "u") and k in ("ceil", "floor"):
+                return d, v
+            digits = int(e.params[0]) if e.params else 0
+            mul = np.float64(10.0 ** digits)
+            x = d.astype(jnp.float64) * mul
+            if k == "ceil":
+                r = jnp.ceil(d.astype(jnp.float64))
+            elif k == "floor":
+                r = jnp.floor(d.astype(jnp.float64))
+            elif k == "round":     # SQL: half away from zero
+                r = jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5) / mul
+            elif k == "round_even":  # pandas/IEEE: half to even
+                r = jnp.round(x) / mul
+            else:                   # trunc: toward zero
+                r = jnp.trunc(x) / mul
+            if src.kind in ("i", "u"):
+                return r.astype(src.numpy), v
+            return r, v
+        x = d.astype(jnp.float64)
+        fns = {"sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log,
+               "log10": jnp.log10, "log2": jnp.log2, "sin": jnp.sin,
+               "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+               "acos": jnp.arccos, "atan": jnp.arctan,
+               "degrees": jnp.degrees, "radians": jnp.radians}
+        if k not in fns:
+            raise ValueError(f"unknown math fn {k}")
+        return fns[k](x), v
+    if isinstance(e, MaskNull):
+        c, cv = eval_expr(e.cond, tree, dicts, schema)
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        hit = c if cv is None else (c & cv)  # null cond does not mask
+        valid = (~hit) if v is None else (v & ~hit)
+        return d, valid
+    if isinstance(e, CodeLUT):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        lut = jnp.asarray(e.rank_lut())
+        codes = lut[jnp.clip(d.astype(jnp.int32), 0, len(e.strings) - 1)]
+        return codes, v
+    if isinstance(e, DateTrunc):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        src = infer_dtype(e.operand, schema)
+        if src is dt.DATE:
+            ns = d.astype(jnp.int64) * dtops.NS_PER_DAY
+            out = dtops.trunc(e.unit, ns)
+            return jnp.floor_divide(out, dtops.NS_PER_DAY
+                                    ).astype(jnp.int32), v
+        return dtops.trunc(e.unit, d), v
+    if isinstance(e, DateAdd):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        n, nv = eval_expr(e.amount, tree, dicts, schema)
+        src = infer_dtype(e.operand, schema)
+        out_dt = infer_dtype(e, schema)
+        ns = d.astype(jnp.int64) * dtops.NS_PER_DAY if src is dt.DATE \
+            else d.astype(jnp.int64)
+        n = n.astype(jnp.int64)
+        if e.unit in ("month", "quarter", "year"):
+            mult = {"month": 1, "quarter": 3, "year": 12}[e.unit]
+            out = dtops.add_months(ns, n * mult)
+        else:
+            step = {"week": dtops.NS_PER_DAY * 7, "day": dtops.NS_PER_DAY,
+                    "hour": dtops.NS_PER_HOUR, "minute": dtops.NS_PER_MIN,
+                    "second": dtops.NS_PER_SEC}[e.unit]
+            out = ns + n * step
+        if out_dt is dt.DATE:
+            out = jnp.floor_divide(out, dtops.NS_PER_DAY).astype(jnp.int32)
+        valid = None
+        if v is not None or nv is not None:
+            valid = (v if v is not None else jnp.ones(out.shape, bool)) & \
+                    (nv if nv is not None else jnp.ones(out.shape, bool))
+        return out, valid
+    if isinstance(e, DateDiff):
+        la, lv = eval_expr(e.left, tree, dicts, schema)
+        ra, rv = eval_expr(e.right, tree, dicts, schema)
+        lt = infer_dtype(e.left, schema)
+        rt = infer_dtype(e.right, schema)
+        lns = la.astype(jnp.int64) * dtops.NS_PER_DAY if lt is dt.DATE \
+            else la.astype(jnp.int64)
+        rns = ra.astype(jnp.int64) * dtops.NS_PER_DAY if rt is dt.DATE \
+            else ra.astype(jnp.int64)
+        u = e.unit
+        if u == "year":
+            out = dtops.year(rns) - dtops.year(lns)
+        elif u == "quarter":
+            out = (dtops.year(rns) * 4 + (dtops.quarter(rns) - 1)) - \
+                  (dtops.year(lns) * 4 + (dtops.quarter(lns) - 1))
+        elif u == "month":
+            out = dtops.month_index(rns) - dtops.month_index(lns)
+        elif u == "week":
+            out = jnp.floor_divide(dtops.days_from_ns(rns) -
+                                   dtops.dayofweek(rns), 7) - \
+                jnp.floor_divide(dtops.days_from_ns(lns) -
+                                 dtops.dayofweek(lns), 7)
+        else:
+            step = {"day": dtops.NS_PER_DAY, "hour": dtops.NS_PER_HOUR,
+                    "minute": dtops.NS_PER_MIN, "second": dtops.NS_PER_SEC}[u]
+            out = jnp.floor_divide(rns, step) - jnp.floor_divide(lns, step)
+        valid = None
+        if lv is not None or rv is not None:
+            valid = (lv if lv is not None else jnp.ones(out.shape, bool)) & \
+                    (rv if rv is not None else jnp.ones(out.shape, bool))
+        return out.astype(jnp.int64), valid
+    if isinstance(e, StrCodes):
+        d, v = eval_expr(e.operand, tree, dicts, schema)
+        codes = d.astype(jnp.int32)
+        if v is not None:
+            codes = jnp.where(v, codes, np.int32(-1))
+        return codes, None
+    if isinstance(e, (StrLen, StrHostFn)):
         col = e.operand
         transforms = []
         while isinstance(col, DictMap):
             transforms.append(col)
             col = col.operand
-        if not isinstance(col, ColRef):
-            raise TypeError("str.len must apply to a string column")
-        dic = dicts.get(col.name)
-        if dic is None:
-            raise TypeError(f"column {col.name} has no dictionary")
-        vals = list(dic)
+        base_codes = None
+        if isinstance(col, CodeLUT):
+            vals = list(col.sorted_dict())
+            base_codes = eval_expr(col, tree, dicts, schema)
+        elif isinstance(col, ColRef):
+            dic = dicts.get(col.name)
+            if dic is None:
+                raise TypeError(f"column {col.name} has no dictionary")
+            vals = list(dic)
+            base_codes = tree[col.name]
+        else:
+            raise TypeError("string functions must apply to a string column")
         for tr in reversed(transforms):
             vals = [tr.apply_host(s) for s in vals]
-        lut = jnp.asarray(np.array([len(s) for s in vals] or [0],
-                                   dtype=np.int64))
-        d, v = eval_expr(col, tree, dicts, schema)
-        return lut[jnp.clip(d, 0, len(vals) - 1 if vals else 0)], v
+        d, v = base_codes
+        if isinstance(e, StrLen):
+            lut = jnp.asarray(np.array([len(s) for s in vals] or [0],
+                                       dtype=np.int64))
+            return lut[jnp.clip(d, 0, len(vals) - 1 if vals else 0)], v
+        pairs = [e.apply_host(s) for s in vals] or [(0, True)]
+        out_np = np.asarray([p[0] for p in pairs])
+        if e.kind == "to_number":
+            out_np = out_np.astype(np.float64)
+        elif e.kind == "to_date":
+            out_np = out_np.astype(np.int32)
+        else:
+            out_np = out_np.astype(np.int64)
+        lut = jnp.asarray(out_np)
+        codes = jnp.clip(d, 0, len(vals) - 1 if vals else 0)
+        out = lut[codes]
+        ok = np.asarray([p[1] for p in pairs], dtype=bool)
+        if not ok.all():
+            okv = jnp.asarray(ok)[codes]
+            v = okv if v is None else (v & okv)
+        return out, v
     if isinstance(e, StrPredicate):
         col = e.operand
         transforms = []
         while isinstance(col, DictMap):  # compose host transforms
             transforms.append(col)
             col = col.operand
-        if not isinstance(col, ColRef):
+        if isinstance(col, CodeLUT):
+            dic = list(col.sorted_dict())
+            d, v = eval_expr(col, tree, dicts, schema)
+        elif isinstance(col, ColRef):
+            dic0 = dicts.get(col.name)
+            if dic0 is None:
+                raise TypeError(f"column {col.name} has no dictionary")
+            dic = list(dic0)
+            d, v = tree[col.name]
+        else:
             raise TypeError("string predicates must apply to a column")
-        dic = dicts.get(col.name)
-        if dic is None:
-            raise TypeError(f"column {col.name} has no dictionary")
         if transforms:
             for tr in reversed(transforms):
                 dic = [tr.apply_host(s) for s in dic]
@@ -512,13 +940,14 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
                 lut[i] = s.endswith(tuple(pats))
             elif e.kind == "match":
                 lut[i] = re.match(pats[0], s) is not None
+            elif e.kind == "fullmatch":
+                lut[i] = re.fullmatch(pats[0], s) is not None
             elif e.kind == "eq_any":
                 lut[i] = s in pats
             elif e.kind == "lower_eq":
                 lut[i] = s.lower() == pats[0]
             else:
                 raise ValueError(f"unknown str predicate {e.kind}")
-        d, v = tree[col.name]
         res = jnp.asarray(lut)[jnp.clip(d, 0, len(dic) - 1)]
         return res, v
     if isinstance(e, Where):
@@ -589,6 +1018,10 @@ def eval_expr(e: Expr, tree: Dict[str, Tuple], dicts: Dict[str, np.ndarray],
                     (rv if rv is not None else jnp.ones(rd.shape, bool))
         if e.op in _CMP:
             return _CMP[e.op](ld, rd), valid
+        if e.op == "max2":   # GREATEST/LEAST (null if either side null)
+            return jnp.maximum(ld, rd), valid
+        if e.op == "min2":
+            return jnp.minimum(ld, rd), valid
         if e.op == "+":
             return ld + rd, valid
         if e.op == "-":

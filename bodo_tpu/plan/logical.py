@@ -431,4 +431,15 @@ def _arrow_field_dtype(typ) -> dt.DType:
         return dt.DATE
     if pa.types.is_duration(typ):
         return dt.TIMEDELTA
+    if pa.types.is_struct(typ):
+        from bodo_tpu.io.arrow_bridge import _arrow_scalar_dtype
+        return dt.struct_of([(f.name, _arrow_scalar_dtype(f.type))
+                             for f in typ])
+    if pa.types.is_map(typ):
+        from bodo_tpu.io.arrow_bridge import _arrow_scalar_dtype
+        return dt.map_of(_arrow_scalar_dtype(typ.key_type),
+                         _arrow_scalar_dtype(typ.item_type))
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ):
+        from bodo_tpu.io.arrow_bridge import _arrow_scalar_dtype
+        return dt.list_of(_arrow_scalar_dtype(typ.value_type))
     return dt.from_numpy(np.dtype(typ.to_pandas_dtype()))

@@ -178,6 +178,21 @@ def _substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
         return Where(_substitute(e.cond, mapping),
                      _substitute(e.iftrue, mapping),
                      _substitute(e.iffalse, mapping))
+    # generic frozen-dataclass walk for the remaining node kinds (SQL
+    # kernel-library exprs: MathFn/CodeLUT/StrConcat/DateAdd/...)
+    import dataclasses
+    if dataclasses.is_dataclass(e):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, Expr):
+                changes[f.name] = _substitute(v, mapping)
+            elif isinstance(v, tuple) and any(isinstance(x, Expr)
+                                              for x in v):
+                changes[f.name] = tuple(
+                    _substitute(x, mapping) if isinstance(x, Expr) else x
+                    for x in v)
+        return dataclasses.replace(e, **changes) if changes else e
     raise TypeError(f"substitute: {e}")
 
 

@@ -38,7 +38,9 @@ from bodo_tpu.table import dtypes as dt
 from bodo_tpu.table.dict_utils import unify_dictionaries
 from bodo_tpu.table.table import Column, ONED, REP, Table, round_capacity
 
-_jit_cache: Dict = {}
+from bodo_tpu.utils.kernel_cache import KernelCache
+
+_jit_cache = KernelCache(maxsize=config.kernel_cache_size)
 
 
 def _schema(t: Table) -> Dict[str, dt.DType]:
@@ -93,33 +95,120 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     Top-level DictMap expressions (string→string transforms) are handled
     host-side: the translation runs on the dictionary, the device only
     remaps codes."""
-    from bodo_tpu.plan.expr import ColRef, DictMap
+    from bodo_tpu.plan.expr import (MAX_CONCAT_DICT, CodeLUT, ColRef,
+                                    DictMap, Expr as _Expr, StrConcat,
+                                    StrToList, eval_expr as _eval)
     dictmaps = {n: e for n, e in new.items() if isinstance(e, DictMap)}
-    new = {n: e for n, e in new.items() if n not in dictmaps}
+    strcats = {n: e for n, e in new.items() if isinstance(e, StrConcat)}
+    strsplits = {n: e for n, e in new.items() if isinstance(e, StrToList)}
+    new = {n: e for n, e in new.items()
+           if n not in dictmaps and n not in strcats
+           and n not in strsplits}
     dm_cols: Dict[str, Column] = {}
-    for n, e in dictmaps.items():
-        # compose nested transforms (upper(substring(...))) down to the
-        # base column, mirroring the StrPredicate eval path
-        chain = [e]
-        base = e.operand
+
+    def _str_part(e):
+        """Resolve a string-producing expr to (vals, codes, valid)."""
+        chain = []
+        base = e
         while isinstance(base, DictMap):
             chain.append(base)
             base = base.operand
-        if not isinstance(base, ColRef):
-            raise TypeError("DictMap must apply to a string column")
-        src = t.columns[base.name]
-        old_dict = src.dictionary if src.dictionary is not None else \
-            np.array([], dtype=str)
-        vals = list(old_dict)
+        if isinstance(base, ColRef):
+            src = t.columns[base.name]
+            if src.dtype is not dt.STRING:
+                raise NotImplementedError(
+                    f"string function over non-string column "
+                    f"{base.name!r} ({src.dtype.name}) — cast to varchar "
+                    f"is not supported")
+            vals = list(src.dictionary if src.dictionary is not None else [])
+            data, valid = src.data, src.valid
+        elif isinstance(base, CodeLUT):
+            data, valid = _eval(base, t.device_data(), _dicts(t), _schema(t))
+            vals = list(base.sorted_dict())
+        else:
+            raise TypeError(f"unsupported string part {base}")
+        ok = None
         for tr in reversed(chain):
+            # null-producing transforms (regexp_substr no-match, get
+            # out-of-range): record per-entry validity before mapping
+            hit = [not tr.host_null(s) for s in vals]
+            if not all(hit):
+                ok = hit if ok is None else [a & b for a, b in zip(ok, hit)]
             vals = [tr.apply_host(s) for s in vals]
+        if ok is not None and not all(ok):
+            lut = jnp.asarray(np.asarray(ok, dtype=bool))
+            okv = lut[jnp.clip(data, 0, max(len(vals) - 1, 0))]
+            valid = okv if valid is None else (valid & okv)
+        return vals, data, valid
+
+    for n, e in strcats.items():
+        # mixed-radix codes over the per-part dictionaries; the combined
+        # dictionary is their cross product (host-side, gated)
+        col_parts = []   # (vals, codes, valid)
+        layout = []      # str literal | index into col_parts
+        for p in e.parts:
+            if isinstance(p, str):
+                layout.append(p)
+            elif isinstance(p, _Expr):
+                layout.append(len(col_parts))
+                col_parts.append(_str_part(p))
+            else:
+                raise TypeError(f"bad concat part {p!r}")
+        import math as _math
+        total = _math.prod(max(len(v), 1) for v, _, _ in col_parts)
+        if total > MAX_CONCAT_DICT:
+            raise NotImplementedError(
+                f"concat dictionary cross-product too large ({total})")
+        import itertools
+        combos = itertools.product(
+            *[v if len(v) else [""] for v, _, _ in col_parts])
+        combined = np.array(
+            ["".join(item if isinstance(item, str) else combo[item]
+                     for item in layout)
+             for combo in combos], dtype=str)
+        nd, remap = (np.unique(combined, return_inverse=True)
+                     if len(combined) else (combined, np.zeros(0, np.int64)))
+        code = None
+        valid = None
+        stride = total
+        for vals, d, v in col_parts:
+            k = max(len(vals), 1)
+            stride //= k
+            term = jnp.clip(d.astype(jnp.int64), 0, k - 1) * stride
+            code = term if code is None else code + term
+            if v is not None:
+                valid = v if valid is None else (valid & v)
+        if code is None:  # all-literal concat
+            code = jnp.zeros((t.capacity,), jnp.int64)
+        mp = jnp.asarray(remap.astype(np.int32) if len(remap)
+                         else np.zeros(1, np.int32))
+        dm_cols[n] = Column(mp[code], valid, dt.STRING, nd)
+
+    for n, e in strsplits.items():
+        # str.split(expand=False): split each dictionary entry, encode
+        # the distinct result tuples as a list<string> dictionary
+        vals, data, valid = _str_part(e.operand)
+        parts = [e.split_host(s) for s in vals]
+        uniq = sorted(set(parts))
+        index = {v: i for i, v in enumerate(uniq)}
+        remap = np.array([index[p] for p in parts] or [0], dtype=np.int32)
+        codes = jnp.asarray(remap)[jnp.clip(data, 0, max(len(vals) - 1, 0))]
+        dic_obj = np.empty(len(uniq), dtype=object)
+        for i, v in enumerate(uniq):
+            dic_obj[i] = v
+        dm_cols[n] = Column(codes, valid, dt.list_of(dt.STRING), dic_obj)
+
+    for n, e in dictmaps.items():
+        # compose nested transforms (upper(substring(...))) down to the
+        # base column/CodeLUT, mirroring the StrPredicate eval path
+        vals, data, valid = _str_part(e)
         mapped = np.array(vals, dtype=str)
         nd, remap = (np.unique(mapped, return_inverse=True)
                      if len(mapped) else (mapped, np.zeros(0, np.int64)))
         mp = jnp.asarray(remap.astype(np.int32) if len(remap)
                          else np.zeros(1, np.int32))
-        codes = mp[jnp.clip(src.data, 0, max(len(old_dict) - 1, 0))]
-        dm_cols[n] = Column(codes, src.valid, dt.STRING, nd)
+        codes = mp[jnp.clip(data, 0, max(len(vals) - 1, 0))]
+        dm_cols[n] = Column(codes, valid, dt.STRING, nd)
 
     schema = _schema(t)
     dicts = _dicts(t)
@@ -148,7 +237,10 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
         # numeric outputs drop stale dictionaries
         for n, e in new.items():
             c = res.columns[n]
-            if c.dtype is dt.STRING and isinstance(e, ColRef):
+            if isinstance(e, CodeLUT):
+                res.columns[n] = Column(c.data.astype(np.int32), c.valid,
+                                        dt.STRING, e.sorted_dict())
+            elif c.dtype is dt.STRING and isinstance(e, ColRef):
                 res.columns[n] = Column(c.data, c.valid, c.dtype,
                                         t.columns[e.name].dictionary)
             elif c.dtype is not dt.STRING:
@@ -1265,23 +1357,6 @@ def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
     exchange bodo/hiframes/rolling.py, dist_cumsum via MPI_Exscan)."""
     from bodo_tpu.ops import window as W
     specs = [(c, op, p, o) for c, op, p, o in specs]
-    # halo limitation: a rolling/shift halo only reaches one shard back;
-    # if any predecessor shard (including empty ones — they forward an
-    # all-invalid halo) holds fewer real rows than the halo needs, run on
-    # the gathered table instead. rolling(w) needs w-1 donor rows,
-    # shift/diff(n) needs n.
-    if t.distribution == ONED and len(t.counts) > 1:
-        halo_need = 0
-        for _, op, p, _ in specs:
-            if op.startswith("rolling_"):
-                halo_need = max(halo_need, int(p) - 1)
-            elif op in ("shift", "diff"):
-                halo_need = max(halo_need, int(p))
-        donor_counts = [int(c) for c in t.counts[:-1]]
-        if halo_need > 0 and donor_counts and \
-                min(donor_counts) < halo_need:
-            res = window_table(t.gather(), specs)
-            return res.shard()
     names = t.names
     key = ("window", _mesh_key(mesh_mod.get_mesh()), _sig(t),
            tuple(specs), t.distribution)
@@ -1307,14 +1382,13 @@ def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
                     out[oname] = (comb, None)
                 elif op.startswith("rolling_"):
                     w = int(param)
-                    hx, hok = W.tail_rows(x, v, count, w - 1) if w > 1 else \
-                        (jnp.zeros(0), jnp.zeros(0, bool))
                     if sharded and w > 1:
-                        hx = C.ring_shift(hx, 1, ax)
-                        hok = C.ring_shift(hok, 1, ax)
-                        hok = hok & (C.rank(ax) != 0)
+                        # halo spans as many predecessor shards as
+                        # needed (short/empty donors included)
+                        hx, hok = W.multi_hop_halo(x, v, count, w - 1, ax)
                     else:  # single block: no predecessor
-                        hok = jnp.zeros_like(hok)
+                        hx = jnp.zeros(max(w - 1, 0))
+                        hok = jnp.zeros(max(w - 1, 0), bool)
                     res = W.rolling_local(op[len("rolling_"):], w, x, v,
                                           count, hx, hok, goff)
                     out[oname] = (res, None)
@@ -1325,13 +1399,11 @@ def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
                     out[oname] = (jnp.where(padmask, rid, -1), None)
                 elif op in ("shift", "diff"):
                     n = int(param)
-                    hx, hok = W.tail_rows(x, v, count, n)
                     if sharded:
-                        hx = C.ring_shift(hx, 1, ax)
-                        hok = C.ring_shift(hok, 1, ax)
-                        hok = hok & (C.rank(ax) != 0)
+                        hx, hok = W.multi_hop_halo(x, v, count, n, ax)
                     else:
-                        hok = jnp.zeros_like(hok)
+                        hx = jnp.zeros(n)
+                        hok = jnp.zeros(n, bool)
                     sh, sok = W.shift_local(x, v, count, hx, hok, n)
                     if op == "diff":
                         cap = x.shape[0]
@@ -1391,9 +1463,12 @@ def rank_window(t: Table, partition_by: Sequence[str],
         t = local
     if t.distribution == ONED:
         if not partition_by:
-            # global ranking needs a total order — gather (rare path)
-            return rank_window(t.gather(), partition_by, order_by, specs,
-                               ascending, na_last).shard()
+            # global ranking: distributed sample sort on the order keys,
+            # then exscan'd row offsets + cross-shard tie carries — no
+            # gather (reference: streaming window over sorted runs,
+            # bodo/libs/streaming/_window.cpp)
+            return _global_rank_sharded(t, order_by, specs,
+                                        tuple(ascending), na_last)
         keep = t.names
         t2 = window_table(t, [(t.names[0], "rowid", None, "__rid")])
         t2 = shuffle_by_key(t2, partition_by)
@@ -1403,6 +1478,114 @@ def rank_window(t: Table, partition_by: Sequence[str],
         return out.select(keep + [o for _, _, o in specs])
     return _rank_window_exec(t, partition_by, order_by, specs,
                              tuple(ascending), na_last)
+
+
+def _global_rank_sharded(t: Table, order_by, specs, ascending,
+                         na_last: bool) -> Table:
+    """No-partition ranking over the whole table, distributed: sort by
+    the order keys (sample sort), then compute ranks with exscan row
+    offsets and typed cross-shard tie detection; restore original row
+    order via the carried rowid."""
+    from bodo_tpu.ops import window as W
+    keep = t.names
+    t2 = window_table(t, [(t.names[0], "rowid", None, "__rid")])
+    if order_by:
+        t2 = sort_table(t2, list(order_by), list(ascending), na_last)
+    else:
+        # no ORDER BY: original row order is the total order already
+        pass
+    m = mesh_mod.get_mesh()
+    ax = config.data_axis
+    ob = list(order_by)
+    kspecs = tuple((op, int(p or 0), o) for op, p, o in specs)
+    key = ("grank", _mesh_key(m), _sig(t2), tuple(ob), kspecs,
+           t2.distribution)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        def body(tree, counts):
+            count = counts[0]
+            some = tree["__rid"][0]
+            cap = some.shape[0]
+            padmask = K.row_mask(count, cap)
+            goff = C.dist_exscan_sum(count, ax)
+            total = C.dist_sum(count, ax)
+            gidx = goff + jnp.arange(cap, dtype=jnp.int64)  # 0-based
+            # tie flags: row differs from the previous real row in ANY
+            # order column (typed compares; nulls tie with nulls)
+            if ob:
+                new = jnp.zeros(cap, bool)
+                for name in ob:
+                    x, v = tree[name]
+                    pv, pok, pexists = W.prev_last_value(x, v, count, ax)
+                    ok = K.value_ok(x, v, padmask)
+                    prev_x = jnp.concatenate([pv[None], x[:-1]])
+                    prev_ok = jnp.concatenate([pok[None], ok[:-1]])
+                    first_global = (gidx == 0)
+                    # nulls tie with nulls: value compare only when both
+                    # sides are real; a validity transition breaks a run
+                    diff = (ok & prev_ok & (prev_x != x)) | (prev_ok != ok)
+                    # row 0 of shard compares against predecessor's last
+                    # row; the very first global row always starts a run
+                    is_first_local = jnp.arange(cap) == 0
+                    no_pred = is_first_local & ~pexists
+                    new = new | diff | no_pred | first_global
+            else:
+                # no ORDER BY: every row is a peer — one global run
+                # (RANK/DENSE_RANK = 1; ROW_NUMBER still positional)
+                new = gidx == 0
+            # rank (min): global index of the run head ≤ this row.
+            # local segment cummax + running-max carry across shards
+            head = jnp.where(new & padmask, gidx, -1)
+            loc = jax.lax.cummax(head)
+            carry = jnp.max(jnp.where(padmask, head, -1))
+            prefix = W.cum_carry_exscan("cummax", carry.astype(jnp.float64),
+                                        ax)
+            # shard 0's prefix is -inf; clamp to the head sentinel (-1)
+            # before the int cast (float->int of -inf is saturation-
+            # defined, not portable)
+            prefix = jnp.maximum(prefix, -1.0).astype(jnp.int64)
+            run_head = jnp.maximum(loc, prefix)
+            # dense rank: cumsum of run-head flags + exscan carry
+            nf = (new & padmask).astype(jnp.int64)
+            dloc = jnp.cumsum(nf)
+            dcarry = jnp.sum(nf)
+            dprefix = W.cum_carry_exscan("cumsum",
+                                         dcarry.astype(jnp.float64), ax)
+            dense = dloc + dprefix.astype(jnp.int64)
+            out = []
+            for op, param, _ in kspecs:
+                if op == "row_number":
+                    r = gidx + 1
+                elif op == "cumcount":
+                    r = gidx
+                elif op == "rank":
+                    r = run_head + 1
+                elif op == "dense_rank":
+                    r = dense
+                elif op == "ntile":
+                    n = jnp.asarray(param, jnp.int64)
+                    small = total // n
+                    rem = total - small * n
+                    # first `rem` buckets get (small+1) rows
+                    cut = rem * (small + 1)
+                    r = jnp.where(
+                        gidx < cut,
+                        gidx // jnp.maximum(small + 1, 1),
+                        rem + (gidx - cut) // jnp.maximum(small, 1)) + 1
+                else:
+                    raise ValueError(f"unknown rank op {op}")
+                out.append(jnp.where(padmask, r.astype(jnp.int64), 0))
+            return tuple(out)
+
+        fn = jax.jit(C.smap(body, in_specs=(P(ax), P(ax)),
+                            out_specs=P(ax), mesh=m))
+        _jit_cache[key] = fn
+    outs = fn(t2.device_data(), t2.counts_device())
+    res = t2.with_columns(t2.columns)
+    for (op, p, oname), d in zip(kspecs, outs):
+        res.columns[oname] = Column(d, None, dt.INT64, None)
+    res = sort_table(res, ["__rid"])
+    return res.select(keep + [o for _, _, o in specs])
 
 
 def _rank_window_exec(t: Table, partition_by, order_by, specs,
@@ -1469,6 +1652,25 @@ def agg_window(t: Table, partition_by: Sequence[str],
         t = local
     if t.distribution == ONED:
         if not partition_by:
+            whole = (not order_by) and all(
+                tuple(frame) == ("all",) and
+                op in ("sum", "sum0", "mean", "min", "max", "count")
+                for op, _, frame, *_ in specs)
+            if whole:
+                # SUM(x) OVER () etc.: one distributed reduction
+                # (psum-combined partials), broadcast back — no gather
+                rmap = {"sum": "sumnull", "sum0": "sum"}
+                vals = reduce_table(
+                    t, [(c, rmap.get(op, op), o)
+                        for op, c, frame, p, o in specs])
+                res = t.with_columns(dict(t.columns))
+                for op, c, frame, p, o in specs:
+                    res.columns[o] = _broadcast_scalar_column(
+                        t, vals[o], count_like=(op == "count"))
+                return res
+            # ordered global frames (running totals over a total order)
+            # still gather — rare at scale; the sorted+carry treatment
+            # used by _global_rank_sharded extends here later
             return agg_window(t.gather(), partition_by, order_by, specs,
                               ascending, na_last).shard()
         keep = t.names
@@ -1489,6 +1691,58 @@ def agg_window(t: Table, partition_by: Sequence[str],
         return out.select(keep + [o for *_, o in specs])
     return _agg_window_exec(t, partition_by, order_by, specs,
                             tuple(ascending), na_last)
+
+
+def _broadcast_scalar_column(t: Table, v, count_like: bool) -> Column:
+    """A whole-table scalar broadcast to every row of a (possibly
+    sharded) table — the OVER () window result column."""
+    import datetime as _dtmod
+    import decimal as pydec
+
+    import pandas as pd
+    cap = t.capacity
+    invalid = False
+    if count_like:
+        arr = np.full(cap, 0 if v is None else int(v), np.int64)
+        dtype = dt.INT64
+    elif v is None or (isinstance(v, float) and np.isnan(v)) or v is pd.NaT:
+        arr = np.zeros(cap, np.float64)
+        dtype = dt.FLOAT64
+        invalid = True
+    elif isinstance(v, pd.Timestamp):
+        arr = np.full(cap, v.value, np.int64)
+        dtype = dt.DATETIME
+    elif isinstance(v, (pd.Timedelta, np.timedelta64)):
+        ns = pd.Timedelta(v).value
+        arr = np.full(cap, ns, np.int64)
+        dtype = dt.TIMEDELTA
+    elif isinstance(v, _dtmod.date) and not isinstance(v, _dtmod.datetime):
+        days = (np.datetime64(v, "D") - np.datetime64(0, "D")).astype(int)
+        arr = np.full(cap, days, np.int32)
+        dtype = dt.DATE
+    elif isinstance(v, pydec.Decimal):
+        # keep the exact fixed-point domain (scaled int64)
+        scale = max(0, -int(v.as_tuple().exponent))
+        arr = np.full(cap, int(v.scaleb(scale)), np.int64)
+        dtype = dt.decimal(scale)
+    elif isinstance(v, (bool, np.bool_)):
+        arr = np.full(cap, bool(v), bool)
+        dtype = dt.BOOL
+    elif isinstance(v, (int, np.integer)):
+        arr = np.full(cap, int(v), np.int64)
+        dtype = dt.INT64
+    else:
+        arr = np.full(cap, float(v), np.float64)
+        dtype = dt.FLOAT64
+    if t.distribution == ONED:
+        data = jax.device_put(arr, mesh_mod.row_sharding())
+        valid = (jax.device_put(np.zeros(cap, bool),
+                                mesh_mod.row_sharding())
+                 if invalid else None)
+    else:
+        data = jnp.asarray(arr)
+        valid = jnp.asarray(np.zeros(cap, bool)) if invalid else None
+    return Column(data, valid, dtype, None)
 
 
 def _agg_window_exec(t: Table, partition_by, order_by, specs,
@@ -1857,6 +2111,26 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
     tree = {n: out[i] for i, n in enumerate(korder)}
     res = t.with_device_data(tree, nrows=int(counts.sum()), counts=counts)
     return shrink_to_fit(res.select(names))
+
+
+def shard_frames(t: Table) -> List:
+    """Decode each shard of a 1D table into its own host DataFrame
+    (rank-local view after a shuffle — the frame a reference worker
+    would hold; used by groupby.apply's per-shard UDF execution)."""
+    if t.distribution != ONED:
+        return [t.to_pandas()]
+    per = t.shard_capacity
+    out = []
+    for s in range(t.num_shards):
+        cols = {}
+        for n, c in t.columns.items():
+            sl = slice(s * per, (s + 1) * per)
+            cols[n] = Column(c.data[sl],
+                             None if c.valid is None else c.valid[sl],
+                             c.dtype, c.dictionary)
+        sub = Table(cols, int(t.counts[s]), REP, None)
+        out.append(sub.to_pandas())
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -573,13 +573,13 @@ class Parser:
         return self.cmp_expr()
 
     def cmp_expr(self):
-        e = self.add_expr()
+        e = self.concat_expr()
         while True:
             t, v = self.peek()
             if t == "op" and v in ("=", "<>", "!=", "<", "<=", ">", ">="):
                 self.i += 1
                 op = {"=": "==", "<>": "!="}.get(v, v)
-                e = BinA(op, e, self.add_expr())
+                e = BinA(op, e, self.concat_expr())
             elif self.kw("IS"):
                 self.eat_kw("IS")
                 neg = self.try_kw("NOT")
@@ -589,9 +589,9 @@ class Parser:
                                         self.peek(1)[1].upper() == "BETWEEN"):
                 neg = self.try_kw("NOT")
                 self.eat_kw("BETWEEN")
-                lo = self.add_expr()
+                lo = self.concat_expr()
                 self.eat_kw("AND")
-                hi = self.add_expr()
+                hi = self.concat_expr()
                 e = Between(e, lo, hi, neg)
             elif self.kw("IN") or (self.kw("NOT") and
                                    self.peek(1)[1].upper() == "IN"):
@@ -620,6 +620,21 @@ class Parser:
             else:
                 return e
 
+    def concat_expr(self):
+        e = self.add_expr()
+        while True:
+            t, v = self.peek()
+            if t == "op" and v == "||":
+                self.i += 1
+                rhs = self.add_expr()
+                # flatten a || b || c into one CONCAT call
+                if isinstance(e, Func) and e.name == "concat":
+                    e = Func("concat", e.args + [rhs])
+                else:
+                    e = Func("concat", [e, rhs])
+            else:
+                return e
+
     def add_expr(self):
         e = self.mul_expr()
         while True:
@@ -627,8 +642,6 @@ class Parser:
             if t == "op" and v in ("+", "-"):
                 self.i += 1
                 e = BinA(v, e, self.mul_expr())
-            elif t == "op" and v == "||":
-                raise NotImplementedError("string concat ||")
             else:
                 return e
 
@@ -747,6 +760,12 @@ class Parser:
             sub = self.select_stmt()
             self.eat_op(")")
             return Exists(sub)
+        # LEFT/RIGHT are join keywords but also scalar functions when
+        # immediately followed by an argument list
+        if t == "kw" and v.upper() in ("LEFT", "RIGHT") and \
+                self.peek(1) == ("op", "("):
+            t = "id"
+            self.toks[self.i] = ("id", v)
         if t == "id":
             name = self.ident()
             if self.try_op("("):           # function call

@@ -292,6 +292,9 @@ class Planner:
         if sel.from_item is None:
             raise NotImplementedError("SELECT without FROM")
         plan, scope = self._plan_from_where(sel.from_item, sel.where, outer)
+        # schema in scope for dtype-sensitive lowering (CAST to varchar)
+        prev_schema = getattr(self, "_cur_schema", None)
+        self._cur_schema = plan.schema
 
         # window-function extraction: each OVER (...) item is replaced by
         # a placeholder column now and planned as a RankWindow/AggWindow
@@ -474,6 +477,7 @@ class Planner:
             plan = L.Projection(plan, [(n, ColRef(n)) for n in out_names])
         if sel.limit is not None:
             plan = L.Limit(plan, sel.limit)
+        self._cur_schema = prev_schema
         return plan, out_names
 
     _WINDOW_FUNCS = {"row_number": "row_number", "rank": "rank",
@@ -1168,13 +1172,33 @@ class Planner:
             if ty is None:
                 raise NotImplementedError(f"CAST to {e.to}")
             if ty is dt.STRING:
+                # identity ONLY for string-typed operands (the common
+                # CAST(strcol AS varchar) form); numeric→varchar has no
+                # bounded dictionary and stays unsupported
+                sch = getattr(self, "_cur_schema", None)
+                if sch is not None:
+                    try:
+                        src_t = infer_dtype(x, sch)
+                    except Exception:
+                        src_t = None
+                    if src_t is dt.STRING:
+                        return x
+                    if src_t is not None:
+                        raise NotImplementedError(
+                            f"CAST({src_t.name}) to varchar")
+                from bodo_tpu.plan.expr import (CodeLUT as _CL,
+                                                StrConcat as _SC)
+                if isinstance(x, (DictMap, _CL, _SC)) or \
+                        (isinstance(x, Lit) and isinstance(x.value, str)):
+                    return x
                 raise NotImplementedError("CAST to varchar")
             return Cast(x, ty)
         if isinstance(e, P.Extract):
             return DtField(e.field, self._expr(e.operand, scope))
         if isinstance(e, P.Func):
             if e.name in ("year", "month", "day", "hour", "minute", "second",
-                          "quarter", "dayofweek", "dayofyear"):
+                          "quarter", "dayofweek", "dayofyear", "week",
+                          "weekofyear"):
                 return DtField(e.name, self._expr(e.args[0], scope))
             if e.name in ("upper", "lower"):
                 return DictMap(e.name, (), self._expr(e.args[0], scope))
@@ -1187,7 +1211,9 @@ class Planner:
             if e.name == "abs":
                 x = self._expr(e.args[0], scope)
                 return Where(BinOp("<", x, Lit(0)), UnOp("neg", x), x)
-            raise NotImplementedError(f"function {e.name}")
+            from bodo_tpu.sql import kernels as K
+            return K.lower_func(e.name, [self._expr(a, scope)
+                                         for a in e.args])
         if isinstance(e, P.SubstringA):
             return DictMap("substring", (e.start, e.length),
                            self._expr(e.operand, scope))

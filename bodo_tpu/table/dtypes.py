@@ -94,6 +94,71 @@ def is_decimal(t: DType) -> bool:
     return t.kind == "dec"
 
 
+@dataclass(frozen=True)
+class ListDType(DType):
+    """Variable-length list column. Physical device repr is an int32
+    code into a host-side dictionary of unique list values (tuples),
+    sorted — the same dict-encoding strategy as strings, so every
+    row-reshaping kernel (filter/join/sort/shuffle) handles list columns
+    unchanged. Reference: bodo/libs/array_item_arr_ext.py (offsets+child
+    repr; here variable-length data stays host-side by design)."""
+    elem: DType = None
+
+
+@dataclass(frozen=True)
+class StructDType(DType):
+    """Struct column: int32 codes into a host dictionary of unique
+    field-value tuples. Reference: bodo/libs/struct_arr_ext.py."""
+    fields: tuple = ()          # ((name, DType), ...)
+
+
+@dataclass(frozen=True)
+class MapDType(DType):
+    """Map column = list<struct<key, value>> encoded the same way.
+    Reference: bodo/libs/map_arr_ext.py."""
+    key: DType = None
+    value: DType = None
+
+
+_NESTED: dict = {}
+
+
+def list_of(elem: DType) -> ListDType:
+    t = _NESTED.get(("list", elem.name))
+    if t is None:
+        t = ListDType(f"list<{elem.name}>", "int32", "list", elem)
+        _NESTED[("list", elem.name)] = t
+        _BY_NAME[t.name] = t
+    return t
+
+
+def struct_of(fields) -> StructDType:
+    fields = tuple((n, t) for n, t in fields)
+    key = ("struct", tuple((n, t.name) for n, t in fields))
+    t = _NESTED.get(key)
+    if t is None:
+        inner = ", ".join(f"{n}: {ft.name}" for n, ft in fields)
+        t = StructDType(f"struct<{inner}>", "int32", "struct", fields)
+        _NESTED[key] = t
+        _BY_NAME[t.name] = t
+    return t
+
+
+def map_of(key_t: DType, val_t: DType) -> MapDType:
+    key = ("map", key_t.name, val_t.name)
+    t = _NESTED.get(key)
+    if t is None:
+        t = MapDType(f"map<{key_t.name}, {val_t.name}>", "int32", "map",
+                     key_t, val_t)
+        _NESTED[key] = t
+        _BY_NAME[t.name] = t
+    return t
+
+
+def is_nested(t: DType) -> bool:
+    return t.kind in ("list", "struct", "map")
+
+
 def by_name(name: str) -> DType:
     return _BY_NAME[name]
 

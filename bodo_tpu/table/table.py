@@ -67,6 +67,19 @@ class Column:
         cap = capacity if capacity is not None else round_capacity(n)
         if arr.dtype == object and _looks_decimal(arr):
             return _decimal_column(arr, cap, valid)
+        if arr.dtype == object:
+            from bodo_tpu.table import nested as _nested
+            nt = _nested.infer_nested_dtype(arr)
+            if nt is not None:
+                vals = list(arr)
+                if valid is not None:
+                    vals = [v if ok else None
+                            for v, ok in zip(vals, valid)]
+                if nt.kind == "struct":
+                    vals = [None if v is None else
+                            tuple(v.get(fn) for fn, _ in nt.fields)
+                            for v in vals]
+                return _nested.encode_values(vals, nt, capacity=cap)
         dtype = dt.from_numpy(arr.dtype)
         dictionary = None
         if dtype is dt.STRING:
@@ -110,6 +123,9 @@ class Column:
     # ---- materialization -------------------------------------------------
     def to_numpy(self, nrows: int):
         """Decode the first `nrows` real rows to a host numpy/object array."""
+        if dt.is_nested(self.dtype):
+            from bodo_tpu.table import nested as _nested
+            return _nested.decode_column(self, nrows)
         data = np.asarray(jax.device_get(self.data))[:nrows]
         valid = (np.asarray(jax.device_get(self.valid))[:nrows]
                  if self.valid is not None else None)
@@ -134,6 +150,13 @@ class Column:
             out = data.view("timedelta64[ns]").copy()
             if valid is not None:
                 out[~valid] = np.timedelta64("NaT")
+            return out
+        if self.dtype is dt.DATE:
+            # days-since-epoch → object array of datetime.date (what
+            # pandas' .dt.date produces), None for nulls
+            out = data.astype("datetime64[D]").astype(object)
+            if valid is not None:
+                out[~valid] = None
             return out
         if self.dtype.kind == "dec":
             import decimal as pydec

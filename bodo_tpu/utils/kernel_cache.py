@@ -1,0 +1,51 @@
+"""Bounded LRU cache for compiled kernels.
+
+Long sessions (and the 400+-test suite) compile thousands of distinct
+jitted kernels; pinning them all forever exhausts XLA:CPU's JIT code
+memory and eventually segfaults the compiler. The reference contains
+the same class of leak per test *module* by running each module in its
+own subprocess (bodo/runtests.py:58). Here the engine itself stays
+healthy: kernel caches evict least-recently-used entries so dropped
+executables are garbage-collected.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class KernelCache:
+    """Dict-shaped LRU with the two operations the kernel caches use
+    (`get` and item assignment)."""
+
+    def __init__(self, maxsize: int = 1024):
+        self.maxsize = maxsize
+        self._d: OrderedDict = OrderedDict()
+        self.evictions = 0
+
+    def get(self, key, default=None):
+        try:
+            self._d.move_to_end(key)
+            return self._d[key]
+        except KeyError:
+            return default
+
+    def __setitem__(self, key, value):
+        if key in self._d:
+            self._d.move_to_end(key)
+        self._d[key] = value
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def __contains__(self, key):
+        return key in self._d
+
+    def __len__(self):
+        return len(self._d)
+
+    def pop(self, key, default=None):
+        return self._d.pop(key, default)
+
+    def clear(self):
+        self._d.clear()

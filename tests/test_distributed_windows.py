@@ -1,0 +1,153 @@
+"""Distributed window paths that previously fell back to gather():
+multi-hop rolling/shift halos across short and empty donor shards, and
+global (no-PARTITION BY) ranking via sample sort + exscan carries.
+
+VERDICT r2 weak #4."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _sharded(pdf):
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.pandas_api.frame import BodoDataFrame
+    from bodo_tpu.plan.physical import execute
+    t = execute(bd.from_pandas(pdf)._plan).shard()
+    return BodoDataFrame(L.FromPandas(t))
+
+
+def test_rolling_halo_wider_than_shard(mesh8):
+    # 40 rows over 8 shards = 5/shard; window 13 spans 3 predecessor
+    # shards — the old one-hop halo had to gather here
+    r = np.random.default_rng(0)
+    pdf = pd.DataFrame({"v": r.normal(size=40)})
+    bdf = _sharded(pdf)
+    got = bdf["v"].rolling(13).sum().to_pandas()
+    exp = pdf["v"].rolling(13).sum()
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(),
+                               rtol=1e-9, equal_nan=True)
+
+
+def test_shift_across_multiple_shards(mesh8):
+    r = np.random.default_rng(1)
+    pdf = pd.DataFrame({"v": r.normal(size=30)})
+    bdf = _sharded(pdf)
+    for n in (1, 7, 23):
+        got = bdf["v"].shift(n).to_pandas()
+        exp = pdf["v"].shift(n)
+        np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(),
+                                   rtol=1e-9, equal_nan=True)
+
+
+def test_rolling_with_empty_shards(mesh8):
+    # fewer rows than shards: some shards are empty donors
+    pdf = pd.DataFrame({"v": np.arange(5, dtype=np.float64)})
+    bdf = _sharded(pdf)
+    got = bdf["v"].rolling(3).mean().to_pandas()
+    exp = pdf["v"].rolling(3).mean()
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(),
+                               rtol=1e-9, equal_nan=True)
+
+
+def test_global_rank_sql(mesh8):
+    """RANK()/DENSE_RANK()/ROW_NUMBER()/NTILE() OVER (ORDER BY ...)
+    without PARTITION BY — distributed, ties included."""
+    from bodo_tpu.sql import BodoSQLContext
+    r = np.random.default_rng(2)
+    n = 500
+    pdf = pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64),
+        "v": r.integers(0, 40, n),          # many ties
+        "s": r.choice(["a", "b", "c"], n),
+    })
+    ctx = BodoSQLContext({"t": pdf})
+    got = ctx.sql("""
+        select k, rank() over (order by v) as rk,
+               dense_rank() over (order by v) as dr,
+               row_number() over (order by v, k) as rn,
+               ntile(7) over (order by v, k) as nt
+        from t
+    """).to_pandas().sort_values("k").reset_index(drop=True)
+    exp_rk = pdf["v"].rank(method="min").astype(np.int64)
+    exp_dr = pdf["v"].rank(method="dense").astype(np.int64)
+    np.testing.assert_array_equal(got["rk"], exp_rk)
+    np.testing.assert_array_equal(got["dr"], exp_dr)
+    order = pdf.sort_values(["v", "k"]).index
+    exp_rn = pd.Series(np.empty(n, np.int64), index=pdf.index)
+    exp_rn.iloc[order] = np.arange(1, n + 1)
+    np.testing.assert_array_equal(got["rn"], exp_rn)
+    # ntile: first (n mod 7) buckets get ceil(n/7) rows
+    small, rem = divmod(n, 7)
+    sizes = got["nt"].value_counts().sort_index()
+    assert list(sizes) == [small + 1] * rem + [small] * (7 - rem)
+
+
+def test_global_rank_with_nulls_and_strings(mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    pdf = pd.DataFrame({
+        "k": np.arange(12, dtype=np.int64),
+        "s": ["b", "a", None, "c", "a", None, "b", "a", "c", "b",
+              None, "a"],
+    })
+    ctx = BodoSQLContext({"t": pdf})
+    got = ctx.sql("""
+        select k, dense_rank() over (order by s) as dr from t
+    """).to_pandas().sort_values("k").reset_index(drop=True)
+    # SQL semantics: nulls rank together (last, na_last=True)
+    cats = {"a": 1, "b": 2, "c": 3}
+    exp = [cats[v] if isinstance(v, str) else 4 for v in pdf["s"]]
+    np.testing.assert_array_equal(got["dr"], exp)
+
+
+def test_whole_table_agg_window_no_gather(mesh8):
+    """SUM/AVG/MIN/MAX/COUNT OVER () on a sharded table: distributed
+    reduction + broadcast (no gather)."""
+    from bodo_tpu import relational as R
+    from bodo_tpu.plan.physical import execute
+    import bodo_tpu.pandas_api as bd
+    r = np.random.default_rng(4)
+    pdf = pd.DataFrame({"v": r.normal(size=300)})
+    t = execute(bd.from_pandas(pdf)._plan).shard()
+    out = R.agg_window(t, [], [], [
+        ("sum", "v", ("all",), 0, "s"),
+        ("mean", "v", ("all",), 0, "m"),
+        ("min", "v", ("all",), 0, "lo"),
+        ("max", "v", ("all",), 0, "hi"),
+        ("count", "v", ("all",), 0, "c"),
+    ])
+    assert out.distribution == "1D"  # stayed sharded — no gather round-trip
+    got = out.to_pandas()
+    np.testing.assert_allclose(got["s"], pdf["v"].sum(), rtol=1e-9)
+    np.testing.assert_allclose(got["m"], pdf["v"].mean(), rtol=1e-9)
+    np.testing.assert_allclose(got["lo"], pdf["v"].min(), rtol=1e-9)
+    np.testing.assert_allclose(got["hi"], pdf["v"].max(), rtol=1e-9)
+    np.testing.assert_array_equal(got["c"], 300)
+
+
+def test_sql_sum_over_empty_window(mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    pdf = pd.DataFrame({"k": np.arange(20, dtype=np.int64),
+                        "v": np.arange(20) * 1.5})
+    ctx = BodoSQLContext({"t": pdf})
+    got = ctx.sql(
+        "select k, v / sum(v) over () as share from t"
+    ).to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_allclose(got["share"], pdf["v"] / pdf["v"].sum(),
+                               rtol=1e-9)
+
+
+def test_global_rank_sharded_frontend(mesh8):
+    r = np.random.default_rng(3)
+    pdf = pd.DataFrame({"v": r.integers(0, 25, 200)})
+    bdf = _sharded(pdf)
+    # groupby-free rank: Series.rank goes through the global path when
+    # the frame is sharded (no partition keys)
+    from bodo_tpu import relational as R
+    from bodo_tpu.plan.physical import execute
+    t = execute(bdf._plan)
+    out = R.rank_window(t, [], ["v"], [("rank", 0, "rk")])
+    got = out.to_pandas()["rk"]
+    exp = pdf["v"].rank(method="min").astype(np.int64)
+    np.testing.assert_array_equal(got, exp)

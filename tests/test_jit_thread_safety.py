@@ -1,0 +1,105 @@
+"""_PandasRedirect thread-awareness + shuffle skew stress.
+
+VERDICT r2 weak #6 (global pandas monkey-patch misroutes concurrent
+host pandas) and weak #10 (overflow-retry paths never stressed at
+skew)."""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def test_redirect_is_thread_local(tmp_path, mesh8):
+    """pd.read_parquet from another thread during a jitted call must hit
+    genuine pandas (returns pd.DataFrame, not a lazy frame)."""
+    from bodo_tpu.jit_compiler import jit
+
+    p = str(tmp_path / "t.parquet")
+    pd.DataFrame({"a": np.arange(50, dtype=np.int64),
+                  "b": np.arange(50) * 0.5}).to_parquet(p)
+
+    inside = threading.Event()
+    release = threading.Event()
+    other_result = {}
+
+    def other_thread():
+        inside.wait(timeout=30)
+        other_result["type"] = type(pd.read_parquet(p))
+        release.set()
+
+    th = threading.Thread(target=other_thread)
+    th.start()
+
+    @jit
+    def f():
+        df = pd.read_parquet(p)          # redirected (lazy) in THIS thread
+        inside.set()
+        release.wait(timeout=30)
+        return df.groupby("a").agg(s=("b", "sum"))
+
+    genuine = pd.read_parquet
+    out = f()
+    th.join(timeout=30)
+    assert other_result["type"] is pd.DataFrame
+    assert len(out) == 50
+    # after the call, pandas entry points are restored
+    assert pd.read_parquet is genuine
+
+
+def test_redirect_reentrant(mesh8, tmp_path):
+    from bodo_tpu.jit_compiler import jit
+    p = str(tmp_path / "u.parquet")
+    pd.DataFrame({"a": np.arange(20, dtype=np.int64)}).to_parquet(p)
+
+    @jit
+    def inner():
+        return pd.read_parquet(p)["a"].sum()
+
+    @jit
+    def outer():
+        return inner() + 1
+
+    genuine = pd.read_parquet
+    assert outer() == 190 + 1
+    assert pd.read_parquet is genuine
+
+
+def test_shuffle_adversarial_skew(mesh8):
+    """90% of rows carry ONE key: every shuffle bucket for that key's
+    target shard overflows the average capacity — exercises the
+    overflow-retry path (config.shuffle_skew_factor) under real skew."""
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.pandas_api.frame import BodoDataFrame
+    from bodo_tpu.plan.physical import execute
+
+    r = np.random.default_rng(11)
+    n = 4000
+    keys = np.where(r.uniform(size=n) < 0.9, 7,
+                    r.integers(0, 500, n)).astype(np.int64)
+    pdf = pd.DataFrame({"k": keys, "v": r.normal(size=n)})
+    t = execute(bd.from_pandas(pdf)._plan).shard()
+    bdf = BodoDataFrame(L.FromPandas(t))
+
+    got = (bdf.groupby("k", as_index=False).agg(s=("v", "sum"),
+                                                c=("v", "count"))
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    exp = (pdf.groupby("k", as_index=False).agg(s=("v", "sum"),
+                                                c=("v", "count"))
+           .sort_values("k").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                  rtol=1e-9)
+
+    # skewed join: build side tiny, probe side 90% one key
+    build = pd.DataFrame({"k": np.arange(500, dtype=np.int64),
+                          "w": np.arange(500) * 2.0})
+    bb = BodoDataFrame(L.FromPandas(
+        execute(bd.from_pandas(build)._plan).shard()))
+    gotj = (bdf.merge(bb, on="k").to_pandas()
+            .sort_values(["k", "v"]).reset_index(drop=True))
+    expj = (pdf.merge(build, on="k").sort_values(["k", "v"])
+            .reset_index(drop=True))
+    pd.testing.assert_frame_equal(gotj, expj, check_dtype=False,
+                                  rtol=1e-9)

@@ -1,0 +1,318 @@
+"""SQL scalar kernel library tests — differential vs Python/pandas.
+
+Mirrors the reference's kernel-library test style
+(BodoSQL/bodosql/tests/test_string_fns.py etc.): each function is
+checked against a straight pandas/Python computation of the same
+expression on the source frame.
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="module")
+def df():
+    r = np.random.default_rng(7)
+    n = 200
+    return pd.DataFrame({
+        "s": r.choice(["hello world", "Bodo TPU", "  pad  ", "a,b,c",
+                       "Mixed CASE text", "", "12.5", "x9", "2024-03-15",
+                       "not a number"], n),
+        "x": np.round(r.uniform(-100, 100, n), 3),
+        "i": r.integers(-50, 50, n),
+        "d": pd.to_datetime("2023-01-01")
+        + pd.to_timedelta(r.integers(0, 900, n), unit="D")
+        + pd.to_timedelta(r.integers(0, 86_400, n), unit="s"),
+    })
+
+
+@pytest.fixture(scope="module")
+def ctx(df):
+    from bodo_tpu.sql import BodoSQLContext
+    return BodoSQLContext({"t": df})
+
+
+def q(ctx, expr_sql):
+    out = ctx.sql(f"select {expr_sql} as r from t").to_pandas()
+    return out["r"]
+
+
+# ---------------------------------------------------------------------------
+# string functions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sql,py", [
+    ("length(s)", lambda s: s.str.len()),
+    ("trim(s)", lambda s: s.str.strip()),
+    ("ltrim(s)", lambda s: s.str.lstrip()),
+    ("rtrim(s)", lambda s: s.str.rstrip()),
+    ("replace(s, 'o', '0')", lambda s: s.str.replace("o", "0", regex=False)),
+    ("lpad(s, 6, '*')",
+     lambda s: s.map(lambda v: v[:6] if len(v) >= 6 else
+                     ("*" * (6 - len(v))) + v)),
+    ("rpad(s, 6, '*')",
+     lambda s: s.map(lambda v: v[:6] if len(v) >= 6 else
+                     v + "*" * (6 - len(v)))),
+    ("left(s, 3)", lambda s: s.str[:3]),
+    ("right(s, 3)", lambda s: s.map(lambda v: v[-3:] if v else "")),
+    ("reverse(s)", lambda s: s.map(lambda v: v[::-1])),
+    ("repeat(s, 2)", lambda s: s * 2),
+    ("split_part(s, ',', 2)",
+     lambda s: s.map(lambda v: (v.split(",") + ["", ""])[1]
+                     if len(v.split(",")) >= 2 else "")),
+    ("upper(s)", lambda s: s.str.upper()),
+    ("lower(s)", lambda s: s.str.lower()),
+    ("initcap(s)",
+     lambda s: s.map(lambda v: __import__("re").sub(
+         r"[A-Za-z0-9]+", lambda m: m.group(0).capitalize(), v))),
+    ("translate(s, 'lo', '01')",
+     lambda s: s.map(lambda v: v.translate(str.maketrans("lo", "01")))),
+    ("substr(s, 2, 3)", lambda s: s.str[1:4]),
+])
+def test_string_fn(ctx, df, sql, py, mesh8):
+    got = q(ctx, sql)
+    exp = py(df["s"])
+    assert list(got) == list(exp), sql
+
+
+def test_concat_cols_and_literals(ctx, df, mesh8):
+    got = q(ctx, "concat(s, '-', s)")
+    exp = df["s"] + "-" + df["s"]
+    assert list(got) == list(exp)
+
+
+def test_concat_pipe_operator(ctx, df, mesh8):
+    got = q(ctx, "s || '!' ")
+    assert list(got) == list(df["s"] + "!")
+
+
+def test_concat_ws(ctx, df, mesh8):
+    got = q(ctx, "concat_ws('/', s, 'z')")
+    assert list(got) == list(df["s"] + "/z")
+
+
+def test_position_ascii(ctx, df, mesh8):
+    got = q(ctx, "position('o', s)")
+    assert list(got) == [v.find("o") + 1 for v in df["s"]]
+    got = q(ctx, "charindex('o', s)")
+    assert list(got) == [v.find("o") + 1 for v in df["s"]]
+    got = q(ctx, "instr(s, 'o')")
+    assert list(got) == [v.find("o") + 1 for v in df["s"]]
+    got = q(ctx, "ascii(s)")
+    assert list(got) == [ord(v[0]) if v else 0 for v in df["s"]]
+
+
+def test_startswith_contains_predicates(ctx, df, mesh8):
+    got = ctx.sql(
+        "select count(*) as n from t where startswith(s, 'B')").to_pandas()
+    assert got["n"][0] == int(df["s"].str.startswith("B").sum())
+    got = ctx.sql(
+        "select count(*) as n from t where contains(s, 'o')").to_pandas()
+    assert got["n"][0] == int(df["s"].str.contains("o", regex=False).sum())
+
+
+# ---------------------------------------------------------------------------
+# regexp
+# ---------------------------------------------------------------------------
+
+def test_regexp_like(ctx, df, mesh8):
+    got = ctx.sql(
+        "select count(*) as n from t where regexp_like(s, '[a-z ]+')"
+    ).to_pandas()
+    exp = int(df["s"].str.fullmatch("[a-z ]+").sum())
+    assert got["n"][0] == exp
+
+
+def test_regexp_replace_substr_count(ctx, df, mesh8):
+    import re
+    got = q(ctx, "regexp_replace(s, '[aeiou]', '_')")
+    assert list(got) == [re.sub("[aeiou]", "_", v) for v in df["s"]]
+    got = q(ctx, "regexp_substr(s, '[0-9]+')")
+    # Snowflake semantics: no match -> NULL (materializes as NaN here,
+    # the engine's missing-string convention)
+    assert [v if isinstance(v, str) else None for v in got] == \
+        [(re.search("[0-9]+", v).group(0)
+          if re.search("[0-9]+", v) else None) for v in df["s"]]
+    got = q(ctx, "regexp_count(s, '[aeiou]')")
+    assert list(got) == [len(re.findall("[aeiou]", v)) for v in df["s"]]
+
+
+def test_crypto(ctx, df, mesh8):
+    import hashlib
+    got = q(ctx, "md5(s)")
+    assert list(got) == [hashlib.md5(v.encode()).hexdigest()
+                         for v in df["s"]]
+    got = q(ctx, "sha2(s, 256)")
+    assert list(got) == [hashlib.sha256(v.encode()).hexdigest()
+                         for v in df["s"]]
+
+
+# ---------------------------------------------------------------------------
+# numeric functions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sql,py", [
+    ("ceil(x)", lambda x: np.ceil(x)),
+    ("floor(x)", lambda x: np.floor(x)),
+    ("sqrt(abs(x))", lambda x: np.sqrt(np.abs(x))),
+    ("exp(x / 100)", lambda x: np.exp(x / 100)),
+    ("ln(abs(x) + 1)", lambda x: np.log(np.abs(x) + 1)),
+    ("log(10, abs(x) + 1)", lambda x: np.log10(np.abs(x) + 1)),
+    ("sign(x)", lambda x: np.sign(x).astype(np.int64)),
+    ("sin(x)", lambda x: np.sin(x)),
+    ("atan(x)", lambda x: np.arctan(x)),
+    ("degrees(x)", lambda x: np.degrees(x)),
+    ("power(x, 2)", lambda x: x ** 2.0),
+    ("mod(i, 7)", lambda x: None),  # handled below on i
+    ("square(x)", lambda x: x * x),
+])
+def test_numeric_fn(ctx, df, sql, py, mesh8):
+    got = q(ctx, sql)
+    if sql == "mod(i, 7)":
+        exp = np.mod(df["i"], 7)
+    else:
+        exp = py(df["x"].to_numpy())
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float64),
+                               np.asarray(exp, dtype=np.float64),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_round_half_away(ctx, mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    d = pd.DataFrame({"v": [0.5, 1.5, 2.5, -0.5, -1.5, 1.25, -1.25]})
+    c = BodoSQLContext({"v": d})
+    got = c.sql("select round(v, 0) as r from v").to_pandas()["r"]
+    # SQL rounds half away from zero (1.5 -> 2, 2.5 -> 3, -1.5 -> -2)
+    assert list(got) == [1.0, 2.0, 3.0, -1.0, -2.0, 1.0, -1.0]
+    got = c.sql("select round(v, 1) as r from v").to_pandas()["r"]
+    assert list(got) == [0.5, 1.5, 2.5, -0.5, -1.5, 1.3, -1.3]
+
+
+def test_trunc_digits(ctx, df, mesh8):
+    got = q(ctx, "trunc(x, 1)")
+    exp = np.trunc(df["x"].to_numpy() * 10) / 10
+    np.testing.assert_allclose(got, exp, rtol=1e-12)
+
+
+def test_to_number(ctx, df, mesh8):
+    got = q(ctx, "to_number(s)")
+    exp = pd.to_numeric(df["s"], errors="coerce")
+    np.testing.assert_allclose(got.astype(float), exp.astype(float),
+                               equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# conditional
+# ---------------------------------------------------------------------------
+
+def test_iff_nullif_greatest_least(ctx, df, mesh8):
+    got = q(ctx, "iff(x > 0, i, -i)")
+    exp = np.where(df["x"] > 0, df["i"], -df["i"])
+    np.testing.assert_array_equal(got, exp)
+
+    got = q(ctx, "nullif(i, 0)")
+    exp = df["i"].astype("float64").where(df["i"] != 0)
+    np.testing.assert_allclose(got.astype("float64").to_numpy(),
+                               exp.to_numpy(), equal_nan=True)
+
+    got = q(ctx, "greatest(x, i, 0)")
+    exp = np.maximum(np.maximum(df["x"], df["i"]), 0)
+    np.testing.assert_allclose(got, exp)
+
+    got = q(ctx, "least(x, i)")
+    np.testing.assert_allclose(got, np.minimum(df["x"], df["i"]))
+
+
+def test_nvl2_zeroifnull(ctx, df, mesh8):
+    got = q(ctx, "nvl2(x, 1, 2)")
+    np.testing.assert_array_equal(got, np.full(len(df), 1))
+    got = q(ctx, "zeroifnull(x)")
+    np.testing.assert_allclose(got, df["x"])
+
+
+# ---------------------------------------------------------------------------
+# datetime
+# ---------------------------------------------------------------------------
+
+def test_date_trunc(ctx, df, mesh8):
+    for unit, freq in [("month", "MS"), ("year", "YS"), ("day", "D"),
+                      ("hour", "h"), ("quarter", "QS")]:
+        got = q(ctx, f"date_trunc('{unit}', d)")
+        if unit == "quarter":
+            exp = df["d"].dt.to_period("Q").dt.start_time
+        elif unit in ("month", "year"):
+            exp = df["d"].dt.to_period({"month": "M", "year": "Y"}[unit]
+                                       ).dt.start_time
+        else:
+            exp = df["d"].dt.floor(freq)
+        assert list(got) == list(exp), unit
+
+
+def test_dateadd(ctx, df, mesh8):
+    got = q(ctx, "dateadd('day', 10, d)")
+    assert list(got) == list(df["d"] + pd.Timedelta(days=10))
+    got = q(ctx, "dateadd('month', 1, d)")
+    assert list(got) == list(df["d"] + pd.DateOffset(months=1))
+    got = q(ctx, "dateadd('year', -2, d)")
+    assert list(got) == list(df["d"] + pd.DateOffset(years=-2))
+    got = q(ctx, "dateadd('hour', 5, d)")
+    assert list(got) == list(df["d"] + pd.Timedelta(hours=5))
+
+
+def test_datediff(ctx, df, mesh8):
+    got = q(ctx, "datediff('day', d, date '2024-06-01')")
+    ref = pd.Timestamp("2024-06-01")
+    exp = (ref.normalize() - df["d"].dt.normalize()).dt.days
+    np.testing.assert_array_equal(got, exp)
+    got = q(ctx, "datediff('month', d, date '2024-06-01')")
+    exp = (2024 * 12 + 5) - (df["d"].dt.year * 12 + df["d"].dt.month - 1)
+    np.testing.assert_array_equal(got, exp)
+    got = q(ctx, "datediff('year', d, date '2024-06-01')")
+    np.testing.assert_array_equal(got, 2024 - df["d"].dt.year)
+
+
+def test_last_day_monthname_dayname_week(ctx, df, mesh8):
+    got = q(ctx, "last_day(d)")
+    exp = df["d"].dt.to_period("M").dt.end_time.dt.normalize()
+    assert list(got) == list(exp)
+
+    got = q(ctx, "monthname(d)")
+    names = ["Jan", "Feb", "Mar", "Apr", "May", "Jun",
+             "Jul", "Aug", "Sep", "Oct", "Nov", "Dec"]
+    assert list(got) == [names[m - 1] for m in df["d"].dt.month]
+
+    got = q(ctx, "dayname(d)")
+    dnames = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+    assert list(got) == [dnames[w] for w in df["d"].dt.dayofweek]
+
+    got = q(ctx, "week(d)")
+    exp = df["d"].dt.isocalendar().week.astype(np.int64)
+    np.testing.assert_array_equal(np.asarray(got), exp.to_numpy())
+
+
+def test_to_date(ctx, mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    d = pd.DataFrame({"s": ["2024-01-05", "2023-12-31", "bad", ""]})
+    c = BodoSQLContext({"v": d})
+    got = c.sql("select to_date(s) as r from v").to_pandas()["r"]
+    assert got[0] == datetime.date(2024, 1, 5)
+    assert got[1] == datetime.date(2023, 12, 31)
+    assert got[2] is None or pd.isna(got[2])
+
+
+def test_string_fn_of_monthname(ctx, df, mesh8):
+    # DictMap over a CodeLUT base: lower(monthname(d))
+    got = q(ctx, "lower(monthname(d))")
+    names = ["jan", "feb", "mar", "apr", "may", "jun",
+             "jul", "aug", "sep", "oct", "nov", "dec"]
+    assert list(got) == [names[m - 1] for m in df["d"].dt.month]
+
+
+def test_predicate_on_monthname(ctx, df, mesh8):
+    got = ctx.sql(
+        "select count(*) as n from t where monthname(d) = 'Mar'"
+    ).to_pandas()
+    assert got["n"][0] == int((df["d"].dt.month == 3).sum())

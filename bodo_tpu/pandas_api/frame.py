@@ -295,6 +295,15 @@ class BodoDataFrame:
         from bodo_tpu.io.iceberg import write_iceberg
         return write_iceberg(self._execute(), table_path, mode=mode)
 
+    def explode(self, column: str) -> "BodoDataFrame":
+        """Row-expand a list column (reference: bodo/libs/_lateral.cpp
+        lateral flatten; pandas df.explode). Pandas' repeated index is
+        not reproduced — rows come back 0..n-1 like reset_index(drop)."""
+        from bodo_tpu.plan.physical import execute
+        from bodo_tpu.table import nested as _nested
+        t = execute(self._plan)
+        return BodoDataFrame(L.FromPandas(_nested.explode_table(t, column)))
+
     def drop(self, columns=None, **kw) -> "BodoDataFrame":
         if columns is None:
             warn_fallback("DataFrame.drop", "only columns= supported")

@@ -205,6 +205,52 @@ class BodoGroupBy:
         return out[[f"{prefix}_{c}" for c in cols]].rename(
             columns={f"{prefix}_{c}": c for c in cols})
 
+    def apply(self, func, *args, **kwargs):
+        """Per-group Python UDF (reference: bodo/hiframes/pd_groupby_ext.py
+        apply support). Distributed execution: one hash shuffle co-locates
+        every group on a shard (`relational.shuffle_by_key`), then the UDF
+        runs rank-local per shard — the same shuffle-then-local-UDF model
+        as the reference's groupby.apply under JIT. Results concatenate
+        and sort to pandas' group order."""
+        import bodo_tpu.relational as R
+        from bodo_tpu.plan.physical import execute
+        t = execute(self._df._plan)
+        if t.distribution != "REP" and t.num_shards > 1:
+            # carry the global row id through the shuffle so per-shard
+            # frames keep ORIGINAL row labels — transform-like UDF
+            # results (same-length Series) then reassemble in pandas'
+            # original row order instead of interleaving local indexes
+            t2 = R.window_table(t, [(t.names[0], "rowid", None, "__rid")])
+            t2 = R.shuffle_by_key(t2, self._keys)
+            frames = [f.set_index("__rid").rename_axis(None)
+                      for f in R.shard_frames(t2)]
+        else:
+            frames = [t.to_pandas()]
+        sel = None
+        if self._selection is not None:
+            sel = self._selection[0] if self._single else self._selection
+        parts = []
+        for f in frames:
+            if not len(f):
+                continue
+            gb = f.groupby(self._keys, as_index=True)
+            if sel is not None:
+                gb = gb[sel]
+            parts.append(gb.apply(func, *args, **kwargs))
+        if not parts:
+            gb = pd.DataFrame(columns=list(self._df._plan.schema)
+                              ).groupby(self._keys)
+            if sel is not None:
+                gb = gb[sel]
+            return gb.apply(func, *args, **kwargs)
+        res = pd.concat(parts)
+        res = res.sort_index(level=list(range(len(self._keys)))
+                             if res.index.nlevels > 1 else None,
+                             kind="stable")
+        if not self._as_index:
+            res = res.reset_index()
+        return res
+
     def size(self):
         res = self._run([(self._keys[0], "size", "size")])
         if self._as_index:

@@ -61,6 +61,7 @@ class BodoSeries:
         self._index = list(index) if index else []
         self._name = name if name is not None else (
             expr.name if isinstance(expr, ColRef) else None)
+        self._categorical = False  # astype('category') materialization flag
 
     # ---- dtype ------------------------------------------------------------
     @property
@@ -147,6 +148,17 @@ class BodoSeries:
         Where(UnOp("isna", self._expr), Lit(v), self._expr))
 
     def astype(self, dtype) -> "BodoSeries":
+        if dtype in ("category", "Category") or (
+                isinstance(dtype, pd.CategoricalDtype)):
+            if self._dtype is not dt.STRING:
+                warn_fallback("Series.astype", "category of non-string")
+                return self.to_pandas().astype("category")
+            # strings are already dict-encoded — categorical is a
+            # materialization flag, not a representation change
+            # (reference: bodo/hiframes/pd_categorical_ext.py)
+            out = self._wrap(self._expr)
+            out._categorical = True
+            return out
         return self._wrap(Cast(self._expr, dt.from_numpy(np.dtype(dtype))))
 
     def where(self, cond, other) -> "BodoSeries":
@@ -208,6 +220,31 @@ class BodoSeries:
     @property
     def str(self):
         return _StrAccessor(self)
+
+    @property
+    def cat(self):
+        return _CatAccessor(self)
+
+    @property
+    def list(self):
+        return _ListAccessor(self)
+
+    @property
+    def struct(self):
+        return _StructAccessor(self)
+
+    def _nested_column(self):
+        """Materialize this series' column (nested accessors are eager —
+        they need the host dictionary)."""
+        from bodo_tpu.plan.physical import execute
+        name = self._name or "_val"
+        t = execute(self._as_projection(name))
+        return t, t.column(name), name
+
+    def _wrap_column(self, t, col, name) -> "BodoSeries":
+        from bodo_tpu.table.table import Table
+        out = Table({name: col}, t.nrows, t.distribution, t.counts)
+        return BodoSeries(L.FromPandas(out), ColRef(name), self._name)
 
     # ---- reductions ---------------------------------------------------------
     def _reduce(self, op):
@@ -287,7 +324,10 @@ class BodoSeries:
             if icols:
                 pdf = pdf.set_index(icols)
                 pdf.index.names = [d for (c, d) in self._index if c != name]
-        return pdf[name].rename(self._name)
+        out = pdf[name].rename(self._name)
+        if self._categorical:
+            out = out.astype("category")
+        return out
 
     def to_pandas(self) -> pd.Series:
         from bodo_tpu.plan.physical import execute
@@ -383,6 +423,85 @@ class _Rolling:
     def count(self): return self._agg("count")
 
 
+class _ListAccessor:
+    """Series.list — list-column element access (pandas ArrowDtype
+    .list accessor surface; reference bodo/libs/array_item_arr_ext.py).
+    Eager: kernels are host-dictionary LUTs gathered on device."""
+
+    def __init__(self, s: BodoSeries):
+        if s._dtype.kind not in ("list", "map"):
+            raise AttributeError(".list requires a list column")
+        self._s = s
+
+    def len(self) -> BodoSeries:
+        from bodo_tpu.table import nested as N
+        from bodo_tpu.table.table import Column
+        t, col, name = self._s._nested_column()
+        data, valid = N.list_lengths(col)
+        return self._s._wrap_column(t, Column(data, valid, dt.INT64, None),
+                                    name)
+
+    def __getitem__(self, i: int) -> BodoSeries:
+        return self.get(i)
+
+    def get(self, i: int) -> BodoSeries:
+        if self._s._dtype.kind == "map":
+            raise NotImplementedError(
+                ".list.get on a map column — use .struct.field(key)")
+        from bodo_tpu.table import nested as N
+        t, col, name = self._s._nested_column()
+        return self._s._wrap_column(t, N.list_get(col, int(i)), name)
+
+
+class _StructAccessor:
+    """Series.struct — struct field projection (pandas ArrowDtype
+    .struct accessor surface; reference bodo/libs/struct_arr_ext.py)."""
+
+    def __init__(self, s: BodoSeries):
+        if s._dtype.kind not in ("struct", "map"):
+            raise AttributeError(".struct requires a struct column")
+        self._s = s
+
+    def field(self, name: str) -> BodoSeries:
+        from bodo_tpu.table import nested as N
+        t, col, cname = self._s._nested_column()
+        if col.dtype.kind == "map":
+            out = N.map_get(col, name)
+        else:
+            out = N.struct_field(col, name)
+        res = self._s._wrap_column(t, out, cname)
+        res._name = name
+        return res
+
+
+class _CatAccessor:
+    """Series.cat — categorical introspection over the dict encoding
+    (reference: bodo/hiframes/pd_categorical_ext.py). Strings are
+    dictionary-encoded with a sorted dictionary, so the dictionary IS
+    the category array and the codes match pandas' astype('category')."""
+
+    def __init__(self, s: BodoSeries):
+        if s._dtype is not dt.STRING:
+            raise AttributeError(".cat requires a string/categorical series")
+        self._s = s
+
+    @property
+    def codes(self) -> BodoSeries:
+        from bodo_tpu.plan.expr import StrCodes
+        return self._s._wrap(StrCodes(self._s._expr))
+
+    @property
+    def categories(self) -> pd.Index:
+        from bodo_tpu.plan.physical import execute
+        name = self._s._name or "_val"
+        t = execute(self._s._as_projection(name))
+        d = t.column(name).dictionary
+        return pd.Index(d if d is not None else [], dtype=object)
+
+    def as_ordered(self):  # dictionary order is sorted already
+        return self._s
+
+
 class _DtAccessor:
     """Series.dt — datetime field extraction (device kernels)."""
 
@@ -451,14 +570,65 @@ class _StrAccessor:
         from bodo_tpu.plan.expr import StrLen
         return self._s._wrap(StrLen(self._s._expr))
 
+    def fullmatch(self, pat):
+        return self._s._wrap(StrPredicate("fullmatch", (pat,),
+                                          self._s._expr))
+
+    def isin(self, values):
+        return self._s._wrap(StrPredicate("eq_any", tuple(values),
+                                          self._s._expr))
+
+    def pad(self, width: int, side: str = "left", fillchar: str = " "):
+        kind = {"left": "rjust", "right": "ljust", "both": "center"}[side]
+        return self._map(kind, width, fillchar)
+
+    def ljust(self, width: int, fillchar: str = " "):
+        return self._map("ljust", width, fillchar)
+
+    def rjust(self, width: int, fillchar: str = " "):
+        return self._map("rjust", width, fillchar)
+
+    def center(self, width: int, fillchar: str = " "):
+        return self._map("center", width, fillchar)
+
+    def repeat(self, repeats: int):
+        return self._map("repeat", int(repeats))
+
+    def get(self, i: int):
+        return self._map("get", int(i))
+
+    def find(self, sub: str):
+        from bodo_tpu.plan.expr import BinOp, Lit, StrHostFn
+        # pandas find is 0-based with -1 absent; position is 1-based/0
+        return self._s._wrap(BinOp(
+            "-", StrHostFn("position", (sub,), self._s._expr), Lit(1)))
+
+    def count(self, pat: str):
+        from bodo_tpu.plan.expr import StrHostFn
+        return self._s._wrap(StrHostFn("regexp_count", (pat,),
+                                       self._s._expr))
+
+    def cat(self, others=None, sep: str = ""):
+        from bodo_tpu.plan.expr import StrConcat
+        if others is None:
+            warn_fallback("Series.str.cat", "reduction form")
+            return self._s.to_pandas().str.cat(sep=sep)
+        parts = [self._s._expr]
+        olist = others if isinstance(others, (list, tuple)) else [others]
+        for o in olist:
+            if sep:
+                parts.append(sep)
+            parts.append(o._expr if isinstance(o, BodoSeries) else str(o))
+        return self._s._wrap(StrConcat(tuple(parts)))
+
     def split(self, pat=None, n: int = -1, expand: bool = False):
         """Split on the host dictionary: each output part is a new
         dict-encoded column sharing the original codes (reference:
-        bodo/libs/dict_arr_ext.py str_split). expand=True only — the
-        list-of-strings form needs the nested-list array type."""
+        bodo/libs/dict_arr_ext.py str_split). expand=False returns a
+        dict-encoded list<string> column (table/nested.py design)."""
         if not expand:
-            warn_fallback("Series.str.split", "expand=False (list result)")
-            return self._s.to_pandas().str.split(pat, n=n)
+            from bodo_tpu.plan.expr import StrToList
+            return self._s._wrap(StrToList((pat, n), self._s._expr))
         import numpy as np
 
         from bodo_tpu.pandas_api.frame import BodoDataFrame

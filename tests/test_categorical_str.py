@@ -1,0 +1,110 @@
+"""Categorical surface (.astype('category'), .cat) and str-accessor
+breadth — differential vs pandas.
+
+Reference surfaces: bodo/hiframes/pd_categorical_ext.py (categorical),
+bodo/hiframes/series_str_impl.py (str accessor).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="module")
+def pdf():
+    r = np.random.default_rng(3)
+    n = 300
+    return pd.DataFrame({
+        "s": r.choice(["apple", "banana", "cherry", "date", "elder"], n),
+        "t": r.choice(["x-1", "y-22", "z-333", ""], n),
+        "v": r.normal(size=n),
+    })
+
+
+@pytest.fixture(scope="module")
+def bdf(pdf):
+    import bodo_tpu.pandas_api as bd
+    return bd.from_pandas(pdf)
+
+
+def test_astype_category_roundtrip(bdf, pdf, mesh8):
+    got = bdf["s"].astype("category").to_pandas()
+    exp = pdf["s"].astype("category")
+    assert got.dtype == "category"
+    assert list(got) == list(exp)
+    assert list(got.cat.categories) == list(exp.cat.categories)
+
+
+def test_cat_codes_match_pandas(bdf, pdf, mesh8):
+    got = bdf["s"].cat.codes.to_pandas()
+    exp = pdf["s"].astype("category").cat.codes
+    np.testing.assert_array_equal(got.to_numpy(), exp.to_numpy())
+
+
+def test_cat_categories(bdf, pdf, mesh8):
+    got = bdf["s"].cat.categories
+    exp = pdf["s"].astype("category").cat.categories
+    assert list(got) == list(exp)
+
+
+def test_cat_on_numeric_raises(bdf, mesh8):
+    with pytest.raises(AttributeError):
+        bdf["v"].cat
+
+
+def test_groupby_on_categorical(bdf, pdf, mesh8):
+    got = (bdf.groupby("s", as_index=False)
+           .agg(m=("v", "mean")).to_pandas()
+           .sort_values("s").reset_index(drop=True))
+    exp = (pdf.groupby("s", as_index=False).agg(m=("v", "mean"))
+           .sort_values("s").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# str accessor breadth
+# ---------------------------------------------------------------------------
+
+def test_str_pad_family(bdf, pdf, mesh8):
+    assert list(bdf["s"].str.pad(8, "left", "*").to_pandas()) == \
+        list(pdf["s"].str.pad(8, "left", "*"))
+    assert list(bdf["s"].str.ljust(8, ".").to_pandas()) == \
+        list(pdf["s"].str.ljust(8, "."))
+    assert list(bdf["s"].str.rjust(8, ".").to_pandas()) == \
+        list(pdf["s"].str.rjust(8, "."))
+    assert list(bdf["s"].str.center(9, "-").to_pandas()) == \
+        list(pdf["s"].str.center(9, "-"))
+
+
+def test_str_repeat_get_find_count(bdf, pdf, mesh8):
+    assert list(bdf["s"].str.repeat(2).to_pandas()) == \
+        list(pdf["s"].str.repeat(2))
+    got = bdf["t"].str.get(1).to_pandas()
+    exp = pdf["t"].str.get(1)
+    assert [x if isinstance(x, str) else None for x in got] == \
+        [x if isinstance(x, str) else None for x in exp]
+    np.testing.assert_array_equal(bdf["s"].str.find("an").to_pandas(),
+                                  pdf["s"].str.find("an"))
+    np.testing.assert_array_equal(bdf["t"].str.count("[0-9]").to_pandas(),
+                                  pdf["t"].str.count("[0-9]"))
+
+
+def test_str_fullmatch_isin(bdf, pdf, mesh8):
+    np.testing.assert_array_equal(
+        bdf["s"].str.fullmatch("[a-d]+").to_pandas(),
+        pdf["s"].str.fullmatch("[a-d]+"))
+    np.testing.assert_array_equal(
+        bdf["s"].str.isin(["apple", "date"]).to_pandas(),
+        pdf["s"].isin(["apple", "date"]))
+
+
+def test_str_cat_series(bdf, pdf, mesh8):
+    got = bdf["s"].str.cat(bdf["t"], sep="/").to_pandas()
+    exp = pdf["s"].str.cat(pdf["t"], sep="/")
+    assert list(got) == list(exp)
+
+
+def test_filter_then_category(bdf, pdf, mesh8):
+    got = bdf[bdf["v"] > 0]["s"].astype("category").to_pandas()
+    exp = pdf[pdf["v"] > 0]["s"].astype("category")
+    assert list(got) == list(exp)

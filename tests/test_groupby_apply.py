@@ -1,0 +1,81 @@
+"""groupby.apply — shuffle-co-located per-group UDFs vs pandas.
+
+Reference: bodo/hiframes/pd_groupby_ext.py apply support (UDF runs
+rank-local after a key shuffle)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="module")
+def pdf():
+    r = np.random.default_rng(5)
+    n = 400
+    return pd.DataFrame({
+        "k": r.integers(0, 12, n),
+        "k2": r.choice(["a", "b", "c"], n),
+        "v": r.normal(size=n),
+        "w": r.integers(0, 100, n).astype(np.int64),
+    })
+
+
+def _bdf(pdf, shard):
+    import bodo_tpu.pandas_api as bd
+    df = bd.from_pandas(pdf)
+    if shard:
+        import bodo_tpu.relational  # noqa: F401
+        from bodo_tpu.plan.physical import execute
+        t = execute(df._plan).shard()
+        from bodo_tpu.plan import logical as L
+        from bodo_tpu.pandas_api.frame import BodoDataFrame
+        return BodoDataFrame(L.FromPandas(t))
+    return df
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_apply_scalar_result(pdf, shard, mesh8):
+    bdf = _bdf(pdf, shard)
+    got = bdf.groupby("k")["v"].apply(lambda s: float(s.max() - s.min()))
+    exp = pdf.groupby("k")["v"].apply(lambda s: float(s.max() - s.min()))
+    pd.testing.assert_series_equal(got, exp, check_dtype=False)
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_apply_series_result(pdf, shard, mesh8):
+    bdf = _bdf(pdf, shard)
+    f = lambda s: s.describe()[["mean", "std"]]  # noqa: E731
+    got = bdf.groupby("k")["v"].apply(f)
+    exp = pdf.groupby("k")["v"].apply(f)
+    pd.testing.assert_series_equal(got, exp, check_dtype=False)
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_apply_multikey_frame_udf(pdf, shard, mesh8):
+    bdf = _bdf(pdf, shard)
+    f = lambda g: g[["v", "w"]].sum()  # noqa: E731
+    got = bdf.groupby(["k", "k2"]).apply(f)
+    exp = pdf.groupby(["k", "k2"]).apply(f)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_apply_transform_like(pdf, shard, mesh8):
+    """Same-length Series results must reassemble in original row order
+    (regression: per-shard local indexes used to interleave)."""
+    bdf = _bdf(pdf, shard)
+    f = lambda s: s - s.mean()  # noqa: E731
+    got = bdf.groupby("k")["v"].apply(f)
+    exp = pdf.groupby("k")["v"].apply(f)
+    pd.testing.assert_series_equal(got, exp, check_dtype=False)
+
+
+def test_apply_as_index_false(pdf, mesh8):
+    bdf = _bdf(pdf, False)
+    got = bdf.groupby("k", as_index=False)["v"].apply(
+        lambda s: float(s.sum()))
+    exp = pdf.groupby("k", as_index=False)["v"].apply(
+        lambda s: float(s.sum()))
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  exp.reset_index(drop=True),
+                                  check_dtype=False)

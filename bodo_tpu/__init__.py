@@ -21,6 +21,14 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from bodo_tpu.config import config, set_config, set_verbose_level  # noqa: E402
+
+if config.compile_cache_dir:
+    # persistent XLA compilation cache: compiled kernels survive process
+    # restarts (the reference's @bodo.jit(cache=True) Numba on-disk
+    # cache, exercised by its caching_tests/)
+    jax.config.update("jax_compilation_cache_dir", config.compile_cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 from bodo_tpu.parallel.mesh import (  # noqa: E402
     get_mesh, set_mesh, use_mesh, make_mesh, num_shards, init_runtime,
 )

@@ -124,6 +124,13 @@ class Config:
     pack_keys: bool = field(
         default_factory=lambda: _env_bool("BODO_TPU_PACK_KEYS", True)
     )
+    # Persistent XLA compilation cache directory (the @jit(cache=True)
+    # analogue — reference: Numba on-disk JIT cache, caching_tests/).
+    # Set to a path to survive process restarts; empty disables. Applied
+    # at import and again by set_config(compile_cache_dir=...).
+    compile_cache_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_COMPILE_CACHE_DIR", "")
+    )
     # SQL plan cache directory (analogue BODO_SQL_PLAN_CACHE_DIR).
     sql_plan_cache_dir: str = field(
         default_factory=lambda: _env_str("BODO_TPU_SQL_PLAN_CACHE_DIR", "")
@@ -140,6 +147,15 @@ def set_config(**kwargs) -> None:
         if k not in valid:
             raise ValueError(f"unknown config key: {k}")
         setattr(config, k, v)
+        if k == "compile_cache_dir" and v:
+            # jax reads this lazily per compilation — a runtime override
+            # takes effect for subsequent compiles
+            import jax
+            jax.config.update("jax_compilation_cache_dir", v)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.1)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 def set_verbose_level(level: int) -> None:

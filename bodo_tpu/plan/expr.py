@@ -463,6 +463,22 @@ class StrLen(Expr):
 
 
 @_frozen
+class NestedFn(Expr):
+    """Semi-structured access over nested (list/struct/map) columns
+    (reference: BodoSQL/bodosql/kernels/semistructured_array_kernels.py
+    GET/GET_PATH/ARRAY_SIZE). kind: list_len | list_get(i) |
+    field(name). Kernels are host-dictionary LUTs gathered on device
+    (table/nested.py); string-valued results attach their dictionary in
+    the assign_columns host pass, so NestedFn must sit at the top level
+    of a projection like DictMap."""
+    kind: str
+    params: Tuple
+    operand: Expr
+    def key(self): return ("nested", self.kind, self.params,
+                           self.operand.key())
+
+
+@_frozen
 class StrToList(Expr):
     """str.split(expand=False) → list<string> column; the split runs
     once per distinct dictionary entry on host (table/nested.py design;
@@ -531,6 +547,21 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
         return dt.STRING
     if isinstance(e, StrToList):
         return dt.list_of(dt.STRING)
+    if isinstance(e, NestedFn):
+        src = infer_dtype(e.operand, schema)
+        if e.kind == "list_len":
+            return dt.INT64
+        if e.kind == "list_get":
+            return src.elem if src.kind == "list" else dt.FLOAT64
+        if e.kind == "field":
+            if src.kind == "map":
+                return src.value
+            if src.kind == "struct":
+                m = dict(src.fields)
+                if e.params[0] in m:
+                    return m[e.params[0]]
+            return dt.FLOAT64
+        raise ValueError(e.kind)
     if isinstance(e, StrLen):
         return dt.INT64
     if isinstance(e, StrCodes):
@@ -625,7 +656,7 @@ def expr_columns(e: Expr) -> set:
         return {"*"}  # may touch any column — disables pruning above it
     if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate, DictMap,
                       StrLen, MathFn, StrHostFn, CodeLUT, DateTrunc,
-                      StrCodes, StrToList)):
+                      StrCodes, StrToList, NestedFn)):
         return expr_columns(e.operand)
     if isinstance(e, Where):
         return (expr_columns(e.cond) | expr_columns(e.iftrue)

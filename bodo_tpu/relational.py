@@ -96,14 +96,16 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     host-side: the translation runs on the dictionary, the device only
     remaps codes."""
     from bodo_tpu.plan.expr import (MAX_CONCAT_DICT, CodeLUT, ColRef,
-                                    DictMap, Expr as _Expr, StrConcat,
-                                    StrToList, eval_expr as _eval)
+                                    DictMap, Expr as _Expr, NestedFn,
+                                    StrConcat, StrToList,
+                                    eval_expr as _eval)
     dictmaps = {n: e for n, e in new.items() if isinstance(e, DictMap)}
     strcats = {n: e for n, e in new.items() if isinstance(e, StrConcat)}
     strsplits = {n: e for n, e in new.items() if isinstance(e, StrToList)}
+    nestedfns = {n: e for n, e in new.items() if isinstance(e, NestedFn)}
     new = {n: e for n, e in new.items()
            if n not in dictmaps and n not in strcats
-           and n not in strsplits}
+           and n not in strsplits and n not in nestedfns}
     dm_cols: Dict[str, Column] = {}
 
     def _str_part(e):
@@ -184,6 +186,29 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
                          else np.zeros(1, np.int32))
         dm_cols[n] = Column(mp[code], valid, dt.STRING, nd)
 
+    for n, e in nestedfns.items():
+        # semi-structured access: host-dictionary LUT kernels
+        from bodo_tpu.table import nested as _nested
+        base = e.operand
+        if not isinstance(base, ColRef):
+            raise TypeError("nested access must apply to a column")
+        src = t.columns[base.name]
+        if not dt.is_nested(src.dtype):
+            raise TypeError(f"{base.name} is not a nested column "
+                            f"({src.dtype.name})")
+        if e.kind == "list_len":
+            data, valid = _nested.list_lengths(src)
+            dm_cols[n] = Column(data, valid, dt.INT64, None)
+        elif e.kind == "list_get":
+            dm_cols[n] = _nested.list_get(src, int(e.params[0]))
+        elif e.kind == "field":
+            if src.dtype.kind == "map":
+                dm_cols[n] = _nested.map_get(src, e.params[0])
+            else:
+                dm_cols[n] = _nested.struct_field(src, e.params[0])
+        else:
+            raise ValueError(e.kind)
+
     for n, e in strsplits.items():
         # str.split(expand=False): split each dictionary entry, encode
         # the distinct result tuples as a list<string> dictionary
@@ -237,13 +262,14 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
         # numeric outputs drop stale dictionaries
         for n, e in new.items():
             c = res.columns[n]
+            dict_typed = c.dtype is dt.STRING or dt.is_nested(c.dtype)
             if isinstance(e, CodeLUT):
                 res.columns[n] = Column(c.data.astype(np.int32), c.valid,
                                         dt.STRING, e.sorted_dict())
-            elif c.dtype is dt.STRING and isinstance(e, ColRef):
+            elif dict_typed and isinstance(e, ColRef):
                 res.columns[n] = Column(c.data, c.valid, c.dtype,
                                         t.columns[e.name].dictionary)
-            elif c.dtype is not dt.STRING:
+            elif not dict_typed:
                 res.columns[n] = Column(c.data, c.valid, c.dtype, None)
     else:
         res = t.with_columns(t.columns)

@@ -45,7 +45,12 @@ class BodoSQLContext:
                            self._tables.items()))
 
     def sql(self, query: str):
-        """Plan + execute; returns a lazy BodoDataFrame."""
+        """Plan + execute; returns a lazy BodoDataFrame (DDL statements
+        execute immediately and return a status/metadata frame, the
+        reference's direct-DDL path: BodoSQL context.py:531)."""
+        ddl = self._try_ddl(query)
+        if ddl is not None:
+            return ddl
         from bodo_tpu.pandas_api.frame import BodoDataFrame
         from bodo_tpu.sql import plan_cache
         sig = self._schema_sig()
@@ -60,6 +65,61 @@ class BodoSQLContext:
             ast = copy.deepcopy(ast)
         plan, names = Planner(self._tables).plan(ast)
         return BodoDataFrame(plan)
+
+    def _try_ddl(self, query: str):
+        """Handle DDL statements (CREATE TABLE/VIEW AS, DROP TABLE,
+        DESCRIBE, SHOW TABLES); None for ordinary queries."""
+        import re
+        q = query.strip().rstrip(";")
+        up = q.upper()
+
+        m = re.match(
+            r"CREATE\s+(OR\s+REPLACE\s+)?(TABLE|VIEW)\s+(\w+)\s+AS\s+",
+            q, re.IGNORECASE)
+        if m:
+            name = m.group(3).lower()
+            if name in self._tables and not m.group(1):
+                raise ValueError(f"table {name!r} already exists "
+                                 f"(use CREATE OR REPLACE)")
+            body = q[m.end():]
+            result = self.sql(body)
+            if m.group(2).upper() == "VIEW":
+                # views stay lazy: re-planned against live sources
+                self._tables[name] = result._plan
+            else:
+                # tables materialize now (CTAS snapshot semantics)
+                from bodo_tpu.plan.physical import execute
+                self._tables[name] = L.FromPandas(execute(result._plan))
+            return pd.DataFrame(
+                {"status": [f"{m.group(2).capitalize()} {name} "
+                            f"successfully created."]})
+
+        m = re.match(r"DROP\s+(TABLE|VIEW)\s+(IF\s+EXISTS\s+)?(\w+)\s*$",
+                     q, re.IGNORECASE)
+        if m:
+            name = m.group(3).lower()
+            if name not in self._tables:
+                if m.group(2):
+                    return pd.DataFrame(
+                        {"status": [f"{name} does not exist, skipped."]})
+                raise ValueError(f"table {name!r} does not exist")
+            del self._tables[name]
+            return pd.DataFrame(
+                {"status": [f"{name} successfully dropped."]})
+
+        m = re.match(r"(DESCRIBE|DESC)\s+(TABLE\s+)?(\w+)$", q,
+                     re.IGNORECASE)
+        if m:
+            name = m.group(3).lower()
+            if name not in self._tables:
+                raise ValueError(f"table {name!r} does not exist")
+            schema = self._tables[name].schema
+            return pd.DataFrame({"name": list(schema),
+                                 "type": [t.name for t in schema.values()]})
+
+        if re.match(r"SHOW\s+TABLES$", up):
+            return pd.DataFrame({"name": sorted(self._tables)})
+        return None
 
     def generate_plan(self, query: str):
         """Return the optimized logical plan (EXPLAIN analogue)."""

@@ -315,7 +315,43 @@ REGISTRY: Dict[str, Callable[[List[Expr]], Expr]] = {
     "monthname": _monthname, "dayname": _dayname,
     "week": None, "weekofyear": None,  # DtField — handled by planner
     "to_date": _to_date, "try_to_date": _to_date,
+    # ---- semi-structured (reference: bodosql/kernels/
+    # semistructured_array_kernels.py) --------------------------------
+    "array_size": None, "get": None, "get_path": None,  # filled below
 }
+
+
+def _array_size(args: List[Expr]) -> Expr:
+    from bodo_tpu.plan.expr import NestedFn
+    _nargs(args, 1, 1, "array_size")
+    return NestedFn("list_len", (), args[0])
+
+
+def _get(args: List[Expr]) -> Expr:
+    from bodo_tpu.plan.expr import NestedFn
+    _nargs(args, 2, 2, "get")
+    v = _lit(args[1], "get key/index")
+    if isinstance(v, str):
+        return NestedFn("field", (v,), args[0])
+    return NestedFn("list_get", (int(v),), args[0])
+
+
+def _get_path(args: List[Expr]) -> Expr:
+    from bodo_tpu.plan.expr import NestedFn
+    _nargs(args, 2, 2, "get_path")
+    path = _lit_str(args[1], "path")
+    parts = [p.strip("'\"") for p in
+             path.replace("]", "").replace("[", ".").split(".") if p]
+    if len(parts) != 1:
+        # nested values hold scalars in this engine (one dict-encoding
+        # level); a multi-part path would address nested-of-nested
+        raise NotImplementedError(
+            f"multi-part GET_PATH {path!r} (nested values are one "
+            f"level deep)")
+    part = parts[0]
+    if part.lstrip("-").isdigit():
+        return NestedFn("list_get", (int(part),), args[0])
+    return NestedFn("field", (part,), args[0])
 
 
 def _concat_ws(args: List[Expr]) -> Expr:
@@ -329,6 +365,9 @@ def _concat_ws(args: List[Expr]) -> Expr:
 
 
 REGISTRY["concat_ws"] = _concat_ws
+REGISTRY["array_size"] = _array_size
+REGISTRY["get"] = _get
+REGISTRY["get_path"] = _get_path
 REGISTRY = {k: v for k, v in REGISTRY.items() if v is not None}
 
 

@@ -105,6 +105,24 @@ def test_parquet_roundtrip_nested(bdf, pdf, tmp_path_factory, mesh8):
     assert list(back["st"]) == list(pdf["st"])
 
 
+def test_sql_semistructured(pdf, mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    ctx = BodoSQLContext({"t": pdf})
+    got = ctx.sql("""
+        select k, array_size(lst) as n, get(lst, 0) as fst,
+               get(st, 'a') as a, get_path(st, 'b') as b
+        from t
+    """).to_pandas().sort_values("k").reset_index(drop=True)
+    exp_n = [len(v) if v is not None else None for v in pdf["lst"]]
+    assert [None if pd.isna(x) else int(x) for x in got["n"]] == exp_n
+    exp_f = [v[0] if v else None for v in pdf["lst"]]
+    assert [None if pd.isna(x) else int(x) for x in got["fst"]] == exp_f
+    exp_a = [v["a"] if v is not None else None for v in pdf["st"]]
+    assert [None if pd.isna(x) else int(x) for x in got["a"]] == exp_a
+    exp_b = [v["b"] if v is not None else None for v in pdf["st"]]
+    assert [x if isinstance(x, str) else None for x in got["b"]] == exp_b
+
+
 def test_map_column_from_arrow(mesh8, tmp_path_factory):
     import pyarrow as pa
     import pyarrow.parquet as pq

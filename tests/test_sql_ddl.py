@@ -1,0 +1,54 @@
+"""SQL DDL surface: CREATE TABLE/VIEW AS, DROP, DESCRIBE, SHOW TABLES
+(reference: BodoSQL direct-DDL execution, context.py:531 +
+calcite DDLExecutor)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture()
+def ctx():
+    from bodo_tpu.sql import BodoSQLContext
+    df = pd.DataFrame({"k": np.arange(20, dtype=np.int64) % 4,
+                       "v": np.arange(20) * 1.5})
+    return BodoSQLContext({"t": df}), df
+
+
+def test_create_table_as(ctx, mesh8):
+    c, df = ctx
+    st = c.sql("create table agg as select k, sum(v) as s from t group by k")
+    assert "created" in st["status"][0]
+    got = c.sql("select * from agg order by k").to_pandas()
+    exp = (df.groupby("k", as_index=False).agg(s=("v", "sum"))
+           .sort_values("k").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    # CTAS is a snapshot: re-creating without OR REPLACE fails
+    with pytest.raises(ValueError):
+        c.sql("create table agg as select * from t")
+    c.sql("create or replace table agg as select k from t")
+    assert list(c.sql("select * from agg").to_pandas().columns) == ["k"]
+
+
+def test_create_view_stays_lazy(ctx, mesh8):
+    c, df = ctx
+    c.sql("create view big as select * from t where v > 10")
+    got = c.sql("select count(*) as n from big").to_pandas()
+    assert got["n"][0] == int((df["v"] > 10).sum())
+
+
+def test_drop_describe_show(ctx, mesh8):
+    c, df = ctx
+    c.sql("create table x as select * from t")
+    names = c.sql("show tables")
+    assert list(names["name"]) == ["t", "x"]
+    d = c.sql("describe x")
+    assert list(d["name"]) == ["k", "v"]
+    assert list(d["type"]) == ["int64", "float64"]
+    st = c.sql("drop table x")
+    assert "dropped" in st["status"][0]
+    assert list(c.sql("show tables")["name"]) == ["t"]
+    st = c.sql("drop table if exists x")
+    assert "skipped" in st["status"][0]
+    with pytest.raises(ValueError):
+        c.sql("drop table x")

@@ -87,6 +87,58 @@ def _opened(path: str):
             src.close()
 
 
+def _attach_footer_ranges(t, files) -> None:
+    """Column.vrange from parquet row-group statistics (free from the
+    footer — the reference planner reads the same stats for pushdown,
+    bodo/io/parquet_pio.py). Integer and timestamp columns only; any
+    file/row-group without stats clears that column's bound."""
+    import numpy as np
+
+    from bodo_tpu.table import dtypes as dt
+    ranges: dict = {}
+    try:
+        for f in files:
+            with _opened(f) as src:
+                md = pq.ParquetFile(src).metadata
+            for rg in range(md.num_row_groups):
+                g = md.row_group(rg)
+                for ci in range(g.num_columns):
+                    col = g.column(ci)
+                    name = col.path_in_schema
+                    if "." in name or name not in t.columns:
+                        continue
+                    st = col.statistics
+                    if st is None or not st.has_min_max:
+                        ranges[name] = None
+                        continue
+                    lo, hi = st.min, st.max
+                    import datetime as _dtm
+                    if isinstance(lo, (int, np.integer)):
+                        lo, hi = int(lo), int(hi)
+                    elif isinstance(lo, _dtm.datetime):
+                        lo = int(np.datetime64(lo, "ns").astype(np.int64))
+                        hi = int(np.datetime64(hi, "ns").astype(np.int64))
+                    elif isinstance(lo, _dtm.date):  # DATE: days
+                        lo = int(np.datetime64(lo, "D").astype(np.int64))
+                        hi = int(np.datetime64(hi, "D").astype(np.int64))
+                    else:
+                        ranges[name] = None
+                        continue
+                    if name in ranges:
+                        if ranges[name] is not None:
+                            ranges[name] = (min(ranges[name][0], lo),
+                                            max(ranges[name][1], hi))
+                    else:
+                        ranges[name] = (lo, hi)
+    except Exception:  # stats are an optimization — never fail the read
+        return
+    for name, r in ranges.items():
+        c = t.columns.get(name)
+        if r is not None and c is not None and \
+                c.dtype.kind in ("i", "u", "dt", "date"):
+            c.vrange = (r[0], r[1], True)  # scan stats are data-exact
+
+
 def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None) -> Table:
@@ -114,7 +166,9 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
                     parts.append(pq.read_table(
                         src, columns=list(columns) if columns else None))
             at = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
-        return arrow_to_table(at)
+        t = arrow_to_table(at)
+        _attach_footer_ranges(t, files)
+        return t
 
     # row-group assignment across processes (reference: parquet_reader.cpp
     # get_scan_units distribution); each file opened/parsed once

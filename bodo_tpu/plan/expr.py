@@ -677,6 +677,70 @@ def expr_columns(e: Expr) -> set:
 
 
 # ---------------------------------------------------------------------------
+# static value-range inference (host side; feeds Column.vrange)
+# ---------------------------------------------------------------------------
+
+# fields with fixed output ranges regardless of input
+_FIELD_RANGES = {"month": (1, 12), "hour": (0, 23), "day": (1, 31),
+                 "dayofweek": (0, 6), "weekday": (0, 6),
+                 "quarter": (1, 4), "minute": (0, 59), "second": (0, 59),
+                 "week": (1, 53), "weekofyear": (1, 53),
+                 "dayofyear": (1, 366)}
+
+
+def expr_range(e: Expr, columns) -> Optional[tuple]:
+    """Host-known (lo, hi, tight) bound on the physical values of `e`,
+    or None. `columns` maps name -> Column (for source vranges).
+    `tight` means refinement (an exact device min/max) would not shrink
+    the bound enough to matter — parquet scan stats and literals are
+    tight, fixed field ranges (month in 1..12) are loose. Conservative:
+    returns None unless the bound is certain."""
+    if isinstance(e, ColRef):
+        c = columns.get(e.name)
+        return c.vrange if c is not None else None
+    if isinstance(e, Lit):
+        v = e.value
+        if isinstance(v, (bool, np.bool_)):
+            return (int(v), int(v), True)
+        if isinstance(v, (int, np.integer)):
+            return (int(v), int(v), True)
+        return None
+    if isinstance(e, DtField):
+        if e.field in _FIELD_RANGES:
+            lo, hi = _FIELD_RANGES[e.field]
+            return (lo, hi, False)
+        src = expr_range(e.operand, columns)
+        if src is None:
+            return None
+        lo, hi = src[0], src[1]
+        tight = len(src) > 2 and bool(src[2])
+        if e.field == "date":       # monotone in ticks
+            day = 86_400_000_000_000
+            return (int(lo) // day, int(hi) // day, tight)
+        if e.field == "year":       # monotone in ticks
+            return (int(np.datetime64(int(lo), "ns").astype(
+                        "datetime64[Y]").astype(int)) + 1970,
+                    int(np.datetime64(int(hi), "ns").astype(
+                        "datetime64[Y]").astype(int)) + 1970, tight)
+        return None
+    if isinstance(e, Where):
+        a = expr_range(e.iftrue, columns)
+        b = expr_range(e.iffalse, columns)
+        if a is None or b is None:
+            return None
+        return (min(a[0], b[0]), max(a[1], b[1]),
+                (len(a) > 2 and bool(a[2])) and
+                (len(b) > 2 and bool(b[2])))
+    if isinstance(e, Cast):
+        if e.to.kind in ("i", "u"):
+            return expr_range(e.operand, columns)
+        return None
+    if isinstance(e, MaskNull):
+        return expr_range(e.operand, columns)
+    return None
+
+
+# ---------------------------------------------------------------------------
 # evaluation (device side, traced)
 # ---------------------------------------------------------------------------
 

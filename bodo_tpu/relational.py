@@ -56,6 +56,17 @@ def _as_local(t: Table) -> Optional[Table]:
     return None
 
 
+def _keep_vranges(res: Table, src: Table) -> Table:
+    """Row-preserving ops (filter/sort/shuffle/slice) keep host value
+    bounds: values are a permutation/subset of the source, so the
+    source's (lo, hi) bound still holds."""
+    for n, c in res.columns.items():
+        s = src.columns.get(n)
+        if c.vrange is None and s is not None and s.dtype is c.dtype:
+            c.vrange = s.vrange
+    return res
+
+
 def _dicts(t: Table) -> Dict[str, np.ndarray]:
     return {n: c.dictionary for n, c in t.columns.items()
             if c.dictionary is not None}
@@ -246,7 +257,10 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
 
             @jax.jit
             def fn(tree):
-                out = dict(tree)
+                # return ONLY the new columns: passing untouched inputs
+                # through a jitted function copies them (no donation) —
+                # a full-table memcpy per assign on wide tables
+                out = {}
                 cap = next(iter(tree.values()))[0].shape[0]
                 for name, e in exprs.items():
                     d, v = eval_expr(e, tree, dicts, schema)
@@ -255,11 +269,16 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
                     out[name] = (d, v)
                 return out
             _jit_cache[key] = fn
-        out_tree = fn(t.device_data())
+        new_tree = fn(t.device_data())
         dtypes = {n: infer_dtype(e, schema) for n, e in new.items()}
-        res = t.with_device_data(out_tree, dtypes=dtypes)
+        cols = dict(t.columns)  # untouched columns: same device arrays
+        for n in new:
+            d, v = new_tree[n]
+            cols[n] = Column(d, v, dtypes[n], None)
+        res = Table(cols, t.nrows, t.distribution, t.counts)
         # dictionary propagation: renames keep the source dictionary,
         # numeric outputs drop stale dictionaries
+        from bodo_tpu.plan.expr import expr_range
         for n, e in new.items():
             c = res.columns[n]
             dict_typed = c.dtype is dt.STRING or dt.is_nested(c.dtype)
@@ -270,7 +289,13 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
                 res.columns[n] = Column(c.data, c.valid, c.dtype,
                                         t.columns[e.name].dictionary)
             elif not dict_typed:
-                res.columns[n] = Column(c.data, c.valid, c.dtype, None)
+                res.columns[n] = Column(c.data, c.valid, c.dtype, None,
+                                        expr_range(e, t.columns))
+        # untouched columns keep their host-known value bounds
+        for n, c in t.columns.items():
+            if n in res.columns and n not in new and n not in dm_cols and \
+                    res.columns[n].vrange is None:
+                res.columns[n].vrange = c.vrange
     else:
         res = t.with_columns(t.columns)
     for n, c in dm_cols.items():
@@ -350,23 +375,30 @@ def filter_table(t: Table, predicate: Expr) -> Table:
     if t.distribution == ONED:
         out_tree, cnts = fn(t.device_data(), t.counts_device())
         counts = np.asarray(jax.device_get(cnts)).astype(np.int64)
-        return rebucket(t.with_device_data(out_tree, nrows=int(counts.sum()),
-                                           counts=counts))
+        return _keep_vranges(
+            rebucket(t.with_device_data(out_tree, nrows=int(counts.sum()),
+                                        counts=counts)), t)
     out_tree, cnt = fn(t.device_data(), jnp.asarray(t.nrows))
-    return rebucket(t.with_device_data(out_tree, nrows=int(cnt)))
+    return _keep_vranges(rebucket(t.with_device_data(out_tree,
+                                                     nrows=int(cnt))), t)
 
 
 # ---------------------------------------------------------------------------
 # key packing (multi-key → one int64 when ranges fit)
 # ---------------------------------------------------------------------------
 
-def _key_ranges(t: Table, keys: Sequence[str]):
+def _key_ranges(t: Table, keys: Sequence[str], use_bounds: bool = True):
     """Host-known (lo, hi) range per key column, or None when unpackable.
-    Strings use the dictionary size; bools are 0/1; ints/dates reduce
-    min/max on device (one cheap fused pass)."""
+    Strings use the dictionary size; bools are 0/1; ints/dates use the
+    column's host-known bound (`Column.vrange` — parquet stats / static
+    field ranges) when present, else reduce min/max on device. Returns
+    (ranges, inexact): `inexact` holds the positions served from bounds
+    — callers whose gates fail on a bound call `_refine_ranges` to get
+    the exact span before giving up."""
     ranges = []
+    inexact = set()
     need_reduce = []
-    for k in keys:
+    for i, k in enumerate(keys):
         c = t.column(k)
         if c.dtype is dt.STRING:
             ranges.append((0, max(len(c.dictionary) - 1, 0))
@@ -374,8 +406,16 @@ def _key_ranges(t: Table, keys: Sequence[str]):
         elif c.dtype.kind == "b":
             ranges.append((0, 1))
         elif c.dtype.kind in ("i", "u") or c.dtype in (dt.DATE,):
-            ranges.append("reduce")
-            need_reduce.append(k)
+            if use_bounds and c.vrange is not None:
+                ranges.append((int(c.vrange[0]), int(c.vrange[1])))
+                # tight bounds (parquet scan stats) are not worth an
+                # exact re-reduce; loose ones (static field ranges like
+                # month 1..12) are refinable on a gate near-miss
+                if not (len(c.vrange) > 2 and c.vrange[2]):
+                    inexact.add(i)
+            else:
+                ranges.append("reduce")
+                need_reduce.append(k)
         else:  # floats/datetimes: don't pack
             ranges.append(None)
     if need_reduce:
@@ -393,7 +433,19 @@ def _key_ranges(t: Table, keys: Sequence[str]):
                 lo = _range_int(stats[f"{k}__min"])
                 hi = _range_int(stats[f"{k}__max"])
                 ranges[i] = None if lo is None or hi is None else (lo, hi)
-    return ranges
+    return ranges, inexact
+
+
+def _refine_ranges(t: Table, keys: Sequence[str], ranges, inexact):
+    """Replace bound-derived entries with exact device-reduced spans."""
+    if not inexact:
+        return ranges, set()
+    exact, _ = _key_ranges(t, [keys[i] for i in sorted(inexact)],
+                           use_bounds=False)
+    out = list(ranges)
+    for i, r in zip(sorted(inexact), exact):
+        out[i] = r
+    return out, set()
 
 
 def _range_int(v) -> Optional[int]:
@@ -418,20 +470,34 @@ def _pack_plan(t: Table, keys: Sequence[str], max_bits: int = 62,
     per field is reserved for null keys (so dropna still works)."""
     if not config.pack_keys or len(keys) < 2:
         return None
+    inexact = set()
     if ranges is None:
-        ranges = _key_ranges(t, keys)
-    fields = []
-    total = 0
-    for k, r in zip(keys, ranges):
-        if r is None:
-            return None
-        lo, hi = r
-        span = hi - lo + 2  # +1 for the null/sentinel code
-        bits = max(1, int(span - 1).bit_length())
-        fields.append((k, lo, bits))
-        total += bits
-        if total > max_bits:
-            return None
+        ranges, inexact = _key_ranges(t, keys)
+
+    def layout(rs):
+        fields = []
+        total = 0
+        for k, r in zip(keys, rs):
+            if r is None:
+                return None
+            lo, hi = r
+            span = hi - lo + 2  # +1 for the null/sentinel code
+            bits = max(1, int(span - 1).bit_length())
+            fields.append((k, lo, bits))
+            total += bits
+            if total > max_bits:
+                return None
+        return fields, total
+
+    got = layout(ranges)
+    if got is None and inexact and \
+            not any(r is None for r in ranges):
+        # loose bounds overflowed the bit budget — retry with exact spans
+        ranges, inexact = _refine_ranges(t, keys, ranges, inexact)
+        got = layout(ranges)
+    if got is None:
+        return None
+    fields, total = got
     # first key in the TOP bits so packed ascending == lexicographic order
     plan = []
     shift = total
@@ -525,21 +591,36 @@ def groupby_agg(t: Table, keys: Sequence[str],
                             for _, op, _ in aggs))
     want_ranges = bool(keys) and (
         dense_ok or (config.pack_keys and len(keys) >= 2))
-    ranges = _key_ranges(t, keys) if want_ranges else None
+    ranges, inexact = _key_ranges(t, keys) if want_ranges else (None, set())
+
+    def _dense_slots(rs) -> int:
+        n = 1
+        for lo, hi in rs:  # python ints: no overflow on wild ranges
+            n *= int(hi) - int(lo) + 1
+            if n > config.dense_groupby_max_slots:
+                break
+        return n
+
     if dense_ok and ranges is not None and \
             all(r is not None for r in ranges):
-        n_slots = 1
-        for lo, hi in ranges:  # python ints: no overflow on wild ranges
-            n_slots *= int(hi) - int(lo) + 1
-            if n_slots > config.dense_groupby_max_slots:
-                break
+        n_slots = _dense_slots(ranges)
         # dense pays a fixed O(n_slots) cost — only worth it when the slot
         # space isn't much larger than the input
-        if 0 < n_slots <= config.dense_groupby_max_slots and \
-                n_slots <= 2 * max(t.nrows, 1):
+        gate = (0 < n_slots <= config.dense_groupby_max_slots and
+                n_slots <= 2 * max(t.nrows, 1))
+        if not gate and inexact:
+            # loose bounds may have inflated the slot product past the
+            # gate — one exact reduce is cheaper than losing the dense
+            # path on a near-miss
+            ranges, inexact = _refine_ranges(t, keys, ranges, inexact)
+            n_slots = _dense_slots(ranges)
+            gate = (0 < n_slots <= config.dense_groupby_max_slots and
+                    n_slots <= 2 * max(t.nrows, 1))
+        if gate:
             return _groupby_agg_dense(t, keys, list(aggs), ranges)
 
-    pack = _pack_plan(t, keys, 62, ranges=ranges)
+    pack = _pack_plan(t, keys, 62,
+                      ranges=None if inexact else ranges)
     if pack is not None:
         return _groupby_agg_packed(t, keys, list(aggs), pack)
     specs = tuple(op for _, op, _ in aggs)
@@ -566,7 +647,7 @@ def groupby_agg(t: Table, keys: Sequence[str],
     cols: Dict[str, Column] = {}
     for kname, (kd, kv) in zip(keys, out_keys):
         src = t.column(kname)
-        cols[kname] = Column(kd, kv, src.dtype, src.dictionary)
+        cols[kname] = Column(kd, kv, src.dtype, src.dictionary, src.vrange)
     for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
         src = t.column(cname)
         cols[oname] = _agg_out_col(src, op, vd, vv)
@@ -667,7 +748,7 @@ def _groupby_agg_packed(t: Table, keys, aggs, pack) -> Table:
             d = d.astype(bool)
         elif d.dtype != src.dtype.numpy:
             d = d.astype(src.dtype.numpy)
-        cols[name] = Column(d, None, src.dtype, src.dictionary)
+        cols[name] = Column(d, None, src.dtype, src.dictionary, src.vrange)
     for _, _, oname in aggs:
         cols[oname] = out.columns[oname]
     return Table(cols, out.nrows, out.distribution, out.counts)
@@ -827,7 +908,7 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
             kd = kd.astype(bool)
         elif kd.dtype != src.dtype.numpy:
             kd = kd.astype(src.dtype.numpy)
-        cols[kname] = Column(kd, None, src.dtype, src.dictionary)
+        cols[kname] = Column(kd, None, src.dtype, src.dictionary, src.vrange)
     for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
         src = t.column(cname)
         cols[oname] = _agg_out_col(src, op, vd, vv)
@@ -868,7 +949,7 @@ def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
     cols: Dict[str, Column] = {}
     for kname, (kd, kv) in zip(keys, out_keys):
         src = t.column(kname)
-        cols[kname] = Column(kd, kv, src.dtype, src.dictionary)
+        cols[kname] = Column(kd, kv, src.dtype, src.dictionary, src.vrange)
     for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
         src = t.column(cname)
         cols[oname] = _agg_out_col(src, op, vd, vv)
@@ -895,7 +976,7 @@ def sort_table(t: Table, by: Sequence[str], ascending=None,
         if pack is not None:
             tp = _packed_key_table(t, pack, with_valid=False)
             res = sort_table(tp, ["__packed"], [True], na_last)
-            return res.select(t.names)
+            return _keep_vranges(res.select(t.names), t)
     others = [n for n in t.names if n not in by]
     order = by + others
     arrays = tuple((t.column(n).data, t.column(n).valid) for n in order)
@@ -914,7 +995,7 @@ def sort_table(t: Table, by: Sequence[str], ascending=None,
                             tuple(ascending), na_last)
         res_tree = {n: out[i] for i, n in enumerate(order)}
         res = t.with_device_data(res_tree, nrows=t.nrows)
-    return res.select(t.names)
+    return _keep_vranges(res.select(t.names), t)
 
 
 # ---------------------------------------------------------------------------
@@ -1058,18 +1139,30 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes,
         # semantics a null-null pair would be silently missed when both
         # sides can hold nulls — use the sort join there
         return None
-    ranges = _key_ranges(right, right_on)
+    ranges, inexact = _key_ranges(right, right_on)
     if any(r is None for r in ranges):
         return None
+
+    def _slots(rs) -> int:
+        n = 1
+        for lo, hi in rs:
+            n *= int(hi) - int(lo) + 1
+            if n > config.dense_join_max_slots:
+                break
+        return n
+
+    n_slots = _slots(ranges)
+    ok = (n_slots <= config.dense_join_max_slots and
+          n_slots <= 16 * right.nrows + 1024)
+    if not ok and inexact:
+        ranges, inexact = _refine_ranges(right, right_on, ranges, inexact)
+        n_slots = _slots(ranges)
+        ok = (n_slots <= config.dense_join_max_slots and
+              n_slots <= 16 * right.nrows + 1024)
+    if not ok:
+        return None  # too large or too sparse: LUT cost would dominate
     sizes = tuple(int(hi) - int(lo) + 1 for lo, hi in ranges)
     los = tuple(int(lo) for lo, _ in ranges)
-    n_slots = 1
-    for s in sizes:
-        n_slots *= s
-        if n_slots > config.dense_join_max_slots:
-            return None
-    if n_slots > 16 * right.nrows + 1024:
-        return None  # too sparse: LUT cost would dominate
 
     lorder, rorder, pa, ba = _probe_build_arrays(left, right, left_on,
                                                  right_on)
@@ -1164,13 +1257,13 @@ def _assemble_join(left, right, left_on, right_on, lorder, rorder,
             assert v is not None and bv is not None
             d = jnp.where(v, d, bd.astype(d.dtype))
             v = v | bv
-        cols[lmap[n]] = Column(d, v, src.dtype, src.dictionary)
+        cols[lmap[n]] = Column(d, v, src.dtype, src.dictionary, src.vrange)
     for i, n in enumerate(rorder):
         if n not in rmap:
             continue
         src = right.column(n)
         d, v = out_b[i]
-        cols[rmap[n]] = Column(d, v, src.dtype, src.dictionary)
+        cols[rmap[n]] = Column(d, v, src.dtype, src.dictionary, src.vrange)
     dist = ONED if counts is not None else REP
     res = Table(cols, nrows, dist, counts)
     # restore pandas-ish column order: left cols then right cols
@@ -1813,7 +1906,7 @@ def _agg_window_exec(t: Table, partition_by, order_by, specs,
         src = t.column(col)
         if op in ("lead", "lag", "first_value", "last_value"):
             # gather ops carry the source dtype (and dictionary)
-            res.columns[oname] = Column(d, v, src.dtype, src.dictionary)
+            res.columns[oname] = Column(d, v, src.dtype, src.dictionary, src.vrange)
         else:
             # same dtype/descale rules as groupby aggregation outputs
             # (sum0 = pandas-style sum: 0 over empty frames, same dtype)
@@ -2136,7 +2229,7 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
     counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
     tree = {n: out[i] for i, n in enumerate(korder)}
     res = t.with_device_data(tree, nrows=int(counts.sum()), counts=counts)
-    return shrink_to_fit(res.select(names))
+    return _keep_vranges(shrink_to_fit(res.select(names)), t)
 
 
 def shard_frames(t: Table) -> List:

@@ -50,6 +50,13 @@ class Column:
     valid: Optional[jax.Array]           # [capacity] bool, None = no nulls
     dtype: DType
     dictionary: Optional[np.ndarray] = None  # sorted unique strings (host)
+    # host-known (lo, hi) bound on the PHYSICAL values (parquet footer
+    # stats, static DtField ranges, literal projections). A bound, not
+    # exact: row-preserving ops (filter/sort/shuffle/join gathers) keep
+    # it — the dense groupby/join/pack planners then skip their exact
+    # min/max device reductions (the reference gets the same shortcut
+    # from parquet row-group statistics in its planner)
+    vrange: Optional[tuple] = None
 
     @property
     def capacity(self) -> int:
@@ -338,7 +345,8 @@ class Table:
                     pv[i * per:i * per + c] = hv[off:off + c]
                     off += c
                 valid = jax.device_put(pv, sharding)
-            new_cols[name] = Column(data, valid, col.dtype, col.dictionary)
+            new_cols[name] = Column(data, valid, col.dtype, col.dictionary,
+                                    col.vrange)
         return Table(new_cols, self.nrows, ONED, counts)
 
     def gather(self) -> "Table":
@@ -367,7 +375,7 @@ class Table:
                 vpad[: self.nrows] = vpacked
                 valid = jnp.asarray(vpad)
             new_cols[name] = Column(jnp.asarray(padded), valid, col.dtype,
-                                    col.dictionary)
+                                    col.dictionary, col.vrange)
         return Table(new_cols, self.nrows, REP, None)
 
     # ---- kernel interface ------------------------------------------------

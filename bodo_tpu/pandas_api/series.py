@@ -478,7 +478,15 @@ class _CatAccessor:
     """Series.cat — categorical introspection over the dict encoding
     (reference: bodo/hiframes/pd_categorical_ext.py). Strings are
     dictionary-encoded with a sorted dictionary, so the dictionary IS
-    the category array and the codes match pandas' astype('category')."""
+    the category array.
+
+    Divergence from pandas (intentional): the dictionary persists
+    through filters, so after a filter removes every row of some
+    category, `.cat.codes`/`.cat.categories` still reflect the FULL
+    dictionary while pandas' `astype('category')` renumbers codes over
+    the remaining uniques. This matches the engine-wide rule that
+    dictionaries are value domains, not observed-value sets (same as
+    the reference's dict-encoded arrays, bodo/libs/dict_arr_ext.py)."""
 
     def __init__(self, s: BodoSeries):
         if s._dtype is not dt.STRING:

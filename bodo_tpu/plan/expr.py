@@ -347,6 +347,27 @@ class MaskNull(Expr):
     def key(self): return ("masknull", self.cond.key(), self.operand.key())
 
 
+def contains_expr(e, cls, stop=()) -> bool:
+    """True when `e` or any sub-expression is an instance of `cls`
+    (generic dataclass-field walk; tuples of Exprs are descended).
+    Subtrees rooted at a `stop` node are not entered — callers use this
+    to exempt nodes that consume the target legally (e.g. StrPredicate
+    evaluates a CodeLUT operand at the dictionary level itself)."""
+    if isinstance(e, cls):
+        return True
+    if stop and isinstance(e, stop):
+        return False
+    import dataclasses
+    if not dataclasses.is_dataclass(e):
+        return False
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        for x in (v if isinstance(v, tuple) else (v,)):
+            if isinstance(x, Expr) and contains_expr(x, cls, stop):
+                return True
+    return False
+
+
 @_frozen
 class CodeLUT(Expr):
     """String column from a small static vocabulary indexed by an integer
@@ -496,8 +517,10 @@ class StrToList(Expr):
 @_frozen
 class StrCodes(Expr):
     """Dictionary codes of a string column as int32 (pandas .cat.codes
-    analogue: the dictionary is sorted, so codes equal the categorical
-    codes of `astype('category')`; nulls become -1). Reference:
+    analogue; nulls become -1). The dictionary is sorted, so on a
+    freshly-scanned column codes equal `astype('category')` codes; after
+    a filter the full dictionary persists, so codes may be sparser than
+    pandas' renumbering (see _CatAccessor docstring). Reference:
     bodo/hiframes/pd_categorical_ext.py get_categorical_arr_codes."""
     operand: Expr
     def key(self): return ("strcodes", self.operand.key())
@@ -733,7 +756,18 @@ def expr_range(e: Expr, columns) -> Optional[tuple]:
                 (len(b) > 2 and bool(b[2])))
     if isinstance(e, Cast):
         if e.to.kind in ("i", "u"):
-            return expr_range(e.operand, columns)
+            r = expr_range(e.operand, columns)
+            if r is None:
+                return None
+            # a narrowing cast (int64 → int32/int8) wraps values that
+            # exceed the target type, so the operand's bound is only
+            # sound when it fits entirely within the target's range —
+            # otherwise dense-groupby planners would trust a violated
+            # bound and silently mis-slot rows
+            info = np.iinfo(e.to.numpy)
+            if info.min <= r[0] and r[1] <= info.max:
+                return r
+            return None
         return None
     if isinstance(e, MaskNull):
         return expr_range(e.operand, columns)

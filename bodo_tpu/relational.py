@@ -117,6 +117,21 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     new = {n: e for n, e in new.items()
            if n not in dictmaps and n not in strcats
            and n not in strsplits and n not in nestedfns}
+    # a CodeLUT nested under Where/BinOp (e.g. IFF(c, MONTHNAME(d),
+    # DAYNAME(d))) would evaluate to raw LUT codes with no dictionary
+    # attached — reject loudly rather than decode garbage downstream.
+    # CodeLUT under a string-CONSUMING node (StrPredicate/StrLen/
+    # StrHostFn produce bool/int, evaluating the LUT at dictionary
+    # level) is legal and exempted via `stop`.
+    from bodo_tpu.plan.expr import (StrCodes, StrHostFn, StrLen,
+                                    StrPredicate,
+                                    contains_expr as _contains)
+    for n, e in new.items():
+        if not isinstance(e, CodeLUT) and _contains(
+                e, CodeLUT, stop=(StrPredicate, StrLen, StrHostFn, StrCodes)):
+            raise NotImplementedError(
+                "CodeLUT (MONTHNAME/DAYNAME) nested under "
+                f"{type(e).__name__} is not supported as a projection")
     dm_cols: Dict[str, Column] = {}
 
     def _str_part(e):
@@ -1251,13 +1266,26 @@ def _assemble_join(left, right, left_on, right_on, lorder, rorder,
     for i, n in enumerate(lorder):
         src = left.column(n)
         d, v = out_p[i]
+        vr = src.vrange
         if n in merged_keys:
             ki = merged_keys[n]
             bd, bv = out_b[ki]
             assert v is not None and bv is not None
             d = jnp.where(v, d, bd.astype(d.dtype))
             v = v | bv
-        cols[lmap[n]] = Column(d, v, src.dtype, src.dictionary, src.vrange)
+            # the merged column now carries RIGHT-side values on
+            # build-only rows, so the left bound alone is unsound: a
+            # later dense groupby/join would trust a stale (lo, hi) and
+            # silently mis-slot right-only keys. Union both bounds
+            # (None if either side is unbounded).
+            rvr = right.column(right_on[ki]).vrange
+            if vr is not None and rvr is not None:
+                tight = (len(vr) > 2 and vr[2]) and (len(rvr) > 2
+                                                     and rvr[2])
+                vr = (min(vr[0], rvr[0]), max(vr[1], rvr[1]), tight)
+            else:
+                vr = None
+        cols[lmap[n]] = Column(d, v, src.dtype, src.dictionary, vr)
     for i, n in enumerate(rorder):
         if n not in rmap:
             continue

@@ -130,3 +130,97 @@ def test_pool_overcommit_stat():
         b.free()
     assert pool.stats()["bytes_over_limit"] == 0
     pool.close()
+
+
+# ---------------------------------------------------------------------------
+# round-3 advisor findings
+# ---------------------------------------------------------------------------
+
+def test_outer_join_merged_key_vrange_union(mesh8):
+    """ADVICE r3 (high): a full-outer merged key column carries RIGHT-side
+    values on build-only rows, so propagating only the LEFT vrange lets a
+    later dense groupby trust a violated bound and mis-slot rows."""
+    import bodo_tpu.relational as R
+    from bodo_tpu import Table
+    from bodo_tpu.table.table import Column
+
+    left = pd.DataFrame({"k": [0, 1, 2, 3], "a": [1.0, 2.0, 3.0, 4.0]})
+    # right keys exceed the left's bound — the normal outer-join case
+    right = pd.DataFrame({"k": [2, 3, 900, 901], "b": [10.0] * 4})
+    exp = (left.merge(right, on="k", how="outer")
+           .groupby("k", as_index=False).agg(n=("a", "size"))
+           .sort_values("k").reset_index(drop=True))
+
+    lt = Table.from_pandas(left)
+    # simulate a parquet-stats tight bound on the left key
+    c = lt.columns["k"]
+    lt.columns["k"] = Column(c.data, c.valid, c.dtype, c.dictionary,
+                             (0, 3, True))
+    rt = Table.from_pandas(right)
+    joined = R.join_tables(lt, rt, ["k"], ["k"], "outer", ("_x", "_y"))
+    vr = joined.column("k").vrange
+    assert vr is None or (vr[0] <= 0 and vr[1] >= 901), vr
+    got = (R.groupby_agg(joined, ["k"], [("a", "size", "n")])
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    assert got["k"].tolist() == exp["k"].tolist()
+    assert got["n"].tolist() == exp["n"].tolist()
+
+
+def test_narrowing_cast_drops_vrange(mesh8):
+    """ADVICE r3: astype('int8') of a column with a wide bound must not
+    keep the wide bound (wrapped values fall outside it)."""
+    from bodo_tpu.plan.expr import Cast, ColRef, expr_range
+    from bodo_tpu.table import dtypes as dt
+    from bodo_tpu.table.table import Column
+    import jax.numpy as jnp
+
+    cols = {"x": Column(jnp.zeros(4, jnp.int64), None, dt.INT64, None,
+                        (0, 1_000_000, True))}
+    assert expr_range(Cast(ColRef("x"), dt.INT8), cols) is None
+    r = expr_range(Cast(ColRef("x"), dt.INT32), cols)
+    assert r is not None and r[0] == 0 and r[1] == 1_000_000
+
+
+def test_nested_codelut_rejected(mesh8):
+    """ADVICE r3: MONTHNAME/DAYNAME nested under IFF/Where must raise,
+    not silently emit undecodable LUT codes."""
+    import bodo_tpu.relational as R
+    from bodo_tpu import Table
+    from bodo_tpu.plan.expr import (BinOp, CodeLUT, ColRef, DtField, Lit,
+                                    Where)
+
+    df = pd.DataFrame({"d": pd.to_datetime(["2024-01-05", "2024-06-07"]),
+                       "c": [True, False]})
+    t = Table.from_pandas(df)
+    mn = CodeLUT(("January", "February", "March", "April", "May", "June",
+                  "July", "August", "September", "October", "November",
+                  "December"),
+                 BinOp("-", DtField("month", ColRef("d")), Lit(1)))
+    dn = CodeLUT(("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                  "Saturday", "Sunday"), DtField("dayofweek", ColRef("d")))
+    with pytest.raises(NotImplementedError):
+        R.assign_columns(t, {"s": Where(ColRef("c"), mn, dn)})
+    # top-level CodeLUT still works and decodes correctly
+    got = R.assign_columns(t, {"s": mn}).to_pandas()
+    assert got["s"].tolist() == ["January", "June"]
+
+
+def test_codelut_under_string_consumer_still_works(mesh8):
+    """CodeLUT under StrPredicate/StrLen (bool/int outputs) is legal —
+    the guard must not over-reject it."""
+    import bodo_tpu.relational as R
+    from bodo_tpu import Table
+    from bodo_tpu.plan.expr import (BinOp, CodeLUT, ColRef, DtField, Lit,
+                                    StrLen, StrPredicate)
+
+    df = pd.DataFrame({"d": pd.to_datetime(["2024-01-05", "2024-06-07"])})
+    t = Table.from_pandas(df)
+    mn = CodeLUT(("January", "February", "March", "April", "May", "June",
+                  "July", "August", "September", "October", "November",
+                  "December"),
+                 BinOp("-", DtField("month", ColRef("d")), Lit(1)))
+    got = R.assign_columns(t, {"n": StrLen(mn)}).to_pandas()
+    assert got["n"].tolist() == [7, 4]
+    got = R.assign_columns(
+        t, {"m": StrPredicate("eq_any", ("June",), mn)}).to_pandas()
+    assert got["m"].tolist() == [False, True]

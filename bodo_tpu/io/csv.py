@@ -17,6 +17,10 @@ from bodo_tpu.io.arrow_bridge import arrow_to_table
 from bodo_tpu.table.table import Table
 
 
+from bodo_tpu.utils.tracing import traced_table_op as _traced
+
+
+@_traced
 def read_csv(path: str, columns: Optional[Sequence[str]] = None,
              parse_dates: Optional[Sequence[str]] = None) -> Table:
     convert = {}

@@ -139,6 +139,10 @@ def _attach_footer_ranges(t, files) -> None:
             c.vrange = (r[0], r[1], True)  # scan stats are data-exact
 
 
+from bodo_tpu.utils.tracing import traced_table_op as _traced
+
+
+@_traced
 def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None) -> Table:

@@ -100,6 +100,10 @@ def _sig(t: Table) -> Tuple:
 # projection / assignment
 # ---------------------------------------------------------------------------
 
+from bodo_tpu.utils.tracing import traced_table_op as _traced
+
+
+@_traced
 def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     """Add/replace columns computed from expressions (df.assign analogue).
 
@@ -347,6 +351,7 @@ def category_code(categories: Sequence[str], value: str) -> int:
 # filter
 # ---------------------------------------------------------------------------
 
+@_traced
 def filter_table(t: Table, predicate: Expr) -> Table:
     """Filter rows; null predicate counts as False (SQL semantics)."""
     schema = _schema(t)
@@ -566,6 +571,7 @@ def _agg_out_col(src: Column, op: str, vd, vv) -> Column:
                   src.dictionary if rdt is dt.STRING else None)
 
 
+@_traced
 def groupby_agg(t: Table, keys: Sequence[str],
                 aggs: Sequence[Tuple[str, str, str]]) -> Table:
     """Group by `keys`; aggs = [(value_col, op, out_name)].
@@ -975,6 +981,7 @@ def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
 # sort
 # ---------------------------------------------------------------------------
 
+@_traced
 def sort_table(t: Table, by: Sequence[str], ascending=None,
                na_last: bool = True) -> Table:
     by = list(by)
@@ -1027,6 +1034,7 @@ def _suffix_columns(left: Table, right: Table, left_on, right_on,
     return lmap, rmap
 
 
+@_traced
 def join_tables(left: Table, right: Table, left_on: Sequence[str],
                 right_on: Sequence[str], how: str = "inner",
                 suffixes=("_x", "_y"), null_equal: bool = True) -> Table:
@@ -1493,6 +1501,7 @@ def _cross_join(left, right, suffixes) -> Table:
 # window / cumulative / shift
 # ---------------------------------------------------------------------------
 
+@_traced
 def window_table(t: Table, specs: Sequence[Tuple[str, str, Optional[int],
                                                  str]]) -> Table:
     """Row-aligned window transforms: specs = [(col, op, param, outname)].

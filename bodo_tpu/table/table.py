@@ -306,11 +306,15 @@ class Table:
         return Table(cols, n, REP, None)
 
     def to_pandas(self) -> pd.DataFrame:
-        t = self.gather() if self.distribution == ONED else self
-        out = {}
-        for name, col in t.columns.items():
-            out[name] = col.to_numpy(t.nrows)
-        return pd.DataFrame(out)
+        from bodo_tpu.utils import tracing
+        with tracing.event("to_pandas") as ev:
+            t = self.gather() if self.distribution == ONED else self
+            out = {}
+            for name, col in t.columns.items():
+                out[name] = col.to_numpy(t.nrows)
+            if ev is not None:
+                ev["rows"] = t.nrows
+            return pd.DataFrame(out)
 
     # ---- distribution ----------------------------------------------------
     def shard(self) -> "Table":

@@ -76,3 +76,36 @@ def dump(path: Optional[str] = None) -> str:
 def profile() -> Dict[str, dict]:
     """Per-operator aggregate metrics (query-profile-collector analogue)."""
     return {k: dict(v) for k, v in _agg.items()}
+
+
+_op_depth = threading.local()
+
+
+def traced_table_op(fn):
+    """Wrap a Table-returning operator so every call (through ANY entry
+    point — executor, streaming, or direct relational calls) lands in
+    the per-operator profile with a rows count. Only the OUTERMOST
+    traced frame records (operators re-enter each other — distributed
+    groupby calls local groupby, windows call sort — and double-counting
+    would make profile totals exceed wall time). No-op when tracing is
+    off (one predicate check)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        if not is_tracing():
+            return fn(*a, **k)
+        depth = getattr(_op_depth, "d", 0)
+        if depth:
+            return fn(*a, **k)
+        _op_depth.d = 1
+        try:
+            with event(fn.__name__) as ev:
+                t = fn(*a, **k)
+                rows = getattr(t, "nrows", None)
+                if rows is not None and ev is not None:
+                    ev["rows"] = rows
+                return t
+        finally:
+            _op_depth.d = 0
+    return wrapper

@@ -75,6 +75,14 @@ class Config:
         default_factory=lambda: _env_int("BODO_TPU_DENSE_GROUPBY_SLOTS",
                                          1 << 22)
     )
+    # Scatter-claim hash groupby/join (ops/hashtable.py): sort-free
+    # group ids / join LUTs at arbitrary key cardinality.
+    hash_groupby: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_HASH_GROUPBY", True)
+    )
+    hash_join: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_HASH_JOIN", True)
+    )
     # Dense-LUT join: build sides whose key-range product is at most this
     # many slots (and whose keys are unique) join by perfect-hash gather.
     dense_join_max_slots: int = field(

@@ -528,3 +528,155 @@ def _nunique(keys, value, perm, seg, padmask_s, out_cap: int):
                               jnp.minimum(s_seg, jnp.uint64(out_cap)).astype(jnp.int64),
                               num_segments=out_cap + 1)[:out_cap]
     return out, None
+
+
+# ---------------------------------------------------------------------------
+# hash-based local kernel (arbitrary key cardinality, no row sort)
+# ---------------------------------------------------------------------------
+
+# ops the hash path supports: everything _segment_agg computes from
+# (seg, values) alone. Order-sensitive composites (nunique/mode/q:*) and
+# the chan_* distributed combines stay on the sort path.
+HASH_OPS = frozenset({
+    "count", "size", "sum", "sumnull", "sum64", "prod", "min", "max",
+    "first", "last", "mean", "var", "std", "var0", "std0",
+    "m2", "m3", "m4", "skew", "kurt",
+})
+
+
+@jax.jit
+def _hashed_claim(key_arrays, count):
+    """Claim dense group ids for arbitrary keys (no row sort)."""
+    from bodo_tpu.ops import hashtable as HT
+
+    cap = key_arrays[0][0].shape[0]
+    padmask = K.row_mask(count, cap)
+    codes, null_ok = HT.encode_columns(key_arrays, null_equal=False)
+    ok = padmask if null_ok is None else (padmask & null_ok)
+    T = HT.table_size(cap)
+    slot, owner, _r, unresolved = HT.claim_slots(codes, ok, T)
+    seg, group_row, n_groups = HT.densify(slot, owner, T)
+    return seg, group_row, ok, n_groups, unresolved
+
+
+@partial(jax.jit, static_argnames=("specs", "num_keys", "ng_cap"))
+def _hashed_agg(arrays, seg, group_row, ok, specs: Tuple[str, ...],
+                num_keys: int, ng_cap: int):
+    """Aggregate into the ng_cap-sized group space (hash order).
+
+    The segment space is the (host-synced, rounded) GROUP count, not the
+    row capacity — on TPU with few groups this is where the Pallas MXU
+    one-hot accumulate takes over from scatter-adds."""
+    from bodo_tpu.ops import pallas_kernels as PK
+
+    keys = arrays[:num_keys]
+    values = arrays[num_keys:]
+    cap = keys[0][0].shape[0]
+    seg = jnp.where(seg < ng_cap, seg, ng_cap)
+
+    grs = jnp.minimum(jnp.maximum(group_row, 0), cap - 1)
+    gvalid = (group_row >= 0)[:ng_cap]
+    gkeys = tuple(data[grs][:ng_cap] for data, valid in keys)
+
+    # MXU route: f32 sums/counts/means via one fused one-hot matmul
+    mxu = ((PK.use_pallas() or PK.FORCE_INTERPRET)
+           and ng_cap <= PK.MAX_MATMUL_SLOTS and cap <= (1 << 24)
+           and all(op in ("sum", "count", "size", "mean")
+                   for op in specs)
+           and all(op in ("count", "size") or
+                   (jnp.issubdtype(d.dtype, jnp.floating)
+                    and d.dtype.itemsize <= 4)
+                   for (d, v), op in zip(values, specs)))
+    if mxu:
+        mcols, moks, plan = [], [], []
+        for (d, v), op in zip(values, specs):
+            vok = K.value_ok(d, v, ok)
+            if op == "size":
+                plan.append(("size", len(mcols), None))
+                mcols.append(jnp.ones((cap,), jnp.float32))
+                moks.append(ok)
+                continue
+            cnt_idx = len(mcols)
+            mcols.append(jnp.ones((cap,), jnp.float32))
+            moks.append(vok)
+            if op == "count":
+                plan.append(("count", cnt_idx, None))
+            else:
+                s_idx = len(mcols)
+                mcols.append(d.astype(jnp.float32))
+                moks.append(vok)
+                plan.append((op, cnt_idx, s_idx))
+        live = seg < ng_cap
+        sums = PK.dense_accumulate(
+            jnp.where(live, seg, 0).astype(jnp.int32), mcols,
+            [m & live for m in moks], ng_cap)
+        gvals = []
+        for op, cnt_idx, s_idx in plan:
+            if op in ("size", "count"):
+                gvals.append((sums[cnt_idx].astype(jnp.int64), None))
+            elif op == "sum":
+                gvals.append((sums[s_idx], None))
+            else:  # mean
+                cnt = sums[cnt_idx]
+                m = sums[s_idx] / jnp.maximum(cnt, 1.0)
+                gvals.append((jnp.where(cnt > 0, m, jnp.nan), None))
+        gvals = tuple(gvals)
+    else:
+        gvals = tuple(_segment_agg(op, data, valid, seg, ok, ng_cap)
+                      for (data, valid), op in zip(values, specs))
+    return gkeys, gvals, gvalid
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
+def _hashed_sort_groups(gkeys, gvals, gvalid, out_capacity: int):
+    """Sort the group table by keys ascending and emit [out_capacity]
+    outputs packed at the front (pandas sort=True)."""
+    ng_cap = gvalid.shape[0]
+    operands: list = []
+    for a in gkeys:
+        operands.extend(SE.key_operands(a, None, padmask=gvalid))
+    nko = len(operands)
+    operands.append(jnp.arange(ng_cap))
+    gperm = lax.sort(tuple(operands), num_keys=nko, is_stable=True)[-1]
+
+    def scatter(a):
+        z = jnp.zeros((out_capacity,), dtype=a.dtype)
+        src = a[gperm]
+        m = min(ng_cap, out_capacity)
+        return z.at[:m].set(src[:m])
+
+    out_keys = tuple((scatter(a), None) for a in gkeys)
+    out_vals = tuple((scatter(d), None if v is None else scatter(v))
+                     for d, v in gvals)
+    return out_keys, out_vals
+
+
+def groupby_local_hashed(arrays, count, specs: Tuple[str, ...],
+                         out_capacity: int, num_keys: int):
+    """Local groupby via the scatter-claim hash table (ops/hashtable.py)
+    instead of a full-row sort: rows claim dense group ids in a few
+    scatter/gather rounds, aggregates run as segment reductions (or the
+    Pallas MXU one-hot accumulate when the group count fits) over the
+    UNSORTED rows, and only the ~n_groups-row group table is sorted to
+    restore pandas' key-ascending output — O(U log U) instead of
+    O(N log N) with U = number of groups (the reference's hash-groupby
+    advantage, bodo/libs/groupby/_groupby.cpp, realized with XLA
+    scatters instead of serial chains).
+
+    Same contract as groupby_local, plus an `unresolved` flag: True
+    means the probe-round cap was hit (pathological input) and the
+    caller must fall back to the sort kernel."""
+    from bodo_tpu.table.table import round_capacity
+
+    seg, group_row, ok, n_groups, unresolved = _hashed_claim(
+        arrays[:num_keys], count)
+    ng, unres = jax.device_get((n_groups, unresolved))
+    if bool(unres):
+        return None, None, 0, True
+    cap = arrays[0][0].shape[0]
+    ng_cap = min(round_capacity(max(int(ng), 1)), cap)
+    gkeys, gvals, gvalid = _hashed_agg(arrays, seg, group_row, ok, specs,
+                                       num_keys, ng_cap)
+    out_keys, out_vals = _hashed_sort_groups(gkeys, gvals, gvalid,
+                                             out_capacity)
+    return out_keys, out_vals, int(ng), False

@@ -649,6 +649,24 @@ def groupby_agg(t: Table, keys: Sequence[str],
     arrays = tuple((t.column(k).data, t.column(k).valid) for k in keys) + \
         tuple((t.column(c).data, t.column(c).valid) for c in val_names)
 
+    # arbitrary-cardinality hash path (scatter-claim table): no row
+    # sort; only the group table is sorted. Falls back to the sort
+    # kernel on probe-round exhaustion (pathological keys).
+    from bodo_tpu.ops.groupby import HASH_OPS, groupby_local_hashed
+    if (t.distribution == REP and keys and config.hash_groupby
+            and all(op in HASH_OPS for op in specs)):
+        out_keys, out_vals, ng, unresolved = groupby_local_hashed(
+            arrays, jnp.asarray(t.nrows), specs, t.capacity, len(keys))
+        if not unresolved:
+            cols: Dict[str, Column] = {}
+            for kname, (kd, kv) in zip(keys, out_keys):
+                src = t.column(kname)
+                cols[kname] = Column(kd, kv, src.dtype, src.dictionary,
+                                     src.vrange)
+            for (cname, op, oname), (vd, vv) in zip(aggs, out_vals):
+                cols[oname] = _agg_out_col(t.column(cname), op, vd, vv)
+            return shrink_to_fit(Table(cols, ng, REP, None))
+
     if t.distribution == ONED:
         t = shrink_to_fit(t)
         arrays = tuple((t.column(k).data, t.column(k).valid) for k in keys) + \
@@ -1105,6 +1123,11 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
                               null_equal)
         if out is not None:
             return out
+        if left_on:
+            out = _join_hash_try(left, right, left_on, right_on, how,
+                                 suffixes, null_equal)
+            if out is not None:
+                return out
     if how == "outer" and left.distribution == ONED and \
             right.distribution == REP:
         # a replicated build side would emit its unmatched rows once PER
@@ -1252,6 +1275,99 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes,
     return rebucket(res)
 
 
+def _join_hash_try(left, right, left_on, right_on, how, suffixes,
+                   null_equal: bool = True) -> Optional[Table]:
+    """Hash-LUT equi-join: the dense-LUT fast path freed from its
+    key-range gate. The build side claims slots in a scatter-claim hash
+    table (ops/hashtable.py) — owner IS the LUT — and probe rows follow
+    the same double-hash sequence to a match or an empty slot. Unique
+    build keys ⇒ ≤1 match per probe row ⇒ static probe-side output
+    capacity, no sort, no shuffle. Arbitrary key dtypes/ranges
+    (reference: bodo/libs/_hash_join.cpp build/probe). Returns None on
+    duplicate build keys or probe-round exhaustion (caller falls back
+    to the sort join)."""
+    from bodo_tpu.ops import hashtable as HT
+    if how not in ("inner", "left") or right.nrows == 0 or \
+            not config.hash_join:
+        return None
+    lorder, rorder, pa, ba = _probe_build_arrays(left, right, left_on,
+                                                 right_on)
+    nk = len(left_on)
+    T = HT.table_size(right.capacity)
+    # per-key null-column layout must match across both sides' encodings
+    # (one side nullable, the other not, is the normal case)
+    def _nullable(c):
+        return c.valid is not None or             np.issubdtype(np.dtype(c.dtype.numpy), np.floating)
+    null_cols = tuple(_nullable(left.column(lk)) or _nullable(right.column(rk))
+                      for lk, rk in zip(left_on, right_on))
+
+    bkey = ("hashjoin_build", _sig(right.select(rorder)), nk, null_equal, T,
+            null_cols)
+    bfn = _jit_cache.get(bkey)
+    if bfn is None:
+        def bbody(arrays, count):
+            cap = arrays[0][0].shape[0]
+            codes, null_ok = HT.encode_columns_aligned(
+                arrays[:nk], null_cols, null_equal)
+            ok = K.row_mask(count, cap)
+            if null_ok is not None:
+                ok = ok & null_ok
+            slot, owner, _r, unresolved = HT.claim_slots(codes, ok, T)
+            cnt = jnp.zeros(T, jnp.int32).at[
+                jnp.where(slot >= 0, slot, T)].add(1, mode="drop")
+            dup = jnp.any(cnt > 1)
+            return codes, owner, dup | unresolved
+
+        bfn = jax.jit(bbody)
+        _jit_cache[bkey] = bfn
+
+    bcodes, owner, bad = bfn(ba, jnp.asarray(right.nrows))
+    if bool(jax.device_get(bad)):
+        return None  # duplicate build keys (or pathological probing)
+
+    pkey = ("hashjoin_probe", _sig(left.select(lorder)),
+            _sig(right.select(rorder)), nk, null_equal, T, how, null_cols)
+    pfn = _jit_cache.get(pkey)
+    if pfn is None:
+        def pbody(p_arrays, b_arrays, bcodes, owner, pcount):
+            cap = p_arrays[0][0].shape[0]
+            codes, null_ok = HT.encode_columns_aligned(
+                p_arrays[:nk], null_cols, null_equal)
+            live = K.row_mask(pcount, cap)
+            if null_ok is not None:
+                live = live & null_ok
+            idx, p_unres = HT.probe_slots(bcodes, owner, codes, live, T)
+            hit = idx >= 0
+            safe = jnp.maximum(idx, 0)
+            out_b = []
+            for d, v in b_arrays:
+                od = d[safe]
+                ov = hit if v is None else (hit & v[safe])
+                out_b.append((od, ov))
+            if how == "inner":
+                flat, slots = _flatten_with_valids(
+                    tuple(p_arrays) + tuple(out_b))
+                packed, cnt = K.compact(hit, tuple(flat))
+                rebuilt = _rebuild_from_flat(packed, slots)
+                np_ = len(p_arrays)
+                return (tuple(rebuilt[:np_]), tuple(rebuilt[np_:]), cnt,
+                        p_unres)
+            out_p2 = tuple((d, v) for d, v in p_arrays)
+            return out_p2, tuple(out_b), pcount, p_unres
+
+        pfn = jax.jit(pbody)
+        _jit_cache[pkey] = pfn
+
+    out_p, out_b, cnt, p_unres = pfn(pa, ba, bcodes, owner,
+                                     jnp.asarray(left.nrows))
+    nrows_, unres_ = jax.device_get((cnt, p_unres))
+    if bool(unres_):
+        return None
+    res = _assemble_join(left, right, left_on, right_on, lorder, rorder,
+                         out_p, out_b, int(nrows_), None, how, suffixes)
+    return rebucket(res)
+
+
 def _probe_build_arrays(left, right, left_on, right_on):
     lorder = left_on + [n for n in left.names if n not in left_on]
     rorder = right_on + [n for n in right.names if n not in right_on]
@@ -1386,9 +1502,10 @@ def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
 
 def _join_sharded(left, right, left_on, right_on, how, suffixes,
                   broadcast: bool = False,
-                  null_equal: bool = True) -> Table:
+                  null_equal: bool = True,
+                  pre_shuffled: bool = False) -> Table:
     m = mesh_mod.get_mesh()
-    if not broadcast:
+    if not broadcast and not pre_shuffled:
         # co-locate equal keys, then join at tight static shapes
         left = shuffle_by_key(left, left_on)
         right = shuffle_by_key(right, right_on)

@@ -69,3 +69,90 @@ def _attach_host_ranges(t: Table, at: pa.Table) -> None:
         elif not isinstance(lo, (int, np.integer)):
             continue
         col.vrange = (int(lo), int(hi), True)
+
+
+# ---------------------------------------------------------------------------
+# chunked / parallel byte-range reader
+# ---------------------------------------------------------------------------
+
+# default byte-range chunk for the streaming reader
+CHUNK_BYTES = 32 << 20
+
+
+def _newline_bounds(path: str, chunk_bytes: int):
+    """(header_bytes, offsets): byte-range chunk boundaries aligned to
+    row starts by scanning forward to the next newline from each nominal
+    split point — the reference's offset-search scheme
+    (bodo/io/_csv_json_reader.cpp). Like the reference's scanner this
+    assumes the row delimiter does not appear inside quoted fields."""
+    import os
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        header = f.readline()
+        start = f.tell()
+        bounds = [start]
+        pos = start + chunk_bytes
+        while pos < size:
+            f.seek(pos)
+            f.readline()
+            pos2 = f.tell()
+            if pos2 >= size:
+                break
+            bounds.append(pos2)
+            pos = pos2 + chunk_bytes
+        bounds.append(size)
+    return header, bounds
+
+
+def iter_csv_arrow(path: str, columns: Optional[Sequence[str]] = None,
+                   parse_dates: Optional[Sequence[str]] = None,
+                   chunk_bytes: int = CHUNK_BYTES):
+    """Yield one arrow Table per newline-aligned byte-range chunk.
+
+    The first chunk's inferred schema is pinned for every later chunk so
+    dtypes cannot drift mid-file (a chunk whose values no longer parse
+    under the pinned schema raises instead of silently widening)."""
+    import io as _io
+
+    header, bounds = _newline_bounds(path, chunk_bytes)
+    column_types = {c: pa.timestamp("ns") for c in (parse_dates or [])}
+    pinned = False
+    with open(path, "rb") as f:
+        for s, e in zip(bounds, bounds[1:]):
+            f.seek(s)
+            buf = f.read(e - s)
+            at = pacsv.read_csv(
+                _io.BytesIO(header + buf),
+                convert_options=pacsv.ConvertOptions(
+                    column_types=dict(column_types),
+                    include_columns=list(columns) if columns else None,
+                ))
+            if not pinned:
+                for fld in at.schema:
+                    column_types.setdefault(fld.name, fld.type)
+                pinned = True
+            yield at
+
+
+def read_csv_chunked(path: str, chunksize: int,
+                     columns: Optional[Sequence[str]] = None,
+                     parse_dates: Optional[Sequence[str]] = None,
+                     chunk_bytes: int = CHUNK_BYTES):
+    """pandas read_csv(chunksize=N) analogue: an iterator of pandas
+    DataFrames of exactly `chunksize` rows (last may be short), parsed
+    chunk-at-a-time with bounded host memory (reference:
+    bodo/io/csv_iterator_ext.py)."""
+    pending = []
+    pending_rows = 0
+    for at in iter_csv_arrow(path, columns, parse_dates, chunk_bytes):
+        pending.append(at)
+        pending_rows += at.num_rows
+        while pending_rows >= chunksize:
+            whole = pa.concat_tables(pending)
+            head = whole.slice(0, chunksize)
+            tail = whole.slice(chunksize)
+            pending = [tail] if tail.num_rows else []
+            pending_rows = tail.num_rows
+            yield head.to_pandas()
+    if pending_rows:
+        yield pa.concat_tables(pending).to_pandas()

@@ -52,7 +52,10 @@ def _recall(name: str, max_age_h: float = 24.0):
     """Load a recorded on-hardware result, or None when absent or STALE
     (older than `max_age_h`): a record from a previous round must not
     mask a regression — only a result captured this round, close to the
-    current code, is reusable."""
+    current code, is reusable. The recorded commit must be HEAD or an
+    ANCESTOR of HEAD (same work lineage, pre-final-commit capture); a
+    recording from a foreign/older lineage is ignored, and an ancestor
+    (≠ HEAD) recording is flagged `commit_mismatch` in the artifact."""
     try:
         with open(os.path.join(_RESULTS_DIR, name)) as f:
             rec = json.load(f)
@@ -63,6 +66,16 @@ def _recall(name: str, max_age_h: float = 24.0):
             print(f"recorded result {name} is stale "
                   f"({rec['recorded_at']}) — ignoring", file=sys.stderr)
             return None
+        commit = rec.get("commit")
+        if commit and commit != _git_head():
+            anc = subprocess.run(
+                ["git", "merge-base", "--is-ancestor", commit, "HEAD"],
+                cwd=_REPO, capture_output=True, timeout=10)
+            if anc.returncode != 0:
+                print(f"recorded result {name} is from a foreign "
+                      f"commit {commit} — ignoring", file=sys.stderr)
+                return None
+            rec["commit_mismatch"] = True
         return rec
     except Exception:
         return None
@@ -282,6 +295,8 @@ def bench_tpch(args):
                            f"({rec.get('recorded_at')}, commit "
                            f"{rec.get('commit')}); tunnel down at "
                            "driver time")})
+            if rec.get("commit_mismatch"):
+                detail["commit_mismatch"] = True
             value = rec["total_hot_s"]
             vs = (round(rec["sqlite_hot_s"] / value, 3)
                   if value else 0.0)
@@ -319,9 +334,6 @@ def main():
                     help="use the streaming batch executor (bounded device "
                          "memory; plan/streaming.py)")
     args = ap.parse_args()
-    os.environ.setdefault(
-        "BODO_TPU_COMPILE_CACHE_DIR",
-        os.path.join(_REPO, ".bench_data", "xla_cache"))
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -351,6 +363,25 @@ def main():
         if args.mesh > 1:
             os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
                 f" --xla_force_host_platform_device_count={args.mesh}"
+    # persistent XLA compile cache, keyed by backend platform + a host
+    # CPU-feature fingerprint: a cache populated on a DIFFERENT host (or
+    # for a different backend) must never be offered to this process —
+    # XLA warns "could lead to execution errors such as SIGILL" when a
+    # donated executable was compiled for other CPU features.
+    import hashlib
+    import platform as _plat
+    try:
+        with open("/proc/cpuinfo") as f:
+            feats = next((ln for ln in f if ln.startswith("flags")), "")
+    except OSError:
+        feats = ""
+    fp = hashlib.sha1(
+        (_plat.machine() + feats).encode()).hexdigest()[:10]
+    backend = "cpu" if use_cpu else accel["platform"]
+    os.environ.setdefault(
+        "BODO_TPU_COMPILE_CACHE_DIR",
+        os.path.join(_REPO, ".bench_data", f"xla_cache_{backend}_{fp}"))
+
     import jax
     if use_cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -485,6 +516,8 @@ def main():
                            f"({rec.get('recorded_at')}, commit "
                            f"{rec.get('commit')}); tunnel down at "
                            "driver time")})
+            if rec.get("commit_mismatch"):
+                detail["commit_mismatch"] = True
             value = rec["speedup"]
     print(json.dumps({
         "metric": "nyc_taxi_speedup_vs_pandas",

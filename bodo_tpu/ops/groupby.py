@@ -32,6 +32,7 @@ from jax import lax
 
 from bodo_tpu.ops import kernels as K
 from bodo_tpu.ops import sort_encoding as SE
+from bodo_tpu.utils.kernel_cache import bounded_jit
 
 # ---------------------------------------------------------------------------
 # agg spec plumbing
@@ -384,7 +385,7 @@ def _groupby_local_impl(arrays, count, specs: Tuple[str, ...],
     return tuple(out_keys), tuple(out_vals), n_groups
 
 
-@partial(jax.jit, static_argnames=("specs", "out_capacity", "num_keys"))
+@bounded_jit(static_argnames=("specs", "out_capacity", "num_keys"))
 def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
                   num_keys: int):
     """Local (single-shard) groupby.
@@ -398,7 +399,7 @@ def groupby_local(arrays, count, specs: Tuple[str, ...], out_capacity: int,
     return _groupby_local_impl(arrays, count, specs, out_capacity, num_keys)
 
 
-@partial(jax.jit, static_argnames=("specs", "out_capacity", "num_keys"))
+@bounded_jit(static_argnames=("specs", "out_capacity", "num_keys"))
 def groupby_merge(state_arrays, batch_arrays, n_state, n_batch,
                   specs: Tuple[str, ...], out_capacity: int, num_keys: int):
     """Merge two packed partial-aggregate blocks (streaming accumulate).
@@ -544,7 +545,7 @@ HASH_OPS = frozenset({
 })
 
 
-@jax.jit
+@bounded_jit
 def _hashed_claim(key_arrays, count):
     """Claim dense group ids for arbitrary keys (no row sort)."""
     from bodo_tpu.ops import hashtable as HT
@@ -559,7 +560,7 @@ def _hashed_claim(key_arrays, count):
     return seg, group_row, ok, n_groups, unresolved
 
 
-@partial(jax.jit, static_argnames=("specs", "num_keys", "ng_cap"))
+@bounded_jit(static_argnames=("specs", "num_keys", "ng_cap"))
 def _hashed_agg(arrays, seg, group_row, ok, specs: Tuple[str, ...],
                 num_keys: int, ng_cap: int):
     """Aggregate into the ng_cap-sized group space (hash order).
@@ -627,7 +628,7 @@ def _hashed_agg(arrays, seg, group_row, ok, specs: Tuple[str, ...],
     return gkeys, gvals, gvalid
 
 
-@partial(jax.jit, static_argnames=("out_capacity",))
+@bounded_jit(static_argnames=("out_capacity",))
 def _hashed_sort_groups(gkeys, gvals, gvalid, out_capacity: int):
     """Sort the group table by keys ascending and emit [out_capacity]
     outputs packed at the front (pandas sort=True)."""
@@ -649,6 +650,26 @@ def _hashed_sort_groups(gkeys, gvals, gvalid, out_capacity: int):
     out_vals = tuple((scatter(d), None if v is None else scatter(v))
                      for d, v in gvals)
     return out_keys, out_vals
+
+
+def groupby_local_hashed_static(arrays, count, specs: Tuple[str, ...],
+                                out_capacity: int, num_keys: int):
+    """Fully-traced hash groupby for use INSIDE shard_map/jit bodies
+    (distributed stage 1): same contract as `groupby_local` plus a
+    traced `unresolved` flag, with the group segment space fixed at
+    `out_capacity` instead of host-synced from the live group count
+    (no host round-trip is possible inside a trace). The caller must
+    guarantee out_capacity ≥ the true group count — with
+    out_capacity == row capacity that holds by construction.
+
+    Returns (out_keys, out_vals, n_groups, unresolved)."""
+    seg, group_row, ok, n_groups, unresolved = _hashed_claim(
+        arrays[:num_keys], count)
+    gkeys, gvals, gvalid = _hashed_agg(arrays, seg, group_row, ok, specs,
+                                       num_keys, out_capacity)
+    out_keys, out_vals = _hashed_sort_groups(gkeys, gvals, gvalid,
+                                             out_capacity)
+    return out_keys, out_vals, n_groups, unresolved
 
 
 def groupby_local_hashed(arrays, count, specs: Tuple[str, ...],

@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 
 from bodo_tpu.ops import sort_encoding as SE
+from bodo_tpu.utils.kernel_cache import bounded_jit
 
 # murmur3 fmix64 constants — the standard 64-bit avalanche finalizer
 _M1 = np.uint64(0xFF51AFD7ED558CCD)
@@ -112,7 +113,7 @@ def table_size(capacity: int) -> int:
     return t
 
 
-@partial(jax.jit, static_argnames=("T", "max_rounds"))
+@bounded_jit(static_argnames=("T", "max_rounds"))
 def claim_slots(codes: Tuple, ok, T: int, max_rounds: int = MAX_ROUNDS):
     """Assign every ok row a slot in [0, T): equal keys share a slot,
     distinct keys get distinct slots.
@@ -162,7 +163,7 @@ def claim_slots(codes: Tuple, ok, T: int, max_rounds: int = MAX_ROUNDS):
     return jnp.where(slot < 0, -1, slot), owner, r, unresolved
 
 
-@partial(jax.jit, static_argnames=("T",))
+@bounded_jit(static_argnames=("T",))
 def densify(slot, owner, T: int):
     """Map claim-table slots to dense group ids [0, n_groups).
 
@@ -201,7 +202,7 @@ def group_ids(key_arrays: Sequence[Tuple], ok_rows,
 # hash join LUT (unique build keys; dup-build falls back to sort-merge)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("T", "max_rounds"))
+@bounded_jit(static_argnames=("T", "max_rounds"))
 def probe_slots(build_codes: Tuple, owner, probe_codes: Tuple, ok,
                 T: int, max_rounds: int = MAX_ROUNDS):
     """For each probe row, the build row with an equal key, else -1.

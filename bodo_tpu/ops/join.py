@@ -34,6 +34,7 @@ from jax import lax
 
 from bodo_tpu.ops import kernels as K
 from bodo_tpu.ops import sort_encoding as SE
+from bodo_tpu.utils.kernel_cache import bounded_jit
 
 
 def _union_gids(probe_keys, build_keys, p_padmask, b_padmask,
@@ -100,15 +101,65 @@ def _union_gids(probe_keys, build_keys, p_padmask, b_padmask,
     return gid[:pcap], gid[pcap:]
 
 
+def _hash_gids(probe_keys, build_keys, p_pad, b_pad,
+               null_equal: bool = False):
+    """Hash-table alternative to `_union_gids`: build keys claim slots
+    in a scatter-claim table (ops/hashtable.py), gid = dense build-key
+    group id; probe rows look their gid up with lock-step probe rounds.
+
+    Duplicate build keys are the NORMAL case (they share a slot, and the
+    downstream per-gid [start, count) expansion emits every duplicate) —
+    the reference's hash-join behavior (bodo/libs/_hash_join.cpp),
+    realized as parallel scatter/gather rounds instead of serial chains.
+    Costs O(rounds) scatters over the BUILD side plus one bcap-row sort
+    downstream, vs the union sort's O((P+B) log (P+B)) — the win when
+    the probe side dwarfs the build side (FK joins).
+
+    Returns (gid_p, gid_b, unresolved); sentinel gid == pcap + bcap for
+    excluded/unmatched rows, matching the union convention. `unresolved`
+    True → the probe-round cap was hit; caller must use the sort path."""
+    from bodo_tpu.ops import hashtable as HT
+
+    pcap = probe_keys[0][0].shape[0]
+    bcap = build_keys[0][0].shape[0]
+    ucap = pcap + bcap
+    bkeys = tuple((bd.astype(pd_.dtype), bv)
+                  for (pd_, _pv), (bd, bv) in zip(probe_keys, build_keys))
+    # fixed null-column layout: both sides encode structurally identical
+    # code tuples even when only one side is nullable
+    null_cols = tuple(
+        SE.null_flag(pd_, pv) is not None
+        or SE.null_flag(bd, bv) is not None
+        for (pd_, pv), (bd, bv) in zip(probe_keys, bkeys))
+    bcodes, b_ok0 = HT.encode_columns_aligned(bkeys, null_cols, null_equal)
+    pcodes, p_ok0 = HT.encode_columns_aligned(probe_keys, null_cols,
+                                              null_equal)
+    b_ok = b_pad if b_ok0 is None else (b_pad & b_ok0)
+    p_ok = p_pad if p_ok0 is None else (p_pad & p_ok0)
+    T = HT.table_size(bcap)
+    slot_b, owner, _r, un1 = HT.claim_slots(bcodes, b_ok, T)
+    seg_b, _group_row, ng = HT.densify(slot_b, owner, T)
+    bidx, un2 = HT.probe_slots(bcodes, owner, pcodes, p_ok, T)
+    gid_b = jnp.where(b_ok, seg_b.astype(jnp.int64), ucap)
+    gid_p = jnp.where(bidx >= 0,
+                      seg_b[jnp.maximum(bidx, 0)].astype(jnp.int64), ucap)
+    return gid_p, gid_b, un1 | un2
+
+
 def _join_plan(probe_keys, build_keys, probe_count, build_count,
-               how: str, null_equal: bool = False):
+               how: str, null_equal: bool = False, method: str = "sort"):
     pcap = probe_keys[0][0].shape[0]
     bcap = build_keys[0][0].shape[0]
     ucap = pcap + bcap
     p_pad = K.row_mask(probe_count, pcap)
     b_pad = K.row_mask(build_count, bcap)
-    gid_p, gid_b = _union_gids(probe_keys, build_keys, p_pad, b_pad,
-                               null_equal)
+    if method == "hash":
+        gid_p, gid_b, unresolved = _hash_gids(probe_keys, build_keys,
+                                              p_pad, b_pad, null_equal)
+    else:
+        gid_p, gid_b = _union_gids(probe_keys, build_keys, p_pad, b_pad,
+                                   null_equal)
+        unresolved = jnp.zeros((), bool)
 
     # order build rows by gid (sentinel rows last)
     gid_b_s, b_perm = lax.sort((gid_b, jnp.arange(bcap)), num_keys=1,
@@ -143,38 +194,43 @@ def _join_plan(probe_keys, build_keys, probe_count, build_count,
                                       (jnp.arange(bcap, dtype=jnp.int64),))
         total = total + n_unm
     return (gid_p, b_perm, bc, starts, offsets, L, total, p_pad,
-            unm_idx, n_unm)
+            unm_idx, n_unm, unresolved)
 
 
-@partial(jax.jit, static_argnames=("num_keys", "how", "null_equal"))
+@bounded_jit(static_argnames=("num_keys", "how", "null_equal", "method"))
 def join_count(probe_keys, build_keys, probe_count, build_count,
-               num_keys: int, how: str, null_equal: bool = False):
+               num_keys: int, how: str, null_equal: bool = False,
+               method: str = "sort"):
     """Exact output row count of the join (cheap pre-pass; the host uses
-    it to pick the materialization capacity bucket)."""
+    it to pick the materialization capacity bucket). Returns
+    (total, unresolved) — unresolved only ever True for method='hash'."""
     plan = _join_plan(probe_keys, build_keys, probe_count,
-                      build_count, how, null_equal)
-    return plan[6]
+                      build_count, how, null_equal, method)
+    return plan[6], plan[10]
 
 
-@partial(jax.jit, static_argnames=("num_keys", "how", "out_capacity",
-                                   "null_equal"))
+@bounded_jit(static_argnames=("num_keys", "how", "out_capacity",
+                              "null_equal", "method"))
 def join_local(probe_arrays, build_arrays, probe_count, build_count,
                num_keys: int, how: str, out_capacity: int,
-               null_equal: bool = False):
+               null_equal: bool = False, method: str = "sort"):
     """Materialize the equi-join.
 
     probe_arrays/build_arrays: tuples of (data, valid); the first
     `num_keys` of each are the join keys (positionally aligned).
-    Returns (out_probe, out_build, out_count, overflow):
+    Returns (out_probe, out_build, out_count, overflow, unresolved):
       out_probe — all probe columns gathered per output row,
       out_build — all build columns (valid=False on unmatched left rows),
-      overflow — True if out_capacity was too small (host retries bigger).
+      overflow — True if out_capacity was too small (host retries bigger),
+      unresolved — method='hash' hit its probe-round cap (pathological
+      input; host must re-run with method='sort').
     """
     probe_keys = probe_arrays[:num_keys]
     build_keys = build_arrays[:num_keys]
     (gid_p, b_perm, bc, starts, offsets, L, total, p_pad,
-     unm_idx, n_unm) = _join_plan(
-        probe_keys, build_keys, probe_count, build_count, how, null_equal)
+     unm_idx, n_unm, unresolved) = _join_plan(
+        probe_keys, build_keys, probe_count, build_count, how, null_equal,
+        method)
     ucap = gid_p.shape[0] + b_perm.shape[0]
     bcap = b_perm.shape[0]
     total_probe = total - n_unm  # probe-driven rows (== total unless outer)
@@ -216,10 +272,11 @@ def join_local(probe_arrays, build_arrays, probe_count, build_count,
         out_build.append((od, ov))
     out_count = jnp.minimum(total, out_capacity)
     overflow = total > out_capacity
-    return tuple(out_probe), tuple(out_build), out_count, overflow
+    return (tuple(out_probe), tuple(out_build), out_count, overflow,
+            unresolved)
 
 
-@partial(jax.jit, static_argnames=("out_capacity",))
+@bounded_jit(static_argnames=("out_capacity",))
 def cross_local(probe_arrays, build_arrays, probe_count, build_count,
                 out_capacity: int):
     """Cartesian product in pandas row order (probe-major: each probe row

@@ -25,6 +25,7 @@ from bodo_tpu.ops import kernels as K
 from bodo_tpu.ops import sort_encoding as SE
 from bodo_tpu.parallel import collectives as C
 from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.utils.kernel_cache import bounded_jit
 
 # oversampling factor for splitter selection (samples per shard = OS * S)
 _OVERSAMPLE = 8
@@ -39,7 +40,7 @@ def _sort_operands(keys: Sequence[Tuple], ascending: Sequence[bool],
     return ops
 
 
-@partial(jax.jit, static_argnames=("num_keys", "ascending", "na_last"))
+@bounded_jit(static_argnames=("num_keys", "ascending", "na_last"))
 def sort_local(arrays, count, num_keys: int, ascending: Tuple[bool, ...],
                na_last: bool = True):
     """Stable multi-key sort of all columns; first `num_keys` arrays are

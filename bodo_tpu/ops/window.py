@@ -23,6 +23,7 @@ import numpy as np
 from jax import lax
 
 from bodo_tpu.ops import kernels as K
+from bodo_tpu.utils.kernel_cache import bounded_jit
 
 
 def _ok(x, valid, padmask):
@@ -254,7 +255,7 @@ def shift_local(x, valid, count, halo_x, halo_ok, n: int):
 # CUMCOUNT over (PARTITION BY keys ORDER BY order_cols)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("specs", "num_keys", "ascending",
+@bounded_jit(static_argnames=("specs", "num_keys", "ascending",
                                    "na_last"))
 def rank_window_local(key_arrays, order_arrays, count,
                       specs: Tuple[Tuple[str, int], ...], num_keys: int,
@@ -428,7 +429,7 @@ def _range_minmax(levels, a, b, empty, want_max: bool, sentinel):
     return jnp.where(empty, sentinel, out)
 
 
-@partial(jax.jit, static_argnames=("specs", "num_keys", "ascending",
+@bounded_jit(static_argnames=("specs", "num_keys", "ascending",
                                    "na_last"))
 def agg_window_local(key_arrays, order_arrays, val_arrays, count,
                      specs: Tuple, num_keys: int,

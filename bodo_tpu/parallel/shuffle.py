@@ -24,8 +24,10 @@ from jax.sharding import PartitionSpec as P
 
 from bodo_tpu.config import config
 from bodo_tpu.ops import kernels as K
-from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, _var_from_m2,
-                                  groupby_local, result_dtype)
+from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, HASH_OPS,
+                                  _var_from_m2, groupby_local,
+                                  groupby_local_hashed_static,
+                                  result_dtype)
 from bodo_tpu.ops.hashing import dest_shard, hash_columns
 from bodo_tpu.parallel import collectives as C
 from bodo_tpu.parallel import mesh as mesh_mod
@@ -148,9 +150,13 @@ def _finalize(op: str, cols, orig_dtype):
 
 
 @lru_cache(maxsize=256)
-def _build_groupby_partial(mesh_key, num_keys: int, specs: Tuple[str, ...]):
+def _build_groupby_partial(mesh_key, num_keys: int, specs: Tuple[str, ...],
+                           method: str = "sort"):
     """Stage 1: per-shard partial aggregation (shrinks data before the
-    wire — the reference's local-combine motivation)."""
+    wire — the reference's local-combine motivation). method='hash'
+    replaces the per-shard row sort with the scatter-claim hash kernel
+    (ops/hashtable.py); its traced `unresolved` flag is OR-visible to
+    the host, which falls back to 'sort' on pathological keys."""
     mesh = _MESHES[mesh_key]
     axis = config.data_axis
     partial_specs, _, _ = _plan_decomposition(specs)
@@ -163,12 +169,17 @@ def _build_groupby_partial(mesh_key, num_keys: int, specs: Tuple[str, ...]):
         p_inputs = tuple(keys) + tuple(
             values[i] for i, op in enumerate(specs)
             for _ in DECOMPOSE[op])
-        pk, pv, ng = groupby_local(p_inputs, count, partial_specs, cap,
-                                   num_keys)
-        return (pk, pv), ng[None]
+        if method == "hash":
+            pk, pv, ng, unres = groupby_local_hashed_static(
+                p_inputs, count, partial_specs, cap, num_keys)
+        else:
+            pk, pv, ng = groupby_local(p_inputs, count, partial_specs,
+                                       cap, num_keys)
+            unres = jnp.zeros((), bool)
+        return (pk, pv), ng[None], unres[None]
 
     shd = C.smap(body, in_specs=(P(axis), P(axis)),
-                 out_specs=(P(axis), P(axis)), mesh=mesh)
+                 out_specs=(P(axis), P(axis), P(axis)), mesh=mesh)
     return jax.jit(shd)
 
 
@@ -266,8 +277,22 @@ def groupby_sharded(arrays, counts, num_keys: int, specs: Tuple[str, ...],
     value_dtypes = tuple(str(arrays[num_keys + i][0].dtype)
                          for i in range(len(specs)))
 
-    partials, ngs = _build_groupby_partial(mk, num_keys, specs)(
-        tuple(arrays), counts)
+    method = "sort"
+    if config.hash_groupby:
+        try:
+            partial_specs, _, _ = _plan_decomposition(specs)
+            if all(p in HASH_OPS for p in partial_specs):
+                method = "hash"
+        except NotImplementedError:
+            pass
+    while True:
+        partials, ngs, unres = _build_groupby_partial(
+            mk, num_keys, specs, method)(tuple(arrays), counts)
+        if method == "hash" and \
+                np.asarray(jax.device_get(unres)).any():
+            method = "sort"  # pathological keys on some shard
+            continue
+        break
     png = np.asarray(jax.device_get(ngs)).reshape(-1)
     max_png = int(png.max()) if len(png) else 0
     safe_cap = round_capacity(max(max_png, 1))

@@ -368,6 +368,36 @@ def contains_expr(e, cls, stop=()) -> bool:
     return False
 
 
+def codelut_misplaced(e, consumer_ok: bool = True) -> bool:
+    """True when a CodeLUT sits in a position where evaluation would
+    yield raw LUT codes with no dictionary attached.
+
+    Legal positions: the top level of a projection, or the operand
+    spine (DictMap* → CodeLUT) of a string-CONSUMING node
+    (StrPredicate/StrLen/StrHostFn/StrCodes evaluate the LUT at
+    dictionary level). Unlike a `stop`-pruned contains_expr walk, the
+    scan continues INSIDE consumer operands, so e.g.
+    StrPredicate(Where(c, CodeLUT, x)) is still reported."""
+    import dataclasses
+    if isinstance(e, CodeLUT):
+        # a legally-consumed CodeLUT's integer operand must itself be
+        # CodeLUT-free
+        return (not consumer_ok) or codelut_misplaced(e.operand, False)
+    if isinstance(e, (StrPredicate, StrLen, StrHostFn, StrCodes)):
+        op = e.operand
+        while isinstance(op, DictMap):
+            op = op.operand
+        return codelut_misplaced(op, True)
+    if not dataclasses.is_dataclass(e):
+        return False
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        for x in (v if isinstance(v, tuple) else (v,)):
+            if isinstance(x, Expr) and codelut_misplaced(x, False):
+                return True
+    return False
+
+
 @_frozen
 class CodeLUT(Expr):
     """String column from a small static vocabulary indexed by an integer

@@ -671,6 +671,15 @@ def append_sharded(state: Optional[Table], batch: Table,
     bflat = []
     for n in names:
         sc, bc = state.column(n), batch.column(n)
+        # schema drift guard: a batch dtype WIDER than the state's would
+        # wrap silently under astype (int64→int32), contradicting the
+        # "column schemas must match" contract — fail loudly instead
+        if bc.data.dtype != sc.data.dtype and not np.can_cast(
+                bc.data.dtype, sc.data.dtype, casting="safe"):
+            raise ValueError(
+                f"append_sharded: batch column {n!r} dtype "
+                f"{bc.data.dtype} does not safely cast to state dtype "
+                f"{sc.data.dtype}")
         sflat.append(sc.data)
         bflat.append(bc.data.astype(sc.data.dtype))
         has_v = sc.valid is not None or bc.valid is not None
@@ -753,9 +762,36 @@ class ShardedPartitionedJoin:
         self.state = append_sharded(self.state, sb, self.mesh)
         return True
 
+    def _probe_keys_compatible(self, pb: Table) -> None:
+        """Fail loudly when probe key columns cannot be compared against
+        the build state raw (shuffle + local join compare dict CODES):
+        drifting string dictionaries or dtype mismatch would otherwise
+        return silently wrong matches for a direct user of this class
+        (build_stream_sharded gates this, __graft_entry__-style callers
+        don't)."""
+        if self.state is None:
+            return
+        for lk, rk in zip(self.left_on, self.right_on):
+            pc, bc = pb.column(lk), self.state.column(rk)
+            if pc.dtype is not bc.dtype:
+                raise ValueError(
+                    f"probe key {lk!r} dtype {pc.dtype} != build key "
+                    f"{rk!r} dtype {bc.dtype}")
+            pd_, bd = pc.dictionary, bc.dictionary
+            if pd_ is None and bd is None:
+                continue
+            if pd_ is None or bd is None or (
+                    pd_ is not bd and not (len(pd_) == len(bd)
+                                           and bool(np.all(pd_ == bd)))):
+                raise ValueError(
+                    f"probe key {lk!r} string dictionary differs from "
+                    "build state's — codes are not comparable (re-encode "
+                    "or use the whole-table join)")
+
     def probe(self, b: Table) -> Table:
         if b.distribution != ONED:
             b = b.shard()
+        self._probe_keys_compatible(b)
         pb = R.shuffle_by_key(b, self.left_on)
         out = R._join_sharded(pb, self.state, self.left_on, self.right_on,
                               self.how, self.suffixes,

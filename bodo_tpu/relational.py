@@ -124,15 +124,13 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     # a CodeLUT nested under Where/BinOp (e.g. IFF(c, MONTHNAME(d),
     # DAYNAME(d))) would evaluate to raw LUT codes with no dictionary
     # attached — reject loudly rather than decode garbage downstream.
-    # CodeLUT under a string-CONSUMING node (StrPredicate/StrLen/
-    # StrHostFn produce bool/int, evaluating the LUT at dictionary
-    # level) is legal and exempted via `stop`.
-    from bodo_tpu.plan.expr import (StrCodes, StrHostFn, StrLen,
-                                    StrPredicate,
-                                    contains_expr as _contains)
+    # CodeLUT as the (DictMap*) operand of a string-CONSUMING node
+    # (StrPredicate/StrLen/StrHostFn/StrCodes evaluate the LUT at the
+    # dictionary level) is legal; the walk still scans INSIDE consumer
+    # operands for deeper illegal nesting.
+    from bodo_tpu.plan.expr import codelut_misplaced as _codelut_bad
     for n, e in new.items():
-        if not isinstance(e, CodeLUT) and _contains(
-                e, CodeLUT, stop=(StrPredicate, StrLen, StrHostFn, StrCodes)):
+        if _codelut_bad(e):
             raise NotImplementedError(
                 "CodeLUT (MONTHNAME/DAYNAME) nested under "
                 f"{type(e).__name__} is not supported as a projection")
@@ -1432,14 +1430,18 @@ def _join_rep(left, right, left_on, right_on, how, suffixes,
     bc = jnp.asarray(right.nrows)
     nk = len(left_on)
     out_cap = round_capacity(max(left.nrows, right.nrows, 1))
-    for _ in range(2):
-        out_p, out_b, cnt, ovf = join_local(pa, ba, pc, bc, nk, how,
-                                            out_cap, null_equal)
+    method = "hash" if config.hash_join else "sort"
+    for _ in range(4):
+        out_p, out_b, cnt, ovf, unres = join_local(
+            pa, ba, pc, bc, nk, how, out_cap, null_equal, method)
+        if method == "hash" and bool(jax.device_get(unres)):
+            method = "sort"  # pathological probe chains: sort safety net
+            continue
         if not bool(jax.device_get(ovf)):
             break
-        total = int(join_count(pa[:nk], ba[:nk], pc, bc, nk, how,
-                               null_equal))
-        out_cap = round_capacity(total)
+        total, _ = join_count(pa[:nk], ba[:nk], pc, bc, nk, how,
+                              null_equal, method)
+        out_cap = round_capacity(int(jax.device_get(total)))
     nrows = int(jax.device_get(cnt))
     return _assemble_join(left, right, left_on, right_on, lorder, rorder,
                           out_p, out_b, nrows, None, how, suffixes)
@@ -1470,15 +1472,17 @@ def _rebuild_from_flat(flat, slots):
 
 
 def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
-                           sig_key, null_equal: bool = True):
+                           sig_key, null_equal: bool = True,
+                           method: str = "sort"):
     """shard_map join of co-located shards — probe rows and build rows
     with equal keys are already on the same shard (hash shuffle happened
     as a separate sized stage via shuffle_by_key), or the build side is
     replicated (broadcast join, reference bodo/libs/_shuffle.h:153).
     Analogue of the reference's partitioned hash join
-    (streaming/_join.h:892)."""
+    (streaming/_join.h:892); with method='hash' the per-shard kernel is
+    the scatter-claim hash join rather than the sort join."""
     key = ("join", mesh_key, nk, how, out_cap, broadcast, sig_key,
-           null_equal)
+           null_equal, method)
     fn = _jit_cache.get(key)
     if fn is not None:
         return fn
@@ -1486,15 +1490,15 @@ def _build_join_sharded_fn(mesh_key, nk, how, out_cap, broadcast: bool,
     ax = config.data_axis
 
     def body(p_arrays, b_arrays, pcounts, bcounts):
-        out_p, out_b, cnt, ovf = join_local(
+        out_p, out_b, cnt, ovf, unres = join_local(
             p_arrays, b_arrays, pcounts[0], bcounts[0], nk, how, out_cap,
-            null_equal)
-        return out_p, out_b, cnt[None], ovf[None]
+            null_equal, method)
+        return out_p, out_b, cnt[None], ovf[None], unres[None]
 
     fn = jax.jit(C.smap(body,
                         in_specs=(P(ax), P() if broadcast else P(ax),
                                   P(ax), P() if broadcast else P(ax)),
-                        out_specs=(P(ax), P(ax), P(ax), P(ax)),
+                        out_specs=(P(ax), P(ax), P(ax), P(ax), P(ax)),
                         mesh=mesh))
     _jit_cache[key] = fn
     return fn
@@ -1522,21 +1526,30 @@ def _join_sharded(left, right, left_on, right_on, how, suffixes,
     else:
         bcounts = right.counts_device()
     sig_key = (_sig(left), _sig(right))
-    for attempt in range(2):
+    method = "hash" if config.hash_join else "sort"
+    for attempt in range(4):
         fn = _build_join_sharded_fn(_mesh_key(m), nk, how, out_cap,
-                                    broadcast, sig_key, null_equal)
-        out_p, out_b, cnts, ovf = fn(pa, ba, left.counts_device(), bcounts)
+                                    broadcast, sig_key, null_equal,
+                                    method)
+        out_p, out_b, cnts, ovf, unres = fn(pa, ba, left.counts_device(),
+                                            bcounts)
+        if (method == "hash"
+                and np.asarray(jax.device_get(unres)).any()):
+            method = "sort"  # pathological probe chains on some shard
+            continue
         if not np.asarray(jax.device_get(ovf)).any():
             break
         # exact per-shard counts, then one final right-sized run
-        cfn_key = ("join_count", _mesh_key(m), nk, how, sig_key, null_equal)
+        cfn_key = ("join_count", _mesh_key(m), nk, how, sig_key,
+                   null_equal, method)
         cfn = _jit_cache.get(cfn_key)
         if cfn is None:
             ax = config.data_axis
 
             def cbody(p_arrays, b_arrays, pcounts, bcounts_):
                 return join_count(p_arrays[:nk], b_arrays[:nk], pcounts[0],
-                                  bcounts_[0], nk, how, null_equal)[None]
+                                  bcounts_[0], nk, how, null_equal,
+                                  method)[0][None]
             cfn = jax.jit(C.smap(
                 cbody,
                 in_specs=(P(ax), P() if broadcast else P(ax), P(ax),

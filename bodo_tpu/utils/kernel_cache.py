@@ -11,6 +11,7 @@ executables are garbage-collected.
 
 from __future__ import annotations
 
+import functools
 from collections import OrderedDict
 
 
@@ -49,3 +50,54 @@ class KernelCache:
 
     def clear(self):
         self._d.clear()
+
+
+def _leaf_key(x):
+    shape = getattr(x, "shape", None)
+    if shape is not None and hasattr(x, "dtype"):
+        return ("a", tuple(shape), str(x.dtype))
+    return ("v", x)
+
+
+def bounded_jit(fun=None, *, static_argnames=(), maxsize=None):
+    """`jax.jit` whose live compiled executables are BOUNDED.
+
+    A module-level `jax.jit` pins one executable per distinct
+    (input avals, static args) combination forever in jax's unbounded
+    per-function cache; a long session (or the 490-test suite in one
+    process) accumulates thousands and XLA:CPU's compiler eventually
+    segfaults. This wrapper creates one `jax.jit` object per
+    combination, held in a `KernelCache` LRU keyed by the call's leaf
+    avals + non-array leaf values, so evicting an entry lets jax
+    garbage-collect its executables. Works inside an outer trace too
+    (leaves are tracers with shape/dtype; the inner jit inlines).
+    """
+    if fun is None:
+        return functools.partial(bounded_jit,
+                                 static_argnames=static_argnames,
+                                 maxsize=maxsize)
+    if maxsize is None:
+        from bodo_tpu.config import config
+        maxsize = config.kernel_cache_size
+    cache = KernelCache(maxsize=maxsize)
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        import jax
+
+        struct, leaves = None, None
+        try:
+            leaves, struct = jax.tree_util.tree_flatten((args, kwargs))
+            key = (struct, tuple(_leaf_key(x) for x in leaves))
+            hash(key)
+        except TypeError:  # unhashable leaf — compile uncached
+            return jax.jit(fun, static_argnames=static_argnames)(
+                *args, **kwargs)
+        fn = cache.get(key)
+        if fn is None:
+            fn = jax.jit(fun, static_argnames=static_argnames)
+            cache[key] = fn
+        return fn(*args, **kwargs)
+
+    wrapper.cache = cache
+    return wrapper

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Run the test suite one-subprocess-per-module.
+
+XLA:CPU's JIT compiler segfaults after pinning thousands of distinct
+compiled kernels in one process; the engine bounds its own caches
+(utils/kernel_cache.py), but a single-process run of the FULL suite
+still accumulates every module's distinct shapes at once. The reference
+engine contains the same class of leak per test module by running each
+module in its own subprocess (reference: bodo/runtests.py:58-100 —
+"Run each test file in a separate process to avoid out-of-memory issues
+in CI"); this is the same harness, pytest-native.
+
+Usage:
+    python runtests.py              # whole suite, one proc per module
+    python runtests.py -k pattern   # forwarded to pytest
+    python runtests.py tests/test_sql.py tests/test_groupby.py
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def main(argv: list[str]) -> int:
+    # a non-flag arg is a test module only if it points at a file; other
+    # bare words (e.g. the pattern value after -k) pass through to pytest
+    modules = [a for a in argv
+               if not a.startswith("-") and os.path.exists(a)]
+    passthrough = [a for a in argv if a not in modules]
+    if not modules:
+        modules = sorted(glob.glob(os.path.join(_REPO, "tests",
+                                                "test_*.py")))
+    t0 = time.time()
+    failed: list[str] = []
+    total = 0
+    for i, mod in enumerate(modules):
+        name = os.path.relpath(mod, _REPO)
+        print(f"[{i + 1}/{len(modules)}] {name} ... ",
+              end="", flush=True)
+        t1 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", mod, "-q", "--no-header",
+             *passthrough],
+            cwd=_REPO, capture_output=True, text=True)
+        dt = time.time() - t1
+        tail = (r.stdout.strip().splitlines() or [""])[-1]
+        print(f"{tail}  ({dt:.0f}s)")
+        # "5 passed" / "2 passed, 1 skipped" style summary; count tests
+        for part in tail.split(","):
+            part = part.strip()
+            if part and part.split()[0].isdigit():
+                total += int(part.split()[0])
+        if r.returncode == 5:  # no tests collected (e.g. -k filter)
+            continue
+        if r.returncode != 0:
+            failed.append(name)
+            sys.stdout.write(r.stdout[-4000:] + r.stderr[-2000:] + "\n")
+    dt = time.time() - t0
+    if failed:
+        print(f"\nFAILED modules ({len(failed)}/{len(modules)}): "
+              f"{' '.join(failed)}  [{dt:.0f}s]")
+        return 1
+    print(f"\nall {len(modules)} modules green, {total} tests "
+          f"[{dt:.0f}s]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

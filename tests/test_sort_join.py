@@ -53,8 +53,9 @@ def test_sort_sharded_global_order(mesh8):
     assert sorted(a_vals.tolist()) == sorted(exp_a.tolist())
 
 
+@pytest.mark.parametrize("method", ["sort", "hash"])
 @pytest.mark.parametrize("how", ["inner", "left"])
-def test_join_local_vs_pandas(mesh8, how):
+def test_join_local_vs_pandas(mesh8, how, method):
     from bodo_tpu import Table
     from bodo_tpu.ops.join import join_count, join_local
 
@@ -68,12 +69,15 @@ def test_join_local_vs_pandas(mesh8, how):
     pa = _table_arrays(tl, ["k", "x"])
     ba = _table_arrays(tr, ["k", "y"])
     pc, bc = jnp.asarray(tl.nrows), jnp.asarray(tr.nrows)
-    total = int(join_count(pa[:1], ba[:1], pc, bc, 1, how))
+    total, unres_c = join_count(pa[:1], ba[:1], pc, bc, 1, how,
+                                False, method)
+    total = int(total)
     exp = left.merge(right, on="k", how=how)
-    assert total == len(exp)
+    assert total == len(exp) and not bool(unres_c)
     cap = max(128, ((total + 127) // 128) * 128)
-    out_p, out_b, cnt, ovf = join_local(pa, ba, pc, bc, 1, how, cap)
-    assert not bool(ovf) and int(cnt) == total
+    out_p, out_b, cnt, ovf, unres = join_local(pa, ba, pc, bc, 1, how,
+                                               cap, False, method)
+    assert not bool(ovf) and int(cnt) == total and not bool(unres)
     got = pd.DataFrame({
         "k": np.asarray(out_p[0][0])[:total],
         "x": np.asarray(out_p[1][0])[:total],
@@ -90,7 +94,8 @@ def test_join_local_vs_pandas(mesh8, how):
                                rtol=1e-12)
 
 
-def test_join_multikey_with_nulls(mesh8):
+@pytest.mark.parametrize("method", ["sort", "hash"])
+def test_join_multikey_with_nulls(mesh8, method):
     from bodo_tpu import Table
     from bodo_tpu.ops.join import join_count, join_local
 
@@ -111,9 +116,13 @@ def test_join_multikey_with_nulls(mesh8):
     pc, bc = jnp.asarray(tl.nrows), jnp.asarray(tr.nrows)
     for how in ("inner", "left"):
         exp = left.merge(right, on=["k1", "k2"], how=how)
-        total = int(join_count(pa[:2], ba[:2], pc, bc, 2, how))
+        total, _ = join_count(pa[:2], ba[:2], pc, bc, 2, how,
+                              False, method)
+        total = int(total)
         assert total == len(exp), how
-        out_p, out_b, cnt, ovf = join_local(pa, ba, pc, bc, 2, how, 128)
+        out_p, out_b, cnt, ovf, unres = join_local(pa, ba, pc, bc, 2, how,
+                                                   128, False, method)
+        assert not bool(unres)
         got_x = sorted(np.asarray(out_p[2][0])[:total].tolist())
         assert got_x == sorted(exp["x"].tolist()), how
 
@@ -128,7 +137,9 @@ def test_join_overflow_flag(mesh8):
     tl, tr = Table.from_pandas(left), Table.from_pandas(right)
     pa = _table_arrays(tl, ["k", "x"])
     ba = _table_arrays(tr, ["k", "y"])
-    out_p, out_b, cnt, ovf = join_local(
-        pa, ba, jnp.asarray(200), jnp.asarray(50), 1, "inner", 128)
-    assert bool(ovf)  # 10000 rows don't fit in 128
-    assert int(cnt) == 128
+    for method in ("sort", "hash"):
+        out_p, out_b, cnt, ovf, _unres = join_local(
+            pa, ba, jnp.asarray(200), jnp.asarray(50), 1, "inner", 128,
+            False, method)
+        assert bool(ovf), method  # 10000 rows don't fit in 128
+        assert int(cnt) == 128, method

@@ -132,6 +132,15 @@ class Config:
     pack_keys: bool = field(
         default_factory=lambda: _env_bool("BODO_TPU_PACK_KEYS", True)
     )
+    # Streaming device-state budget in MiB (0 = unbounded). When a
+    # streaming sort/join's accumulated device state exceeds this, the
+    # state is sorted/parked to the spillable host pool via the
+    # comptroller (larger-than-HBM streaming; reference analogue:
+    # OperatorBufferPool spill thresholds, bodo/libs/_operator_pool.h).
+    stream_device_budget_mb: int = field(
+        default_factory=lambda: _env_int(
+            "BODO_TPU_STREAM_DEVICE_BUDGET_MB", 0)
+    )
     # Persistent XLA compilation cache directory (the @jit(cache=True)
     # analogue — reference: Numba on-disk JIT cache, caching_tests/).
     # Set to a path to survive process restarts; empty disables. Applied

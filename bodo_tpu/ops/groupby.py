@@ -22,7 +22,6 @@ cancelling E[x²] − E[x]² form.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax

@@ -18,7 +18,6 @@ segment-reductions run over a capacity-sized space, not the table.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -103,6 +102,27 @@ def encode_columns_aligned(key_arrays: Sequence[Tuple],
                 ok = nn if ok is None else (ok & nn)
         codes.append(enc)
     return tuple(codes), ok
+
+
+def aligned_codes(probe_keys: Sequence[Tuple], build_keys: Sequence[Tuple],
+                  null_equal: bool):
+    """Encode two positionally-aligned key sets into STRUCTURALLY
+    IDENTICAL code tuples: build keys cast to the probe dtypes, and both
+    sides share one null-column layout (the OR of their nullability).
+    The one spelling of the hash-join/membership encode used by
+    ops/join.py `_hash_gids` and the streaming drain's key-membership
+    probe. Returns (pcodes, bcodes, p_ok, b_ok) with ok = None when no
+    rows are excluded."""
+    bkeys = tuple((bd.astype(pd_.dtype), bv)
+                  for (pd_, _pv), (bd, bv) in zip(probe_keys, build_keys))
+    null_cols = tuple(
+        SE.null_flag(pd_, pv) is not None
+        or SE.null_flag(bd, bv) is not None
+        for (pd_, pv), (bd, bv) in zip(probe_keys, bkeys))
+    bcodes, b_ok = encode_columns_aligned(bkeys, null_cols, null_equal)
+    pcodes, p_ok = encode_columns_aligned(probe_keys, null_cols,
+                                          null_equal)
+    return pcodes, bcodes, p_ok, b_ok
 
 
 def table_size(capacity: int) -> int:

@@ -24,7 +24,6 @@ then `join_local` materializes.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -123,17 +122,8 @@ def _hash_gids(probe_keys, build_keys, p_pad, b_pad,
     pcap = probe_keys[0][0].shape[0]
     bcap = build_keys[0][0].shape[0]
     ucap = pcap + bcap
-    bkeys = tuple((bd.astype(pd_.dtype), bv)
-                  for (pd_, _pv), (bd, bv) in zip(probe_keys, build_keys))
-    # fixed null-column layout: both sides encode structurally identical
-    # code tuples even when only one side is nullable
-    null_cols = tuple(
-        SE.null_flag(pd_, pv) is not None
-        or SE.null_flag(bd, bv) is not None
-        for (pd_, pv), (bd, bv) in zip(probe_keys, bkeys))
-    bcodes, b_ok0 = HT.encode_columns_aligned(bkeys, null_cols, null_equal)
-    pcodes, p_ok0 = HT.encode_columns_aligned(probe_keys, null_cols,
-                                              null_equal)
+    pcodes, bcodes, p_ok0, b_ok0 = HT.aligned_codes(probe_keys,
+                                                    build_keys, null_equal)
     b_ok = b_pad if b_ok0 is None else (b_pad & b_ok0)
     p_ok = p_pad if p_ok0 is None else (p_pad & p_ok0)
     T = HT.table_size(bcap)

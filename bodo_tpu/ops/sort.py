@@ -11,7 +11,7 @@ destination assignment + fixed-capacity all_to_all (parallel/shuffle.py).
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
 import jax

@@ -14,7 +14,6 @@ cross-shard carry; the shard_map wrapper lives in relational.py.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import jax

@@ -517,18 +517,80 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
         inner = build_stream_sharded(node.left, m)
         if inner is None:
             return None
+
+        def _keys_streamable() -> bool:
+            # Key dtypes must agree exactly (the stream skips
+            # join_tables' promotion step) and string keys need a shared
+            # dictionary — whole-table path otherwise.
+            return not any(
+                node.left.schema[lk] is not node.right.schema[rk]
+                or node.left.schema[lk] is dt.STRING
+                for lk, rk in zip(node.left_on, node.right_on))
+
+        def _pjoin_gen(pj, src):
+            # close() in finally: an abandoned consumer (generator GC'd
+            # before exhaustion) must not leak parked host-pool chunks
+            try:
+                for b in src:
+                    out = pj.probe(b)
+                    if out is not None:
+                        yield out
+                yield from pj.drain()
+            finally:
+                pj.close()
+
+        # stream the build side too when its subtree has a streaming
+        # form: batches buffer (device) only up to the broadcast
+        # threshold, then switch to the partitioned join — the build is
+        # never fully materialized (reference: build streamed from the
+        # scan into partitions, bodo/libs/streaming/_join.h:267).
+        build_src = build_stream_sharded(node.right, m)
+        if build_src is not None and _keys_streamable():
+            try:
+                pj = ShardedPartitionedJoin(
+                    node.left_on, node.right_on, node.how, node.suffixes,
+                    node.null_equal, m)
+            except NotImplementedError:
+                return None
+            buffered: Optional[Table] = None
+            nbb = 0
+            for bb in build_src:
+                nbb += 1
+                if pj.state is not None or pj.spilling:
+                    if not pj.push_build(bb):
+                        return None
+                    continue
+                if not _dicts_compatible(buffered, bb):
+                    return None
+                buffered = append_sharded(buffered, bb, m)
+                if buffered.nrows > config.bcast_join_threshold:
+                    if not pj.push_build(buffered):
+                        return None
+                    buffered = None
+            if pj.state is not None or pj.spilling:
+                log(1, f"streaming partitioned join: build streamed over "
+                       f"{nbb} batches"
+                       + (f", {len(pj.build_chunks)} spilled chunks"
+                          if pj.spilling else ""))
+                return _pjoin_gen(pj, inner)
+            if buffered is None:
+                return None  # empty build stream
+            log(1, f"streaming join: build streamed over {nbb} batches "
+                   f"({buffered.nrows} rows, broadcast)")
+            join = ShardedStreamJoin(buffered, node.left_on,
+                                     node.right_on, node.how,
+                                     node.suffixes, node.null_equal)
+
+            def gen_join_s(src):
+                for b in src:
+                    yield join(b)
+            return gen_join_s(inner)
+
         from bodo_tpu.plan import physical
         build = physical._exec(node.right)
         if build.nrows > config.bcast_join_threshold:
-            # partitioned streaming join: hash-shuffle the build side
-            # into per-shard state; probe batches follow the same hash.
-            # Key dtypes must agree exactly (the stream skips
-            # join_tables' promotion step) and string keys need a shared
-            # dictionary — bail to the whole-table path otherwise.
-            for lk, rk in zip(node.left_on, node.right_on):
-                if node.left.schema[lk] is not node.right.schema[rk] or \
-                        node.left.schema[lk] is dt.STRING:
-                    return None
+            if not _keys_streamable():
+                return None
             try:
                 pj = ShardedPartitionedJoin(
                     node.left_on, node.right_on, node.how, node.suffixes,
@@ -543,15 +605,11 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
                 if not pj.push_build(bb):
                     return None
                 nbb += 1
-            if pj.state is None:
+            if pj.state is None and not pj.spilling:
                 return None
-            log(1, f"streaming partitioned join: build state "
-                   f"{pj.state.nrows} rows over {nbb} batches")
-
-            def gen_pjoin(src):
-                for b in src:
-                    yield pj.probe(b)
-            return gen_pjoin(inner)
+            log(1, f"streaming partitioned join: build state over "
+                   f"{nbb} batches")
+            return _pjoin_gen(pj, inner)
         join = ShardedStreamJoin(build, node.left_on, node.right_on,
                                  node.how, node.suffixes, node.null_equal)
 
@@ -709,11 +767,16 @@ def append_sharded(state: Optional[Table], batch: Table,
     return Table(cols, int(counts.sum()), ONED, counts)
 
 
-def _dicts_compatible(state: Optional[Table], batch: Table) -> bool:
-    if state is None:
+def _dict_template(t: Table) -> Dict:
+    """Per-column dictionary snapshot; survives state parks so drift
+    detection stays live across spilled chunks."""
+    return {n: t.column(n).dictionary for n in t.names}
+
+
+def _dicts_match_template(tmpl: Optional[Dict], batch: Table) -> bool:
+    if tmpl is None:
         return True
-    for n in state.names:
-        sd = state.column(n).dictionary
+    for n, sd in tmpl.items():
         bd = batch.column(n).dictionary
         if sd is None and bd is None:
             continue
@@ -723,6 +786,116 @@ def _dicts_compatible(state: Optional[Table], batch: Table) -> bool:
                                  and bool(np.all(sd == bd))):
             return False
     return True
+
+
+def _dicts_compatible(state: Optional[Table], batch: Table) -> bool:
+    if state is None:
+        return True
+    return _dicts_match_template(_dict_template(state), batch)
+
+
+# ---------------------------------------------------------------------------
+# host-roundtrip helpers for spilled streaming state
+# ---------------------------------------------------------------------------
+
+def _table_device_bytes(t: Table) -> int:
+    n = 0
+    for c in t.columns.values():
+        n += c.data.size * c.data.dtype.itemsize
+        if c.valid is not None:
+            n += c.valid.size
+    return n
+
+
+def _host_cols(t: Table):
+    """(data, valid) numpy copies of the live rows of a REP table."""
+    out = {}
+    for n in t.names:
+        c = t.column(n)
+        d = np.asarray(jax.device_get(c.data))[:t.nrows]
+        v = (np.asarray(jax.device_get(c.valid))[:t.nrows]
+             if c.valid is not None else None)
+        out[n] = (d, v)
+    return out
+
+
+def _table_from_host(host_cols, template: Table, nrows: int) -> Table:
+    """REP device table from numpy columns, schema from `template`."""
+    from bodo_tpu.table.table import round_capacity
+    cap = round_capacity(max(nrows, 1))
+    cols: Dict[str, Column] = {}
+    for n, (d, v) in host_cols.items():
+        src = template.column(n)
+        pd_ = np.zeros((cap,), dtype=d.dtype)
+        pd_[:nrows] = d
+        pv = None
+        if v is not None:
+            pv = np.zeros((cap,), dtype=bool)
+            pv[:nrows] = v
+            pv = jnp.asarray(pv)
+        cols[n] = Column(jnp.asarray(pd_), pv, src.dtype, src.dictionary)
+    return Table(cols, nrows, REP, None)
+
+
+def _concat_host_frames(frames: Sequence[Dict], template: Table,
+                        nrows: int) -> Table:
+    """Concatenate host-col dicts (np) into one REP device table."""
+    cat = {}
+    for n in template.names:
+        has_v = any(f[n][1] is not None for f in frames)
+        d = np.concatenate([f[n][0] for f in frames])
+        v = (np.concatenate([f[n][1] if f[n][1] is not None
+                             else np.ones(len(f[n][0]), bool)
+                             for f in frames]) if has_v else None)
+        cat[n] = (d, v)
+    return _table_from_host(cat, template, nrows)
+
+
+def _concat_tables_host(tables: Sequence[Table]) -> Table:
+    """Concatenate REP tables host-side (np), preserving schema."""
+    if len(tables) == 1:
+        return tables[0]
+    return _concat_host_frames([_host_cols(t) for t in tables],
+                               tables[0], sum(t.nrows for t in tables))
+
+
+def _host_filter(t: Table, mask: np.ndarray) -> Table:
+    """Select rows of a REP table by a host bool mask (np gather)."""
+    hc = _host_cols(t)
+    out = {n: (d[mask], None if v is None else v[mask])
+           for n, (d, v) in hc.items()}
+    return _table_from_host(out, t, int(mask.sum()))
+
+
+def _key_membership(p: Table, b: Table, left_on, right_on,
+                    null_equal: bool) -> np.ndarray:
+    """Host bool[p.nrows]: does each probe row's key appear in b?
+
+    Scatter-claim membership probe (ops/hashtable.py); pathological
+    probe-round exhaustion falls back to a pandas merge indicator."""
+    from bodo_tpu.ops import hashtable as HT
+    from bodo_tpu.ops import kernels as K
+
+    pk = [(p.column(lk).data, p.column(lk).valid) for lk in left_on]
+    bk = [(b.column(rk).data, b.column(rk).valid) for rk in right_on]
+    pcodes, bcodes, p_ok0, b_ok0 = HT.aligned_codes(pk, bk, null_equal)
+    b_pad = K.row_mask(jnp.asarray(b.nrows), b.capacity)
+    p_pad = K.row_mask(jnp.asarray(p.nrows), p.capacity)
+    b_ok = b_pad if b_ok0 is None else (b_pad & b_ok0)
+    p_ok = p_pad if p_ok0 is None else (p_pad & p_ok0)
+    T = HT.table_size(b.capacity)
+    slot, owner, _r, un1 = HT.claim_slots(bcodes, b_ok, T)
+    idx, un2 = HT.probe_slots(bcodes, owner, pcodes, p_ok, T)
+    if bool(jax.device_get(un1 | un2)):
+        pl = p.select(list(left_on)).to_pandas()
+        bl = b.select(list(right_on)).to_pandas().drop_duplicates()
+        m = pl.merge(bl, left_on=list(left_on), right_on=list(right_on),
+                     how="left", indicator=True)
+        matched = (m["_merge"] == "both").to_numpy()
+        if not null_equal:
+            matched &= ~pl.isna().any(axis=1).to_numpy()
+        return matched
+    return np.asarray(jax.device_get(idx))[:p.nrows] >= 0
 
 
 # ---------------------------------------------------------------------------
@@ -750,6 +923,36 @@ class ShardedPartitionedJoin:
         self.null_equal = null_equal
         self.mesh = mesh or mesh_mod.get_mesh()
         self.state: Optional[Table] = None
+        # larger-than-device build: when the accumulated build state
+        # exceeds the configured device budget, whole state chunks park
+        # into the spillable host pool; probe batches are then deferred
+        # (parked too) and drained chunk-against-chunk at the end —
+        # device memory stays bounded by ~2 chunks + one join output
+        # (reference analogue: JoinPartition build spill + probe-side
+        # chunk replay, bodo/libs/streaming/_join.h:267).
+        self.budget = int(config.stream_device_budget_mb) << 20
+        self.build_chunks: List = []    # OffloadedTable (REP row order)
+        self.probe_chunks: List = []
+        self._pending_probe: Optional[Table] = None
+        self._key_template: Optional[Dict] = None
+        self._build_dicts: Optional[Dict] = None   # survives state parks
+        self._probe_dicts: Optional[Dict] = None
+        self._comp = None
+        self._op = None
+
+    # -- spill plumbing -----------------------------------------------------
+
+    def _park(self, t: Table):
+        from bodo_tpu.runtime.comptroller import default_comptroller
+        if self._comp is None:
+            self._comp = default_comptroller()
+            self._op = self._comp.register("stream_join")
+        return self._comp.park(self._op, t.gather()
+                               if t.distribution == ONED else t)
+
+    @property
+    def spilling(self) -> bool:
+        return bool(self.build_chunks)
 
     def push_build(self, b: Table) -> bool:
         """Accumulate one 1D build batch. False → caller must abandon
@@ -757,10 +960,35 @@ class ShardedPartitionedJoin:
         if b.distribution != ONED:
             b = b.shard()
         sb = R.shuffle_by_key(b, self.right_on)
-        if not _dicts_compatible(self.state, sb):
+        if not _dicts_match_template(self._build_dicts, sb):
+            self.close()  # free any parked chunks before the fallback
             return False
+        if self._build_dicts is None:
+            self._build_dicts = _dict_template(sb)
+            self._key_template = {
+                rk: (sb.column(rk).dtype, sb.column(rk).dictionary)
+                for rk in self.right_on}
         self.state = append_sharded(self.state, sb, self.mesh)
+        if self.budget and _table_device_bytes(self.state) > self.budget:
+            self.build_chunks.append(self._park(self.state))
+            self.state = None
         return True
+
+    def close(self) -> None:
+        """Free parked host-pool state (idempotent). Called when
+        streaming is abandoned or after drain() — parked chunks must not
+        outlive the operator."""
+        for ot in self.build_chunks + self.probe_chunks:
+            try:
+                ot.free()
+            except Exception:
+                pass
+        self.build_chunks, self.probe_chunks = [], []
+        self._pending_probe = None
+        self.state = None
+        if self._comp is not None:
+            self._comp.unregister(self._op)
+            self._comp = None
 
     def _probe_keys_compatible(self, pb: Table) -> None:
         """Fail loudly when probe key columns cannot be compared against
@@ -769,15 +997,16 @@ class ShardedPartitionedJoin:
         return silently wrong matches for a direct user of this class
         (build_stream_sharded gates this, __graft_entry__-style callers
         don't)."""
-        if self.state is None:
+        if self._key_template is None:
             return
         for lk, rk in zip(self.left_on, self.right_on):
-            pc, bc = pb.column(lk), self.state.column(rk)
-            if pc.dtype is not bc.dtype:
+            pc = pb.column(lk)
+            bdt, bd = self._key_template[rk]
+            if pc.dtype is not bdt:
                 raise ValueError(
                     f"probe key {lk!r} dtype {pc.dtype} != build key "
-                    f"{rk!r} dtype {bc.dtype}")
-            pd_, bd = pc.dictionary, bc.dictionary
+                    f"{rk!r} dtype {bdt}")
+            pd_ = pc.dictionary
             if pd_ is None and bd is None:
                 continue
             if pd_ is None or bd is None or (
@@ -788,10 +1017,28 @@ class ShardedPartitionedJoin:
                     "build state's — codes are not comparable (re-encode "
                     "or use the whole-table join)")
 
-    def probe(self, b: Table) -> Table:
+    def probe(self, b: Table) -> Optional[Table]:
+        """Join one probe batch. Returns the joined batch — or None when
+        the build side spilled past the device budget: the batch is
+        parked and its results come from drain() instead."""
         if b.distribution != ONED:
             b = b.shard()
         self._probe_keys_compatible(b)
+        if self.spilling:
+            # defer RAW batches (no shuffle: drain()'s join_tables
+            # re-partitions restored chunks from scratch anyway)
+            if not _dicts_match_template(self._probe_dicts, b):
+                raise ValueError("probe batch dictionaries drifted "
+                                 "across spilled streaming state")
+            if self._probe_dicts is None:
+                self._probe_dicts = _dict_template(b)
+            self._pending_probe = append_sharded(self._pending_probe, b,
+                                                 self.mesh)
+            if self.budget and _table_device_bytes(
+                    self._pending_probe) > self.budget:
+                self.probe_chunks.append(self._park(self._pending_probe))
+                self._pending_probe = None
+            return None
         pb = R.shuffle_by_key(b, self.left_on)
         out = R._join_sharded(pb, self.state, self.left_on, self.right_on,
                               self.how, self.suffixes,
@@ -800,22 +1047,81 @@ class ShardedPartitionedJoin:
         cap = _pow2_cap(max(int(out.counts.max(initial=0)), 1))
         return shard_recapacity(out, cap, self.mesh)
 
+    def drain(self) -> Iterator[Table]:
+        """Emit results for probe batches deferred while the build side
+        was spilled: every (probe chunk × build chunk) pair joins inner
+        at bounded device residency; for a left join, probe rows matched
+        by NO chunk emit once against an empty build table (preserving
+        output schema/suffix naming). Frees all parked state."""
+        if not self.spilling:
+            return
+        if self.state is not None:
+            self.build_chunks.append(self._park(self.state))
+            self.state = None
+        if self._pending_probe is not None:
+            self.probe_chunks.append(self._park(self._pending_probe))
+            self._pending_probe = None
+        log(1, f"streaming join drain: {len(self.build_chunks)} build x "
+               f"{len(self.probe_chunks)} probe spilled chunks")
+        try:
+            for pot in self.probe_chunks:
+                p = pot.restore_slice(0, pot.nrows)
+                matched = np.zeros(p.nrows, dtype=bool)
+                empty_b = None
+                for bot in self.build_chunks:
+                    c = bot.restore_slice(0, bot.nrows)
+                    out = R.join_tables(
+                        p.shard(), c.shard(), self.left_on, self.right_on,
+                        how="inner", suffixes=self.suffixes,
+                        null_equal=self.null_equal)
+                    if out.distribution != ONED:
+                        out = out.shard()
+                    yield out
+                    if self.how == "left":
+                        matched |= _key_membership(
+                            p, c, self.left_on, self.right_on,
+                            self.null_equal)
+                    if empty_b is None:
+                        zc = np.zeros(mesh_mod.num_shards(self.mesh),
+                                      dtype=np.int64)
+                        cb = c.shard()
+                        empty_b = Table(dict(cb.columns), 0, ONED, zc)
+                if self.how == "left" and not matched.all():
+                    unm = _host_filter(p, ~matched)
+                    out = R.join_tables(
+                        unm.shard(), empty_b, self.left_on, self.right_on,
+                        how="left", suffixes=self.suffixes,
+                        null_equal=self.null_equal)
+                    if out.distribution != ONED:
+                        out = out.shard()
+                    yield out
+        finally:
+            self.close()
+
 
 # ---------------------------------------------------------------------------
 # streaming sample sort (two passes over a re-buildable stream)
 # ---------------------------------------------------------------------------
 
 class ShardedStreamSort:
-    """Distributed streaming sort: batches append into per-shard 1D
-    state as they flow (one pass over the child), then finish() runs the
-    existing sample sort — one range exchange + local sort — over the
-    accumulated state.
+    """Distributed streaming sort with run-generation external sort.
+
+    Batches append into per-shard 1D state as they flow (one pass over
+    the child). Under a device budget (config.stream_device_budget_mb),
+    each time the state exceeds the budget it is SORTED into a run and
+    parked in the spillable host pool (the comptroller spills runs to
+    disk under pressure); finish() then range-merges the sorted runs:
+    global range splitters come from the runs' partition keys (host),
+    each range restores only its row slices from every run (binary
+    search on the runs' sorted keys — no full-run restore), concatenates
+    and locally sorts them, so device residency during the merge is one
+    range at a time.
 
     The reference streams sort chunks with spill + final k-way merge
-    (bodo/libs/streaming/_sort.cpp); here the final merge is replaced by
-    the mesh sample sort (ops/sort.py sort_sharded), and bounded device
-    memory comes from the accumulate state being a plain 1D table the
-    comptroller can park between batches."""
+    (bodo/libs/streaming/_sort.cpp external sort); the k-way comparator
+    merge becomes a range-partitioned re-sort, the same trade the mesh
+    sample sort makes (ops/sort.py). With no budget (0), finish() is the
+    one-shot mesh sample sort over the accumulated state."""
 
     def __init__(self, by, ascending, na_last: bool, mesh=None):
         self.by = list(by)
@@ -824,17 +1130,102 @@ class ShardedStreamSort:
         self.mesh = mesh or mesh_mod.get_mesh()
         self.S = mesh_mod.num_shards(self.mesh)
         self.state: Optional[Table] = None
+        self.budget = int(config.stream_device_budget_mb) << 20
+        self.runs: List[Tuple] = []  # (OffloadedTable, pk np, nbytes)
+        self._dicts: Optional[Dict] = None  # survives run parks
+        self._comp = None
+        self._op = None
 
     def push(self, b: Table) -> bool:
         if b.distribution != ONED:
             b = b.shard()
-        if not _dicts_compatible(self.state, b):
+        if not _dicts_match_template(self._dicts, b):
+            self.close()
             return False
+        if self._dicts is None:
+            self._dicts = _dict_template(b)
         self.state = append_sharded(self.state, b, self.mesh)
+        if self.budget and _table_device_bytes(self.state) > self.budget:
+            self._park_run()
         return True
 
+    def close(self) -> None:
+        """Free parked runs (idempotent) — abandonment must not leak."""
+        for ot, _pk, _b in self.runs:
+            try:
+                ot.free()
+            except Exception:
+                pass
+        self.runs = []
+        self.state = None
+        if self._comp is not None:
+            self._comp.unregister(self._op)
+            self._comp = None
+
+    def _park_run(self) -> None:
+        from bodo_tpu.ops.sort import _partition_key
+        from bodo_tpu.runtime.comptroller import default_comptroller
+        if self._comp is None:
+            self._comp = default_comptroller()
+            self._op = self._comp.register("stream_sort")
+        run = R.sort_table(self.state, self.by, self.ascending,
+                           self.na_last)
+        g = run.gather() if run.distribution == ONED else run
+        c0 = g.column(self.by[0])
+        padmask = jnp.arange(g.capacity) < g.nrows
+        pk = _partition_key([(c0.data, c0.valid)], [self.ascending[0]],
+                            self.na_last, padmask)
+        pk = np.asarray(jax.device_get(pk))[:g.nrows]
+        nbytes = _table_device_bytes(g)
+        ot = self._comp.park(self._op, g)
+        self.runs.append((ot, pk, nbytes))
+        self.state = None
+        log(1, f"streaming sort: parked run {len(self.runs)} "
+               f"({g.nrows} rows, {nbytes >> 20} MiB)")
+
     def finish(self) -> Table:
-        return R.sort_table(self.state, self.by, self.ascending,
-                            self.na_last)
+        if not self.runs:
+            return R.sort_table(self.state, self.by, self.ascending,
+                                self.na_last)
+        if self.state is not None and self.state.nrows > 0:
+            self._park_run()
+        try:
+            return self._merge_runs()
+        finally:
+            self.close()
+
+    def _merge_runs(self) -> Table:
+        total_rows = sum(pk.size for _ot, pk, _b in self.runs)
+        total_bytes = sum(b for *_x, b in self.runs)
+        nranges = max(2, -(-total_bytes // max(self.budget, 1)))
+        allpk = np.sort(np.concatenate([pk for _ot, pk, _b in self.runs]))
+        spl = [allpk[min(i * total_rows // nranges, total_rows - 1)]
+               for i in range(1, nranges)]
+        log(1, f"streaming sort merge: {len(self.runs)} runs, "
+               f"{total_rows} rows, {nranges} ranges")
+        frames = []
+        template = None
+        out_rows = 0
+        for r in range(nranges):
+            parts = []
+            for ot, pk, _b in self.runs:
+                lo = 0 if r == 0 else int(np.searchsorted(
+                    pk, spl[r - 1], side="left"))
+                hi = pk.size if r == nranges - 1 else int(np.searchsorted(
+                    pk, spl[r], side="left"))
+                if hi > lo:
+                    parts.append(ot.restore_slice(lo, hi))
+            if not parts:
+                continue
+            chunk = _concat_tables_host(parts)
+            schunk = R.sort_table(chunk, self.by, self.ascending,
+                                  self.na_last)
+            if schunk.distribution == ONED:
+                schunk = schunk.gather()
+            frames.append(_host_cols(schunk))
+            template = schunk
+            out_rows += schunk.nrows
+        out = _concat_host_frames(frames, template, out_rows)
+        return out.shard()
 
 

@@ -72,13 +72,18 @@ class OperatorComptroller:
 
     def park(self, op_id: int, t: Table) -> OffloadedTable:
         """Offload a table into the pool under this operator's account,
-        making room by spilling other parked state if needed."""
+        making room by spilling other parked state if needed. If the
+        pool is still over its limit after the insert (a single parked
+        state bigger than the whole budget), the new state spills to
+        disk immediately — parked state is always allowed to leave
+        memory."""
         need = self._table_bytes(t)
         self.ensure_room(need)
         ot = offload_table(t, pool=self.pool)
         with self._mu:
             if op_id in self._parked:
                 self._parked[op_id].append([ot, need, False])
+        self.ensure_room(0)
         return ot
 
     def release(self, op_id: int, ot: OffloadedTable) -> None:
@@ -96,30 +101,35 @@ class OperatorComptroller:
 
     def ensure_room(self, nbytes: int) -> None:
         """Spill largest-first until `nbytes` fits under the limit (best
-        effort — stops when nothing unpinned remains)."""
+        effort — stops when nothing spillable remains). Previously
+        spilled entries remain candidates: a restore_slice() pin/unpin
+        cycle brings a run's buffers back into memory, so the
+        spilled-once flag is only a priority hint (fresh state first),
+        not a permanent exclusion."""
         from bodo_tpu.utils import tracing
         while self._in_use() + nbytes > self.limit:
-            victim = None
             with self._mu:
-                for op, lst in self._parked.items():
-                    for e in lst:
-                        if not e[2] and (victim is None
-                                         or e[1] > victim[1][1]):
-                            victim = (op, e)
-            if victim is None:
+                entries = [(op, e) for op, lst in self._parked.items()
+                           for e in lst]
+            # fresh (never-spilled) victims first, then re-resident ones;
+            # largest-first within each class
+            entries.sort(key=lambda oe: (oe[1][2], -oe[1][1]))
+            progress = False
+            for op, e in entries:
+                with tracing.event("comptroller_spill",
+                                   operator=self._ops.get(op, "?"),
+                                   bytes=e[1]):
+                    spilled = e[0].spill()
+                e[2] = True
+                if spilled:
+                    progress = True
+                    self.n_spills += 1
+                    self.bytes_spilled += e[1]
+                    log(1, f"comptroller: spilled {e[1]} bytes of "
+                           f"{self._ops.get(op, '?')} ({spilled} buffers)")
+                    break
+            if not progress:
                 return
-            op, e = victim
-            with tracing.event("comptroller_spill",
-                               operator=self._ops.get(op, "?"),
-                               bytes=e[1]):
-                spilled = e[0].spill()
-            e[2] = True  # marked even on failure so the loop advances
-            if spilled == 0:
-                continue  # pinned/already-freed victim: try next largest
-            self.n_spills += 1
-            self.bytes_spilled += e[1]
-            log(1, f"comptroller: spilled {e[1]} bytes of "
-                   f"{self._ops.get(op, '?')} ({spilled} buffers)")
 
     def stats(self) -> dict:
         with self._mu:

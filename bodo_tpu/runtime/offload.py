@@ -61,6 +61,54 @@ class OffloadedTable:
                 n += int(c.valid.spill())
         return n
 
+    @property
+    def nrows(self) -> int:
+        return self._nrows
+
+    def restore_slice(self, lo: int, hi: int,
+                      unpin_after: bool = True) -> Table:
+        """Rebuild a REP device Table from host rows [lo, hi) WITHOUT
+        closing the offloaded table (the external sort/join restore
+        spilled state one range at a time — reference analogue: partition
+        rescan in bodo/libs/streaming/_sort.cpp /
+        _join.h JoinPartition::FinalizeBuild). Buffers are re-pinned for
+        the copy (restoring from disk if spilled) and unpinned again by
+        default so the remaining rows stay spillable."""
+        if self._closed:
+            raise RuntimeError("OffloadedTable already restored/freed")
+        lo = max(0, min(lo, self._nrows))
+        hi = max(lo, min(hi, self._nrows))
+        cols: Dict[str, Column] = {}
+        for name, c in self._cols.items():
+            if not c.data._pinned:
+                c.data.pin()
+            arr = np.array(c.data.as_array(c.data_dtype)[lo:hi], copy=True)
+            valid = None
+            if c.valid is not None:
+                if not c.valid._pinned:
+                    c.valid.pin()
+                valid = jnp.asarray(np.array(
+                    c.valid.as_array(np.bool_)[lo:hi], copy=True))
+            cols[name] = Column(jnp.asarray(arr), valid, c.dtype,
+                                c.dictionary)
+            if unpin_after:
+                c.data.unpin()
+                if c.valid is not None:
+                    c.valid.unpin()
+        return Table(cols, hi - lo, "REP", None)
+
+    def host_column(self, name: str) -> np.ndarray:
+        """Host view copy of one column's live rows (pins for the read)."""
+        if self._closed:
+            raise RuntimeError("OffloadedTable already restored/freed")
+        c = self._cols[name]
+        if not c.data._pinned:
+            c.data.pin()
+        arr = np.array(c.data.as_array(c.data_dtype)[:self._nrows],
+                       copy=True)
+        c.data.unpin()
+        return arr
+
     def restore(self) -> Table:
         """Pin (restoring from disk as needed) and rebuild the device
         Table, then release the host buffers. One-shot: the offloaded

@@ -51,11 +51,13 @@ def main(argv: list[str]) -> int:
         dt = time.time() - t1
         tail = (r.stdout.strip().splitlines() or [""])[-1]
         print(f"{tail}  ({dt:.0f}s)")
-        # "5 passed" / "2 passed, 1 skipped" style summary; count tests
+        # count only "N passed" — warnings/failed/deselected parts of the
+        # summary line must not inflate the headline test count
         for part in tail.split(","):
-            part = part.strip()
-            if part and part.split()[0].isdigit():
-                total += int(part.split()[0])
+            words = part.strip().split()
+            if len(words) >= 2 and words[0].isdigit() \
+                    and words[1].startswith("passed"):
+                total += int(words[0])
         if r.returncode == 5:  # no tests collected (e.g. -k filter)
             continue
         if r.returncode != 0:

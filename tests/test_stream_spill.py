@@ -1,0 +1,120 @@
+"""Larger-than-device-budget streaming: external sort runs + partitioned
+join build/probe spill through the comptroller host pool
+(plan/streaming_sharded.py; reference analogues:
+bodo/libs/streaming/_sort.cpp external sort,
+bodo/libs/streaming/_join.h:267 JoinPartition spill)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bodo_tpu.config import set_config
+from bodo_tpu.table.table import Table
+
+
+@pytest.fixture
+def budget1mb():
+    set_config(stream_device_budget_mb=1)
+    yield
+    set_config(stream_device_budget_mb=0)
+
+
+def _big(n=200_000, seed=5):
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({"k": r.permutation(n).astype(np.int64),
+                         "x": r.normal(size=n)})
+
+
+def test_external_sort_spills_and_orders(mesh8, budget1mb):
+    from bodo_tpu.plan.streaming_sharded import (ShardedStreamSort,
+                                                 table_batches_sharded)
+    df = _big()
+    ss = ShardedStreamSort(["k"], [True], True)
+    t = Table.from_pandas(df).shard()
+    for b in table_batches_sharded(t, 8192):
+        assert ss.push(b)
+    assert len(ss.runs) >= 2, "budget must force multiple parked runs"
+    out = ss.finish().to_pandas()
+    assert len(out) == len(df)
+    np.testing.assert_array_equal(out["k"].to_numpy(),
+                                  np.arange(len(df), dtype=np.int64))
+    # payload stays row-aligned with the key through the run merge
+    exp = df.sort_values("k")["x"].to_numpy()
+    np.testing.assert_allclose(out["x"].to_numpy(), exp)
+
+
+def test_external_sort_multikey_desc(mesh8, budget1mb):
+    from bodo_tpu.plan.streaming_sharded import (ShardedStreamSort,
+                                                 table_batches_sharded)
+    r = np.random.default_rng(6)
+    n = 150_000
+    df = pd.DataFrame({"a": r.integers(0, 50, n),
+                       "b": r.normal(size=n),
+                       "x": np.arange(n, dtype=np.float64)})
+    ss = ShardedStreamSort(["a", "b"], [True, False], True)
+    for bt in table_batches_sharded(Table.from_pandas(df).shard(), 8192):
+        assert ss.push(bt)
+    assert ss.runs
+    out = ss.finish().to_pandas()
+    exp = df.sort_values(["a", "b"], ascending=[True, False])
+    np.testing.assert_array_equal(out["a"].to_numpy(),
+                                  exp["a"].to_numpy())
+    np.testing.assert_allclose(out["b"].to_numpy(), exp["b"].to_numpy())
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_partitioned_join_spill_drain(mesh8, budget1mb, how):
+    from bodo_tpu.plan.streaming_sharded import (ShardedPartitionedJoin,
+                                                 table_batches_sharded)
+    r = np.random.default_rng(7)
+    nb = 150_000
+    build = pd.DataFrame({"k": r.permutation(nb).astype(np.int64),
+                          "w": r.normal(size=nb)})
+    # probe half in-range (matches), half out-of-range (left-only rows)
+    probe = pd.DataFrame({"k": r.integers(0, 2 * nb, 6000)
+                          .astype(np.int64),
+                          "y": r.normal(size=6000)})
+    pj = ShardedPartitionedJoin(["k"], ["k"], how, ("_x", "_y"))
+    for b in table_batches_sharded(Table.from_pandas(build).shard(), 8192):
+        assert pj.push_build(b)
+    assert pj.spilling, "budget must force spilled build chunks"
+    outs = []
+    for b in table_batches_sharded(Table.from_pandas(probe).shard(), 2048):
+        out = pj.probe(b)
+        if out is not None:
+            outs.append(out.to_pandas())
+    for out in pj.drain():
+        outs.append(out.to_pandas())
+    got = pd.concat(outs, ignore_index=True)
+    exp = probe.merge(build, on="k", how=how)
+    assert len(got) == len(exp)
+    key = ["k", "y"]
+    g = got.sort_values(key).reset_index(drop=True)
+    e = exp.sort_values(key).reset_index(drop=True)
+    np.testing.assert_allclose(g["y"].to_numpy(), e["y"].to_numpy())
+    np.testing.assert_allclose(g["w"].to_numpy(), e["w"].to_numpy(),
+                               equal_nan=True)
+
+
+def test_spill_recorded_by_comptroller(mesh8, budget1mb):
+    """The parked runs flow through the operator comptroller (visible in
+    its stats), not ad-hoc host arrays."""
+    from bodo_tpu.plan.streaming_sharded import (ShardedStreamSort,
+                                                 table_batches_sharded)
+    from bodo_tpu.runtime.comptroller import (OperatorComptroller,
+                                              set_default_comptroller)
+    comp = OperatorComptroller(limit_bytes=1 << 20)  # 1 MiB host limit
+    set_default_comptroller(comp)
+    try:
+        df = _big(120_000, seed=8)
+        ss = ShardedStreamSort(["k"], [True], True)
+        for b in table_batches_sharded(Table.from_pandas(df).shard(),
+                                       8192):
+            assert ss.push(b)
+        assert ss.runs
+        stats = comp.stats()
+        assert stats["n_spills"] >= 1, stats  # host limit forced disk
+        out = ss.finish().to_pandas()
+        assert out["k"].is_monotonic_increasing and len(out) == len(df)
+    finally:
+        set_default_comptroller(None)

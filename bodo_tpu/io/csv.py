@@ -79,16 +79,22 @@ def _attach_host_ranges(t: Table, at: pa.Table) -> None:
 CHUNK_BYTES = 32 << 20
 
 
-def _newline_bounds(path: str, chunk_bytes: int):
+def _newline_bounds(path: str, chunk_bytes: int,
+                    split_header: bool = True):
     """(header_bytes, offsets): byte-range chunk boundaries aligned to
     row starts by scanning forward to the next newline from each nominal
     split point — the reference's offset-search scheme
     (bodo/io/_csv_json_reader.cpp). Like the reference's scanner this
-    assumes the row delimiter does not appear inside quoted fields."""
+    assumes the row delimiter does not appear inside quoted fields.
+    `split_header=False` (JSON-lines: the first line is data) returns
+    header=b"" with bounds starting at byte 0."""
     import os
     size = os.path.getsize(path)
     with open(path, "rb") as f:
-        header = f.readline()
+        if split_header:
+            header = f.readline()
+        else:
+            header = b""
         start = f.tell()
         bounds = [start]
         pos = start + chunk_bytes
@@ -134,6 +140,31 @@ def iter_csv_arrow(path: str, columns: Optional[Sequence[str]] = None,
             yield at
 
 
+def slice_arrow_batches(src, chunksize: int):
+    """Re-slice a stream of arrow Tables into exactly-`chunksize` arrow
+    Tables (last may be short). Linear: the pending tail concatenates
+    once per INPUT chunk, and all output slices cut from that one
+    concatenation (not re-concatenated per yield)."""
+    if chunksize < 1:
+        raise ValueError(f"chunksize must be >= 1, got {chunksize}")
+    pending = []
+    pending_rows = 0
+    for at in src:
+        pending.append(at)
+        pending_rows += at.num_rows
+        if pending_rows < chunksize:
+            continue
+        whole = pa.concat_tables(pending)
+        off = 0
+        while pending_rows - off >= chunksize:
+            yield whole.slice(off, chunksize)
+            off += chunksize
+        pending = [whole.slice(off)] if pending_rows > off else []
+        pending_rows -= off
+    if pending_rows:
+        yield pa.concat_tables(pending)
+
+
 def read_csv_chunked(path: str, chunksize: int,
                      columns: Optional[Sequence[str]] = None,
                      parse_dates: Optional[Sequence[str]] = None,
@@ -142,17 +173,7 @@ def read_csv_chunked(path: str, chunksize: int,
     DataFrames of exactly `chunksize` rows (last may be short), parsed
     chunk-at-a-time with bounded host memory (reference:
     bodo/io/csv_iterator_ext.py)."""
-    pending = []
-    pending_rows = 0
-    for at in iter_csv_arrow(path, columns, parse_dates, chunk_bytes):
-        pending.append(at)
-        pending_rows += at.num_rows
-        while pending_rows >= chunksize:
-            whole = pa.concat_tables(pending)
-            head = whole.slice(0, chunksize)
-            tail = whole.slice(chunksize)
-            pending = [tail] if tail.num_rows else []
-            pending_rows = tail.num_rows
-            yield head.to_pandas()
-    if pending_rows:
-        yield pa.concat_tables(pending).to_pandas()
+    for at in slice_arrow_batches(
+            iter_csv_arrow(path, columns, parse_dates, chunk_bytes),
+            chunksize):
+        yield at.to_pandas()

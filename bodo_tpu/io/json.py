@@ -1,5 +1,11 @@
 """JSON (lines) reader — analogue of the reference's JSON connector
-(bodo/io/_csv_json_reader.cpp, bodo/ir/json_ext.py:32)."""
+(bodo/io/_csv_json_reader.cpp, bodo/ir/json_ext.py:32).
+
+Whole-file `read_json` plus a chunked byte-range reader for JSON-lines:
+record boundaries are newlines, so the CSV reader's newline-aligned
+byte-range scheme applies unchanged; the first chunk's inferred schema
+is pinned as an explicit_schema for every later chunk so dtypes cannot
+drift mid-file."""
 
 from __future__ import annotations
 
@@ -16,3 +22,42 @@ def read_json(path: str, columns: Optional[Sequence[str]] = None) -> Table:
     if columns:
         at = at.select(list(columns))
     return arrow_to_table(at)
+
+
+def iter_json_arrow(path: str, columns: Optional[Sequence[str]] = None,
+                    chunk_bytes: Optional[int] = None):
+    """Yield one arrow Table per newline-aligned byte-range chunk of a
+    JSON-lines file (one record per line)."""
+    import io as _io
+
+    from bodo_tpu.io.csv import CHUNK_BYTES, _newline_bounds
+
+    if chunk_bytes is None:
+        chunk_bytes = CHUNK_BYTES
+    # JSON-lines has no header row: the first line is data
+    _hdr, bounds = _newline_bounds(path, chunk_bytes, split_header=False)
+    schema = None
+    with open(path, "rb") as f:
+        for s, e in zip(bounds, bounds[1:]):
+            f.seek(s)
+            buf = f.read(e - s)
+            po = (pajson.ParseOptions(explicit_schema=schema)
+                  if schema is not None else pajson.ParseOptions())
+            at = pajson.read_json(_io.BytesIO(buf), parse_options=po)
+            if schema is None:
+                schema = at.schema
+            if columns:
+                at = at.select(list(columns))
+            yield at
+
+
+def read_json_chunked(path: str, chunksize: int,
+                      columns: Optional[Sequence[str]] = None,
+                      chunk_bytes: Optional[int] = None):
+    """Iterator of pandas DataFrames of exactly `chunksize` rows from a
+    JSON-lines file, parsed chunk-at-a-time with bounded host memory."""
+    from bodo_tpu.io.csv import slice_arrow_batches
+
+    for at in slice_arrow_batches(
+            iter_json_arrow(path, columns, chunk_bytes), chunksize):
+        yield at.to_pandas()

@@ -13,15 +13,42 @@ from bodo_tpu.pandas_api.series import BodoSeries
 from bodo_tpu.plan import logical as L
 
 __all__ = ["BodoDataFrame", "BodoSeries", "read_parquet", "read_csv",
-           "from_pandas", "concat"]
+           "read_json", "from_pandas", "concat"]
 
 
 def read_parquet(path, columns=None) -> BodoDataFrame:
     return BodoDataFrame(L.ReadParquet(path, columns))
 
 
-def read_csv(path, columns=None, parse_dates=None) -> BodoDataFrame:
+def read_csv(path, columns=None, parse_dates=None, chunksize=None,
+             iterator=False):
+    """Lazy CSV scan. With `chunksize` (or `iterator=True`) returns an
+    iterator of pandas DataFrames parsed chunk-at-a-time with bounded
+    host memory (pandas TextFileReader analogue; reference:
+    bodo/io/csv_iterator_ext.py)."""
+    if chunksize is not None or iterator:
+        if chunksize is not None and chunksize < 1:
+            raise ValueError(
+                f"chunksize must be >= 1, got {chunksize}")
+        from bodo_tpu.io.csv import read_csv_chunked
+        return read_csv_chunked(path,
+                                1_000_000 if chunksize is None
+                                else chunksize,
+                                columns, parse_dates)
     return BodoDataFrame(L.ReadCsv(path, columns, parse_dates))
+
+
+def read_json(path, columns=None, chunksize=None):
+    """JSON-lines scan. With `chunksize`, an iterator of pandas
+    DataFrames (byte-range chunked parse, bounded host memory);
+    otherwise an eager whole-file read into a lazy frame (reference:
+    bodo/ir/json_ext.py)."""
+    if chunksize is not None:
+        from bodo_tpu.io.json import read_json_chunked
+        return read_json_chunked(path, chunksize, columns)
+    from bodo_tpu.io.json import read_json as _rj
+    t = _rj(path, columns)
+    return BodoDataFrame(L.FromPandas(t))
 
 
 def from_pandas(df) -> BodoDataFrame:

@@ -170,6 +170,23 @@ def parquet_batches(path: str, columns: Optional[Sequence[str]],
         yield flush()
 
 
+def csv_batches(path: str, columns: Optional[Sequence[str]],
+                parse_dates, batch_rows: int) -> Iterator[Table]:
+    """Stream a CSV file as fixed-capacity REP Tables: newline-aligned
+    byte-range chunks parsed one at a time (bounded host memory), then
+    re-sliced to a fixed row count so every downstream kernel compiles
+    once (reference: chunked parallel CSV read,
+    bodo/io/_csv_json_reader.cpp + csv_iterator_ext.py)."""
+    from bodo_tpu.io.arrow_bridge import arrow_to_table
+    from bodo_tpu.io.csv import iter_csv_arrow, slice_arrow_batches
+
+    cap = round_capacity(batch_rows)
+    tracker = DictTracker()
+    for at in slice_arrow_batches(
+            iter_csv_arrow(path, columns, parse_dates), batch_rows):
+        yield tracker.absorb(arrow_to_table(at, capacity=cap))
+
+
 def table_batches(t: Table, batch_rows: int) -> Iterator[Table]:
     """Slice an in-memory REP table into fixed-capacity batches (static
     Python slice bounds, so every batch shares one compiled shape)."""
@@ -677,6 +694,9 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
 
     if isinstance(node, L.ReadParquet):
         return parquet_batches(node.path, node.columns, batch_rows)
+    if isinstance(node, L.ReadCsv):
+        return csv_batches(node.path, node.columns, node.parse_dates,
+                           batch_rows)
     if isinstance(node, L.FromPandas):
         if node.table.distribution != REP:
             return None

@@ -138,11 +138,28 @@ def parquet_batches_sharded(path: str, columns: Optional[Sequence[str]],
     (bounded host memory), scatter each over the mesh at a FIXED per-shard
     capacity so every downstream kernel compiles once."""
     from bodo_tpu.plan.streaming import parquet_batches
+    return _shard_batches(parquet_batches(path, columns, batch_rows),
+                          batch_rows, mesh)
+
+
+def csv_batches_sharded(path: str, columns: Optional[Sequence[str]],
+                        parse_dates, batch_rows: int,
+                        mesh=None) -> Iterator[Table]:
+    """Stream a CSV file as 1D batches (byte-range chunked host parse →
+    fixed-capacity scatter; reference: the parallel chunked CSV scan,
+    bodo/io/_csv_json_reader.cpp)."""
+    from bodo_tpu.plan.streaming import csv_batches
+    return _shard_batches(csv_batches(path, columns, parse_dates,
+                                      batch_rows), batch_rows, mesh)
+
+
+def _shard_batches(src: Iterator[Table], batch_rows: int,
+                   mesh=None) -> Iterator[Table]:
     m = mesh or mesh_mod.get_mesh()
     S = mesh_mod.num_shards(m)
     bcap_s = _pow2_cap(-(-batch_rows // S))
     with mesh_mod.use_mesh(m):
-        for rep_batch in parquet_batches(path, columns, batch_rows):
+        for rep_batch in src:
             sh = rep_batch.shard()
             yield shard_recapacity(sh, bcap_s, m)
 
@@ -482,6 +499,9 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
     if isinstance(node, L.ReadParquet):
         return parquet_batches_sharded(node.path, node.columns, batch_rows,
                                        m)
+    if isinstance(node, L.ReadCsv):
+        return csv_batches_sharded(node.path, node.columns,
+                                   node.parse_dates, batch_rows, m)
     if isinstance(node, L.FromPandas):
         t = node.table
         if t.distribution != ONED:

@@ -363,24 +363,16 @@ def main():
         if args.mesh > 1:
             os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
                 f" --xla_force_host_platform_device_count={args.mesh}"
-    # persistent XLA compile cache, keyed by backend platform + a host
-    # CPU-feature fingerprint: a cache populated on a DIFFERENT host (or
-    # for a different backend) must never be offered to this process —
-    # XLA warns "could lead to execution errors such as SIGILL" when a
-    # donated executable was compiled for other CPU features.
-    import hashlib
-    import platform as _plat
-    try:
-        with open("/proc/cpuinfo") as f:
-            feats = next((ln for ln in f if ln.startswith("flags")), "")
-    except OSError:
-        feats = ""
-    fp = hashlib.sha1(
-        (_plat.machine() + feats).encode()).hexdigest()[:10]
-    backend = "cpu" if use_cpu else accel["platform"]
-    os.environ.setdefault(
-        "BODO_TPU_COMPILE_CACHE_DIR",
-        os.path.join(_REPO, ".bench_data", f"xla_cache_{backend}_{fp}"))
+    # persistent XLA compile cache for the TPU backend ONLY: XLA:CPU AOT
+    # executables embed host CPU-feature tuning that varies even across
+    # processes on one box ("could lead to execution errors such as
+    # SIGILL" warnings when reloaded), and CPU compiles are cheap enough
+    # not to need a disk cache.
+    if not use_cpu:
+        os.environ.setdefault(
+            "BODO_TPU_COMPILE_CACHE_DIR",
+            os.path.join(_REPO, ".bench_data",
+                         f"xla_cache_{accel['platform']}"))
 
     import jax
     if use_cpu:

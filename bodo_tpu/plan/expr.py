@@ -280,13 +280,72 @@ class DictMap(Expr):
         if self.kind == "append":
             return s + self.params[0]
         if self.kind == "regexp_replace":
-            pat, repl = self.params
-            return re.sub(pat, repl, s)
+            # (pat, repl[, position, occurrence]) — occurrence 0 = all
+            # (Snowflake REGEXP_REPLACE semantics,
+            # bodosql/kernels/regexp_array_kernels.py)
+            pat, repl = self.params[:2]
+            pos = self.params[2] if len(self.params) > 2 else 1
+            occ = self.params[3] if len(self.params) > 3 else 0
+            head, tail = s[:pos - 1], s[pos - 1:]
+            if occ == 0:
+                return head + re.sub(pat, repl, tail)
+            n = 0
+            for m in re.finditer(pat, tail):
+                n += 1
+                if n == occ:
+                    return (head + tail[:m.start()] + m.expand(repl)
+                            + tail[m.end():])
+            return s  # fewer than `occ` matches: unchanged
         if self.kind == "regexp_substr":
-            # no-match rows become NULL (validity handled by the
-            # assign_columns host pass, relational._str_part)
-            m = re.search(self.params[0], s)
-            return m.group(0) if m else ""
+            # (pat[, position, occurrence, group]) — no-match rows become
+            # NULL (validity handled by the assign_columns host pass,
+            # relational._str_part)
+            m = self._re_match(s)
+            if m is None:
+                return ""
+            grp = self.params[3] if len(self.params) > 3 else 0
+            return m.group(grp) or ""
+        if self.kind == "json_extract":
+            # JSON_EXTRACT_PATH_TEXT: dotted/indexed path into a JSON
+            # string; missing path / bad JSON -> NULL via host_null
+            # (bodosql/kernels/json_array_kernels.py)
+            v = _json_path_get(s, self.params[0])
+            if v is None:
+                return ""
+            if isinstance(v, (dict, list)):
+                import json as _json
+                return _json.dumps(v, separators=(",", ":"))
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        if self.kind == "json_canon":
+            # PARSE_JSON/TO_JSON canonical form; invalid JSON -> NULL
+            import json as _json
+            try:
+                return _json.dumps(_json.loads(s),
+                                   separators=(",", ":"))
+            except Exception:
+                return ""
+        if self.kind == "strtok":
+            # STRTOK(s[, delim, part]): split on ANY delimiter char,
+            # empty tokens dropped (Snowflake)
+            part = self.params[1] if len(self.params) > 1 else 1
+            toks = self._strtok_tokens(s)
+            return toks[part - 1] if 1 <= part <= len(toks) else ""
+        if self.kind == "check_json":
+            # Snowflake CHECK_JSON: NULL for valid JSON (host_null),
+            # the parse-error description for invalid
+            import json as _json
+            try:
+                _json.loads(s)
+                return ""
+            except Exception as exc:
+                return str(exc)
+        if self.kind == "insert":
+            # INSERT(s, pos, len, repl) (Snowflake)
+            pos, n, repl = self.params
+            i = pos - 1
+            return s[:i] + repl + s[i + n:]
         if self.kind == "ljust":
             n, fill = self.params
             return s.ljust(n, fill)
@@ -313,15 +372,91 @@ class DictMap(Expr):
             return h(s.encode()).hexdigest()
         raise ValueError(self.kind)
 
+    def _strtok_tokens(self, s: str):
+        """STRTOK tokens: split on ANY delimiter char, drop empties; an
+        empty delimiter set means the whole string is one token."""
+        delim = self.params[0] if self.params else " "
+        if not delim:
+            return [s] if s else []
+        return [t_ for t_ in re.split(
+            "|".join(re.escape(c) for c in delim), s) if t_]
+
+    def _re_match(self, s: str):
+        """regexp_substr match honoring (pat, position, occurrence)."""
+        pat = self.params[0]
+        pos = self.params[1] if len(self.params) > 1 else 1
+        occ = self.params[2] if len(self.params) > 2 else 1
+        n = 0
+        for m in re.finditer(pat, s[pos - 1:]):
+            n += 1
+            if n == occ:
+                return m
+        return None
+
     def host_null(self, s: str) -> bool:
         """Whether this transform yields NULL for input `s` (applied by
         the assign_columns host pass; eval-side predicates ignore it)."""
         if self.kind == "regexp_substr":
-            return re.search(self.params[0], s) is None
+            m = self._re_match(s)
+            if m is None:
+                return True
+            grp = self.params[3] if len(self.params) > 3 else 0
+            return m.group(grp) is None
+        if self.kind == "json_extract":
+            return _json_path_get(s, self.params[0]) is None
+        if self.kind == "json_canon":
+            import json as _json
+            try:
+                _json.loads(s)
+                return False
+            except Exception:
+                return True
+        if self.kind == "strtok":
+            part = self.params[1] if len(self.params) > 1 else 1
+            return not (1 <= part <= len(self._strtok_tokens(s)))
+        if self.kind == "check_json":
+            import json as _json
+            try:
+                _json.loads(s)
+                return True   # valid JSON -> NULL (Snowflake CHECK_JSON)
+            except Exception:
+                return False
         if self.kind == "get":
             i = self.params[0]
             return not (-len(s) <= i < len(s))
         return False
+
+
+def _json_path_get(s: str, path: str):
+    """Walk a dotted/bracketed path into a JSON string; None on invalid
+    JSON or a missing step (JSON_EXTRACT_PATH_TEXT / GET_PATH host
+    evaluator; reference: bodosql/kernels/json_array_kernels.py)."""
+    import json as _json
+    try:
+        v = _json.loads(s)
+    except Exception:
+        return None
+    for part in _split_json_path(path):
+        if isinstance(part, int):
+            if not isinstance(v, list) or not (-len(v) <= part < len(v)):
+                return None
+            v = v[part]
+        else:
+            if not isinstance(v, dict) or part not in v:
+                return None
+            v = v[part]
+    return v
+
+
+def _split_json_path(path: str):
+    """'a.b[2].c' / "a['b']" -> ['a', 'b', 2, 'c']."""
+    parts = []
+    for seg in path.replace("]", "").replace("[", ".").split("."):
+        seg = seg.strip().strip("'\"")
+        if not seg:
+            continue
+        parts.append(int(seg) if seg.lstrip("-").isdigit() else seg)
+    return parts
 
 
 @_frozen
@@ -336,6 +471,36 @@ class MathFn(Expr):
     params: Tuple
     operand: Expr
     def key(self): return ("math", self.kind, self.params, self.operand.key())
+
+
+@_frozen
+class ToChar(Expr):
+    """TO_CHAR/TO_VARCHAR of a non-string operand: the operand evaluates
+    on device, values round-trip to host once, and the formatted strings
+    dict-encode like any ingest (reference:
+    bodosql/kernels/casting_array_kernels.py to_char). `fmt` is a
+    Snowflake-style date format ('YYYY-MM-DD' etc., translated to
+    strftime) or None for the canonical numeric/date rendering. Must sit
+    at the top level of a projection (relational.assign_columns builds
+    the new dictionary host-side, same contract as DictMap)."""
+    fmt: Optional[str]
+    operand: Expr
+
+    def key(self):
+        return ("tochar", self.fmt, self.operand.key())
+
+    _FMT = (("YYYY", "%Y"), ("YY", "%y"), ("MMMM", "%B"),
+            ("MON", "%b"), ("MM", "%m"), ("DD", "%d"), ("DY", "%a"),
+            ("HH24", "%H"), ("HH12", "%I"), ("HH", "%H"),
+            ("MI", "%M"), ("SS", "%S"), ("AM", "%p"), ("PM", "%p"))
+
+    def strftime_fmt(self) -> Optional[str]:
+        if self.fmt is None:
+            return None
+        out = self.fmt
+        for sf, py in self._FMT:
+            out = out.replace(sf, py).replace(sf.lower(), py)
+        return out
 
 
 @_frozen
@@ -451,7 +616,34 @@ class StrHostFn(Expr):
                 return 0, False
             return int(d.astype(np.int64)), True
         if self.kind == "regexp_count":
-            return len(re.findall(self.params[0], s)), True
+            pos = self.params[1] if len(self.params) > 1 else 1
+            return len(re.findall(self.params[0], s[pos - 1:])), True
+        if self.kind == "regexp_instr":
+            # (pat[, position, occurrence, option]) -> 1-based match
+            # start (option=0) or one past the end (option=1); 0 = no
+            # match (Snowflake REGEXP_INSTR)
+            pat = self.params[0]
+            pos = self.params[1] if len(self.params) > 1 else 1
+            occ = self.params[2] if len(self.params) > 2 else 1
+            opt = self.params[3] if len(self.params) > 3 else 0
+            n = 0
+            for m in re.finditer(pat, s[pos - 1:]):
+                n += 1
+                if n == occ:
+                    return (m.end() if opt else m.start()) + pos, True
+            return 0, True
+        if self.kind == "editdistance":
+            t_ = self.params[0]
+            cap = self.params[1] if len(self.params) > 1 else None
+            prev = list(range(len(t_) + 1))
+            for i, cs in enumerate(s, 1):
+                cur = [i]
+                for j, ct in enumerate(t_, 1):
+                    cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                                   prev[j - 1] + (cs != ct)))
+                prev = cur
+            d = prev[-1]
+            return (min(d, cap) if cap is not None else d), True
         raise ValueError(self.kind)
 
 
@@ -596,7 +788,7 @@ def infer_dtype(e: Expr, schema: Dict[str, dt.DType]) -> dt.DType:
         return dt.DATE if e.field == "date" else dt.INT64
     if isinstance(e, (IsIn, StrPredicate)):
         return dt.BOOL
-    if isinstance(e, (DictMap, CodeLUT, StrConcat)):
+    if isinstance(e, (DictMap, CodeLUT, StrConcat, ToChar)):
         return dt.STRING
     if isinstance(e, StrToList):
         return dt.list_of(dt.STRING)
@@ -709,7 +901,7 @@ def expr_columns(e: Expr) -> set:
         return {"*"}  # may touch any column — disables pruning above it
     if isinstance(e, (UnOp, Cast, DtField, IsIn, StrPredicate, DictMap,
                       StrLen, MathFn, StrHostFn, CodeLUT, DateTrunc,
-                      StrCodes, StrToList, NestedFn)):
+                      StrCodes, StrToList, NestedFn, ToChar)):
         return expr_columns(e.operand)
     if isinstance(e, Where):
         return (expr_columns(e.cond) | expr_columns(e.iftrue)

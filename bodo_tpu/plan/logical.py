@@ -109,6 +109,38 @@ class FromPandas(Node):
         return ("from_pandas", self._id)
 
 
+class Explode(Node):
+    """LATERAL FLATTEN over a list column: one output row per element,
+    adding `value_name` (element) + `index_name` (0-based position)
+    while keeping every child column; empty/null arrays drop unless
+    `outer` (reference: BodoSQL lateral FLATTEN,
+    BodoSQL/bodosql/kernels/lateral.py, bodo/libs/_lateral.cpp)."""
+
+    def __init__(self, child: Node, column: str, value_name: str,
+                 index_name: str, outer: bool = False):
+        self.children = [child]
+        self.column = column
+        self.value_name = value_name
+        self.index_name = index_name
+        self.outer = outer
+        cdt = child.schema[column]
+        if cdt.kind != "list":
+            raise TypeError(f"FLATTEN input {column!r} is not an array "
+                            f"column ({cdt.name})")
+        sch = dict(child.schema)
+        sch[value_name] = cdt.elem
+        sch[index_name] = dt.INT64
+        self.schema = sch
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def key(self):
+        return ("explode", self.child.key(), self.column,
+                self.value_name, self.index_name, self.outer)
+
+
 class Projection(Node):
     def __init__(self, child: Node, exprs: Sequence[Tuple[str, Expr]]):
         self.children = [child]

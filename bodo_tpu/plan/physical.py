@@ -126,6 +126,12 @@ def _exec_inner(node: L.Node) -> Table:
         else:
             out = nonequi.nl_join_rep(left, right, node.pred, node.how)
         return _maybe_shard(out)
+    if isinstance(node, L.Explode):
+        from bodo_tpu.table import nested as _nested
+        out = _nested.flatten_table(_exec(node.child), node.column,
+                                    node.value_name, node.index_name,
+                                    node.outer)
+        return _maybe_shard(out)
     if isinstance(node, L.Union):
         return _maybe_shard(R.concat_tables(
             [_exec(c) for c in node.children]))

@@ -112,15 +112,17 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     remaps codes."""
     from bodo_tpu.plan.expr import (MAX_CONCAT_DICT, CodeLUT, ColRef,
                                     DictMap, Expr as _Expr, NestedFn,
-                                    StrConcat, StrToList,
+                                    StrConcat, StrToList, ToChar,
                                     eval_expr as _eval)
     dictmaps = {n: e for n, e in new.items() if isinstance(e, DictMap)}
     strcats = {n: e for n, e in new.items() if isinstance(e, StrConcat)}
     strsplits = {n: e for n, e in new.items() if isinstance(e, StrToList)}
     nestedfns = {n: e for n, e in new.items() if isinstance(e, NestedFn)}
+    tochars = {n: e for n, e in new.items() if isinstance(e, ToChar)}
     new = {n: e for n, e in new.items()
            if n not in dictmaps and n not in strcats
-           and n not in strsplits and n not in nestedfns}
+           and n not in strsplits and n not in nestedfns
+           and n not in tochars}
     # a CodeLUT nested under Where/BinOp (e.g. IFF(c, MONTHNAME(d),
     # DAYNAME(d))) would evaluate to raw LUT codes with no dictionary
     # attached — reject loudly rather than decode garbage downstream.
@@ -250,6 +252,63 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
         for i, v in enumerate(uniq):
             dic_obj[i] = v
         dm_cols[n] = Column(codes, valid, dt.list_of(dt.STRING), dic_obj)
+
+    for n, e in tochars.items():
+        # TO_CHAR/TO_VARCHAR: evaluate the operand on device, format on
+        # host once, dict-encode like any string ingest
+        from bodo_tpu.plan.expr import infer_dtype as _infer
+        if _infer(e.operand, _schema(t)) is dt.STRING:
+            # identity on strings (dictionary passes through)
+            vals, data, valid = _str_part(e.operand)
+            mapped = np.array(vals, dtype=str)
+            nd, remap = (np.unique(mapped, return_inverse=True)
+                         if len(mapped)
+                         else (mapped, np.zeros(0, np.int64)))
+            mp = jnp.asarray(remap.astype(np.int32) if len(remap)
+                             else np.zeros(1, np.int32))
+            dm_cols[n] = Column(
+                mp[jnp.clip(data, 0, max(len(vals) - 1, 0))], valid,
+                dt.STRING, nd if len(nd) else np.array([""], str))
+            continue
+        d, v = _eval(e.operand, t.device_data(), _dicts(t), _schema(t))
+        vals = np.asarray(jax.device_get(d))
+        host_v = (np.asarray(jax.device_get(v)) if v is not None
+                  else np.ones(len(vals), bool))
+        src_dt = infer_dtype(e.operand, _schema(t))
+        fmt = e.strftime_fmt()
+        if src_dt is dt.DATETIME or src_dt is dt.DATE:
+            unit = "ns" if src_dt is dt.DATETIME else "D"
+            ts = vals.astype(f"datetime64[{unit}]")
+            import pandas as _pd
+            ser = _pd.Series(ts)
+            out = ser.dt.strftime(
+                fmt or ("%Y-%m-%d" if src_dt is dt.DATE
+                        else "%Y-%m-%d %H:%M:%S.%f")).to_numpy(str)
+        elif dt.is_decimal(src_dt):
+            # decimals store value*10^scale in int64 — format exactly
+            # (integer divmod, no float round-trip)
+            sc = src_dt.scale
+
+            def _fmtd(x):
+                sign = "-" if x < 0 else ""
+                q, rem = divmod(abs(int(x)), 10 ** sc)
+                return f"{sign}{q}.{rem:0{sc}d}" if sc else f"{sign}{q}"
+            out = np.array([_fmtd(x) for x in vals.astype(np.int64)],
+                           dtype=str)
+        elif np.issubdtype(vals.dtype, np.floating):
+            # Snowflake canonical float rendering (repr-shortest)
+            out = np.array([repr(float(x)) for x in vals], dtype=str)
+        elif vals.dtype == np.bool_:
+            out = np.where(vals, "true", "false").astype(str)
+        else:
+            out = vals.astype(np.int64).astype(str)
+        uniq, inv = (np.unique(out, return_inverse=True) if len(out)
+                     else (np.array([], str), np.zeros(0, np.int64)))
+        codes = jnp.asarray(inv.astype(np.int32)
+                            if len(inv) else np.zeros(t.capacity, np.int32))
+        vm = jnp.asarray(host_v) if v is not None else None
+        dm_cols[n] = Column(codes, vm, dt.STRING,
+                            uniq if len(uniq) else np.array([""], str))
 
     for n, e in dictmaps.items():
         # compose nested transforms (upper(substring(...))) down to the

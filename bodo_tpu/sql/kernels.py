@@ -174,10 +174,110 @@ def _nullif(args: List[Expr]) -> Expr:
     return MaskNull(BinOp("==", args[0], args[1]), args[0])
 
 
+def _re_flags(params: str) -> str:
+    """Snowflake regexp parameter string -> inline-flag prefix ('i' case
+    insensitive, 'c' sensitive, 's' dotall, 'm' multiline). When both
+    'c' and 'i' appear, the LAST one wins (Snowflake rule)."""
+    ci = ""
+    for ch in params:
+        if ch in "ci":
+            ci = ch
+    out = "i" if ci == "i" else ""
+    if "s" in params:
+        out += "s"
+    if "m" in params:
+        out += "m"
+    return f"(?{out})" if out else ""
+
+
 def _regexp_like(args: List[Expr]) -> Expr:
-    _nargs(args, 2, 2, "regexp_like")
-    return StrPredicate("fullmatch", (_lit_str(args[1], "pattern"),),
-                        args[0])
+    _nargs(args, 2, 3, "regexp_like")
+    pat = _lit_str(args[1], "pattern")
+    if len(args) > 2:
+        pat = _re_flags(_lit_str(args[2], "parameters")) + pat
+    return StrPredicate("fullmatch", (pat,), args[0])
+
+
+def _regexp_substr(args: List[Expr]) -> Expr:
+    # REGEXP_SUBSTR(s, pat[, position[, occurrence[, params[, group]]]])
+    _nargs(args, 2, 6, "regexp_substr")
+    pat = _lit_str(args[1], "pattern")
+    pos = _lit_int(args[2], "position") if len(args) > 2 else 1
+    occ = _lit_int(args[3], "occurrence") if len(args) > 3 else 1
+    if len(args) > 4:
+        pat = _re_flags(_lit_str(args[4], "parameters")) + pat
+    grp = _lit_int(args[5], "group") if len(args) > 5 else 0
+    return _dictmap("regexp_substr", (pat, pos, occ, grp), args[0])
+
+
+def _regexp_instr(args: List[Expr]) -> Expr:
+    # REGEXP_INSTR(s, pat[, position[, occurrence[, option[, params]]]])
+    _nargs(args, 2, 6, "regexp_instr")
+    pat = _lit_str(args[1], "pattern")
+    pos = _lit_int(args[2], "position") if len(args) > 2 else 1
+    occ = _lit_int(args[3], "occurrence") if len(args) > 3 else 1
+    opt = _lit_int(args[4], "option") if len(args) > 4 else 0
+    if len(args) > 5:
+        pat = _re_flags(_lit_str(args[5], "parameters")) + pat
+    return StrHostFn("regexp_instr", (pat, pos, occ, opt), args[0])
+
+
+def _regexp_count2(args: List[Expr]) -> Expr:
+    _nargs(args, 2, 4, "regexp_count")
+    pat = _lit_str(args[1], "pattern")
+    pos = _lit_int(args[2], "position") if len(args) > 2 else 1
+    if len(args) > 3:
+        pat = _re_flags(_lit_str(args[3], "parameters")) + pat
+    return StrHostFn("regexp_count", (pat, pos), args[0])
+
+
+def _json_extract(args: List[Expr]) -> Expr:
+    _nargs(args, 2, 2, "json_extract_path_text")
+    return _dictmap("json_extract", (_lit_str(args[1], "path"),), args[0])
+
+
+def _parse_json(args: List[Expr]) -> Expr:
+    _nargs(args, 1, 1, "parse_json")
+    return _dictmap("json_canon", (), args[0])
+
+
+def _strtok(args: List[Expr]) -> Expr:
+    _nargs(args, 1, 3, "strtok")
+    delim = _lit_str(args[1], "delimiters") if len(args) > 1 else " "
+    part = _lit_int(args[2], "part") if len(args) > 2 else 1
+    return _dictmap("strtok", (delim, part), args[0])
+
+
+def _insert_fn(args: List[Expr]) -> Expr:
+    _nargs(args, 4, 4, "insert")
+    return _dictmap("insert",
+                    (_lit_int(args[1], "pos"), _lit_int(args[2], "len"),
+                     _lit_str(args[3], "repl")), args[0])
+
+
+def _editdistance(args: List[Expr]) -> Expr:
+    _nargs(args, 2, 3, "editdistance")
+    params = (_lit_str(args[1], "other"),)
+    if len(args) > 2:
+        params += (_lit_int(args[2], "max"),)
+    return StrHostFn("editdistance", params, args[0])
+
+
+def _to_char(args: List[Expr]) -> Expr:
+    from bodo_tpu.plan.expr import ToChar
+    _nargs(args, 1, 2, "to_char")
+    fmt = _lit_str(args[1], "format") if len(args) > 1 else None
+    return ToChar(fmt, args[0])
+
+
+def _space(args: List[Expr]) -> Expr:
+    _nargs(args, 1, 1, "space")
+    return Lit(" " * _lit_int(args[0], "space count"))
+
+
+def _char_fn(args: List[Expr]) -> Expr:
+    _nargs(args, 1, 1, "char")
+    return Lit(chr(_lit_int(args[0], "char code")))
 
 
 def _monthname(args: List[Expr]) -> Expr:
@@ -231,10 +331,15 @@ def _sha2(args: List[Expr]) -> Expr:
 
 
 def _regexp_replace(args: List[Expr]) -> Expr:
-    _nargs(args, 2, 3, "regexp_replace")
+    # REGEXP_REPLACE(s, pat[, repl[, position[, occurrence[, params]]]])
+    _nargs(args, 2, 6, "regexp_replace")
+    pat = _lit_str(args[1], "pattern")
     repl = _lit_str(args[2], "replacement") if len(args) > 2 else ""
-    return _dictmap("regexp_replace",
-                    (_lit_str(args[1], "pattern"), repl), args[0])
+    pos = _lit_int(args[3], "position") if len(args) > 3 else 1
+    occ = _lit_int(args[4], "occurrence") if len(args) > 4 else 0
+    if len(args) > 5:
+        pat = _re_flags(_lit_str(args[5], "parameters")) + pat
+    return _dictmap("regexp_replace", (pat, repl, pos, occ), args[0])
 
 
 REGISTRY: Dict[str, Callable[[List[Expr]], Expr]] = {
@@ -273,10 +378,32 @@ REGISTRY: Dict[str, Callable[[List[Expr]], Expr]] = {
     "regexp_like": _regexp_like,
     "rlike": _regexp_like,
     "regexp_replace": _regexp_replace,
-    "regexp_substr": lambda a: _dictmap(
-        "regexp_substr", (_lit_str(a[1], "pattern"),), a[0]),
-    "regexp_count": lambda a: StrHostFn(
-        "regexp_count", (_lit_str(a[1], "pattern"),), a[0]),
+    "regexp_substr": _regexp_substr,
+    # Spark/Hive signature: REGEXP_EXTRACT(s, pat, group) — arg 3 is a
+    # capture-GROUP index (default 1), not Snowflake's position
+    "regexp_extract": lambda a: _dictmap(
+        "regexp_substr",
+        (_lit_str(a[1], "pattern"), 1, 1,
+         _lit_int(a[2], "group") if len(a) > 2 else 1), a[0]),
+    "regexp_count": _regexp_count2,
+    "regexp_instr": _regexp_instr,
+    # ---- json / variant (reference: bodosql/kernels/
+    # json_array_kernels.py, variant_array_kernels.py) -----------------
+    "json_extract_path_text": _json_extract,
+    "get_json_object": _json_extract,
+    "parse_json": _parse_json,
+    "try_parse_json": _parse_json,
+    "to_json": _parse_json,
+    # CHECK_JSON: NULL for VALID json, parse-error text for invalid
+    "check_json": lambda a: _dictmap("check_json", (), a[0]),
+    # ---- casting (reference: bodosql/kernels/casting_array_kernels.py) --
+    "to_char": _to_char, "to_varchar": _to_char,
+    # ---- string breadth --------------------------------------------------
+    "strtok": _strtok,
+    "insert": _insert_fn,
+    "editdistance": _editdistance,
+    "space": _space,
+    "char": _char_fn, "chr": _char_fn,
     # ---- crypto (reference: bodosql/kernels/crypto_funcs.py) ----
     "md5": _strmap("md5", ""),
     "md5_hex": _strmap("md5", ""),

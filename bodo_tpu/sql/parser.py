@@ -130,6 +130,16 @@ class Case:
 class CastA:
     operand: Any
     to: str
+    try_: bool = False
+
+
+@dataclass
+class FlattenItem:
+    """LATERAL FLATTEN(input => <expr>) [AS] alias — the table function
+    form of array explode (reference: BodoSQL lateral.py FLATTEN)."""
+    input: Any
+    alias: str = "f"
+    outer: bool = False
 
 
 @dataclass
@@ -200,7 +210,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<str>'(?:[^']|'')*')
   | (?P<qid>"[^"]+")
   | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
-  | (?P<op><>|!=|>=|<=|\|\||[=<>+\-*/%(),.;])
+  | (?P<op><>|!=|>=|<=|=>|\|\||[=<>+\-*/%(),.;])
 """, re.VERBOSE)
 
 
@@ -232,7 +242,8 @@ _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "AS", "AND", "OR", "NOT", "IN", "IS", "NULL", "LIKE", "BETWEEN",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "ON",
-    "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "DISTINCT", "EXISTS",
+    "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "TRY_CAST", "DISTINCT",
+    "EXISTS", "LATERAL",
     "ASC", "DESC", "DATE", "INTERVAL", "EXTRACT", "WITH", "UNION", "ALL",
     "SUBSTRING", "FOR", "NULLS", "FIRST", "LAST", "TRUE", "FALSE",
     "OVER", "PARTITION",
@@ -444,6 +455,21 @@ class Parser:
             self.try_kw("AS")
             alias = self.ident()
             return SubSelect(sub, alias)
+        if self.try_kw("LATERAL"):
+            return self._flatten_item()
+        if self.peek()[0] == "id" and \
+                self.peek()[1].upper() in ("FLATTEN", "TABLE") and \
+                self.peek(1) == ("op", "("):
+            if self.peek()[1].upper() == "TABLE":
+                self.i += 1          # TABLE ( FLATTEN (...) ) alias
+                self.eat_op("(")
+                item = self._flatten_item()
+                self.eat_op(")")
+                self.try_kw("AS")
+                if self.peek()[0] == "id":
+                    item.alias = self.ident()
+                return item
+            return self._flatten_item()
         name = self.ident()
         alias = None
         if self.try_kw("AS"):
@@ -452,6 +478,39 @@ class Parser:
             # USING introduces a join-key list, never a table alias
             alias = self.ident()
         return TableRef(name, alias)
+
+    def _flatten_item(self) -> "FlattenItem":
+        """FLATTEN(input => expr [, outer => true|false]) [AS] alias."""
+        nm = self.ident()
+        if nm.upper() != "FLATTEN":
+            raise NotImplementedError(
+                f"LATERAL {nm} (only FLATTEN is supported)")
+        self.eat_op("(")
+        inp = None
+        outer = False
+        while True:
+            t, v = self.peek()
+            if t in ("id", "kw") and v.upper() in ("INPUT", "OUTER") and \
+                    self.peek(1) == ("op", "=>"):
+                key = v.upper()
+                self.i += 2
+                if key == "INPUT":
+                    inp = self.expr()
+                else:
+                    outer = self.eat_kw("TRUE", "FALSE") == "TRUE"
+            else:
+                inp = self.expr()
+            if not self.try_op(","):
+                break
+        self.eat_op(")")
+        if inp is None:
+            raise SyntaxError("FLATTEN requires an input argument")
+        self.try_kw("AS")
+        alias = "f"
+        if self.peek()[0] == "id" and \
+                self.peek()[1].upper() != "USING":
+            alias = self.ident()
+        return FlattenItem(inp, alias, outer)
 
     # -- expressions (precedence climbing) --------------------------------
     def expr(self):
@@ -718,7 +777,8 @@ class Parser:
                 else_ = self.expr()
             self.eat_kw("END")
             return Case(whens, else_)
-        if self.kw("CAST"):
+        if self.kw("CAST", "TRY_CAST"):
+            is_try = self.peek()[1].upper() == "TRY_CAST"
             self.i += 1
             self.eat_op("(")
             e = self.expr()
@@ -729,7 +789,7 @@ class Parser:
                 while not self.try_op(")"):
                     self.i += 1
             self.eat_op(")")
-            return CastA(e, ty.lower())
+            return CastA(e, ty.lower(), is_try)
         if self.kw("EXTRACT"):
             self.i += 1
             self.eat_op("(")

@@ -26,8 +26,8 @@ import numpy as np
 
 from bodo_tpu.plan import logical as L
 from bodo_tpu.plan.expr import (BinOp, Cast, ColRef, DictMap, DtField, Expr,
-                                IsIn, Lit, StrPredicate, UnOp, Where,
-                                infer_dtype)
+                                IsIn, Lit, StrHostFn, StrPredicate, UnOp,
+                                Where, infer_dtype)
 from bodo_tpu.sql import parser as P
 from bodo_tpu.table import dtypes as dt
 
@@ -629,6 +629,64 @@ class Planner:
                 rels.append(item)
         flatten(from_item)
 
+        # LATERAL FLATTEN items apply to the plan built from the other
+        # relations (correlated table function): plan the rest first,
+        # then explode; WHERE runs after the explode so predicates can
+        # reference the flatten output (f.value / f.index)
+        flats = [r for r in rels if isinstance(r, P.FlattenItem)]
+        if flats:
+            rest = [r for r in rels if not isinstance(r, P.FlattenItem)]
+            if not rest:
+                raise NotImplementedError(
+                    "LATERAL FLATTEN requires a base relation")
+            item = rest[0]
+            for r in rest[1:]:
+                item = P.JoinItem(item, r, "cross")
+            # conjuncts that touch a flatten alias (f.value / f.index)
+            # must run AFTER the explode; everything else goes into the
+            # base planning so WHERE-derived equi-joins still form (no
+            # accidental cross products)
+            fl_aliases = {f.alias.lower() for f in flats}
+            fl_cols = {"value", "index"}
+            pre: List = []
+            post: List = []
+
+            def _touches_flatten(e) -> bool:
+                if isinstance(e, P.Col):
+                    return ((e.qualifier or "").lower() in fl_aliases
+                            or (e.qualifier is None
+                                and e.name.lower() in fl_cols))
+                import dataclasses
+                if not dataclasses.is_dataclass(e):
+                    return False
+                return any(
+                    _touches_flatten(x)
+                    for f_ in dataclasses.fields(e)
+                    for v_ in [getattr(e, f_.name)]
+                    for x in (v_ if isinstance(v_, (list, tuple))
+                              else (v_,)))
+
+            def _split_w(e):
+                if isinstance(e, P.BinA) and e.op == "&":
+                    _split_w(e.left)
+                    _split_w(e.right)
+                elif _touches_flatten(e):
+                    post.append(e)
+                else:
+                    pre.append(e)
+            if where is not None:
+                _split_w(where)
+            pre_where = None
+            for cnj in pre:
+                pre_where = cnj if pre_where is None else \
+                    P.BinA("&", pre_where, cnj)
+            plan, scope = self._plan_from_where(item, pre_where, outer)
+            for fl in flats:
+                plan, scope = self._plan_flatten(plan, scope, fl)
+            for cnj in post:
+                plan = self._plan_where(plan, scope, cnj)
+            return plan, scope
+
         planned = [self._from(r, outer) for r in rels]
         if len(planned) == 1:
             plan, scope = planned[0]
@@ -791,6 +849,25 @@ class Planner:
     # ------------------------------------------------------------------
     # WHERE with subquery lowering
     # ------------------------------------------------------------------
+    def _plan_flatten(self, plan: L.Node, scope: Scope,
+                      fl) -> Tuple[L.Node, Scope]:
+        """Apply one LATERAL FLATTEN: explode the input array column and
+        expose <alias>.value / <alias>.index in scope (reference:
+        BodoSQL/bodosql/kernels/lateral.py lateral_flatten)."""
+        if not isinstance(fl.input, P.Col):
+            raise NotImplementedError(
+                "FLATTEN input must be a column reference")
+        flat = self._try_col(fl.input, scope)
+        if flat is None:
+            raise ValueError(f"unknown FLATTEN input {fl.input.name}")
+        tag = self._fresh("fl")
+        vname, iname = f"{tag}__value", f"{tag}__index"
+        plan = L.Explode(plan, flat, vname, iname, fl.outer)
+        scope = scope.merged(Scope())
+        scope.add(fl.alias, "value", vname)
+        scope.add(fl.alias, "index", iname)
+        return plan, scope
+
     def _plan_where(self, plan: L.Node, scope: Scope, where) -> L.Node:
         conjuncts: List = []
 
@@ -1168,30 +1245,40 @@ class Planner:
                   "smallint": dt.INT32, "double": dt.FLOAT64,
                   "float": dt.FLOAT64, "real": dt.FLOAT32,
                   "decimal": dt.FLOAT64, "numeric": dt.FLOAT64,
-                  "varchar": dt.STRING, "date": dt.DATE}.get(e.to)
+                  "varchar": dt.STRING, "text": dt.STRING,
+                  "string": dt.STRING, "date": dt.DATE}.get(e.to)
             if ty is None:
                 raise NotImplementedError(f"CAST to {e.to}")
+            sch = getattr(self, "_cur_schema", None)
+            src_t = None
+            if sch is not None:
+                try:
+                    src_t = infer_dtype(x, sch)
+                except Exception:
+                    src_t = None
             if ty is dt.STRING:
-                # identity ONLY for string-typed operands (the common
-                # CAST(strcol AS varchar) form); numeric→varchar has no
-                # bounded dictionary and stays unsupported
-                sch = getattr(self, "_cur_schema", None)
-                if sch is not None:
-                    try:
-                        src_t = infer_dtype(x, sch)
-                    except Exception:
-                        src_t = None
-                    if src_t is dt.STRING:
-                        return x
-                    if src_t is not None:
-                        raise NotImplementedError(
-                            f"CAST({src_t.name}) to varchar")
+                # string operands pass through; other types format on
+                # host via ToChar (bodosql casting_array_kernels to_char)
+                if src_t is dt.STRING:
+                    return x
                 from bodo_tpu.plan.expr import (CodeLUT as _CL,
-                                                StrConcat as _SC)
+                                                StrConcat as _SC,
+                                                ToChar as _TC)
                 if isinstance(x, (DictMap, _CL, _SC)) or \
                         (isinstance(x, Lit) and isinstance(x.value, str)):
                     return x
-                raise NotImplementedError("CAST to varchar")
+                return _TC(None, x)
+            if src_t is dt.STRING:
+                # string → number/date goes through the host parse LUT;
+                # TRY_CAST semantics (null on failure) come for free,
+                # and plain CAST shares them (no SQL error channel in a
+                # traced kernel — the reference's try-variant behavior)
+                if ty is dt.DATE:
+                    return StrHostFn("to_date", (), x)
+                if ty in (dt.FLOAT64, dt.FLOAT32):
+                    return StrHostFn("to_number", (), x)
+                if ty in (dt.INT64, dt.INT32):
+                    return Cast(StrHostFn("to_number", (), x), ty)
             return Cast(x, ty)
         if isinstance(e, P.Extract):
             return DtField(e.field, self._expr(e.operand, scope))

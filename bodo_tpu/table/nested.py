@@ -274,42 +274,115 @@ def explode_table(t, col_name: str):
     cols = {}
     for n, c in src.columns.items():
         if n == col_name:
-            elem_dt = c.dtype.elem
-            if elem_dt is dt.STRING:
-                from bodo_tpu.table.table import Column as _C
-                isna = np.array([e is None for e in elems], dtype=bool)
-                safe = np.array([e if isinstance(e, str) else ""
-                                 for e in elems], dtype=str)
-                uniq, inv = (np.unique(safe, return_inverse=True)
-                             if total else (np.array([], dtype=str),
-                                            np.zeros(0, np.int64)))
-                data = np.zeros(cap, np.int32)
-                data[:total] = inv.astype(np.int32)
-                vm = None
-                if isna.any():
-                    vmn = np.zeros(cap, bool)
-                    vmn[:total] = ~isna
-                    vm = jnp.asarray(vmn)
-                cols[n] = _C(jnp.asarray(data), vm, dt.STRING, uniq)
-            else:
-                isna = np.array([e is None for e in elems], dtype=bool)
-                data = np.zeros(cap, elem_dt.numpy)
-                data[:total] = [0 if e is None else e for e in elems]
-                vm = None
-                if isna.any():
-                    vmn = np.zeros(cap, bool)
-                    vmn[:total] = ~isna
-                    vm = jnp.asarray(vmn)
-                cols[n] = Column(jnp.asarray(data), vm, elem_dt, None)
+            cols[n] = _elem_column(elems, c.dtype.elem, total, cap)
         else:
-            gather = jnp.asarray(row_idx)
-            data = c.data[gather]
-            data = jnp.concatenate(
-                [data, jnp.zeros((cap - total,), data.dtype)])
-            vm = None
-            if c.valid is not None:
-                vmv = c.valid[gather]
-                vm = jnp.concatenate(
-                    [vmv, jnp.zeros((cap - total,), bool)])
-            cols[n] = Column(data, vm, c.dtype, c.dictionary)
+            cols[n] = _gather_column(c, row_idx, total, cap)
+    return Table(cols, total, REP, None)
+
+
+def _elem_column(elems: List, elem_dt: dt.DType, total: int,
+                 cap: int) -> Column:
+    """Column from a host list of scalar elements (None = null)."""
+    isna = np.array([e is None for e in elems], dtype=bool)
+    if elem_dt is dt.STRING:
+        safe = np.array([e if isinstance(e, str) else ""
+                         for e in elems], dtype=str)
+        uniq, inv = (np.unique(safe, return_inverse=True)
+                     if total else (np.array([], dtype=str),
+                                    np.zeros(0, np.int64)))
+        data = np.zeros(cap, np.int32)
+        data[:total] = inv.astype(np.int32)
+        vm = None
+        if isna.any():
+            vmn = np.zeros(cap, bool)
+            vmn[:total] = ~isna
+            vm = jnp.asarray(vmn)
+        return Column(jnp.asarray(data), vm, dt.STRING, uniq)
+    data = np.zeros(cap, elem_dt.numpy)
+    data[:total] = [0 if e is None else e for e in elems]
+    vm = None
+    if isna.any():
+        vmn = np.zeros(cap, bool)
+        vmn[:total] = ~isna
+        vm = jnp.asarray(vmn)
+    return Column(jnp.asarray(data), vm, elem_dt, None)
+
+
+def _gather_column(c: Column, row_idx: np.ndarray, total: int,
+                   cap: int) -> Column:
+    """Replicate a source column through the explode row gather."""
+    gather = jnp.asarray(row_idx)
+    data = c.data[gather]
+    data = jnp.concatenate(
+        [data, jnp.zeros((cap - total,), data.dtype)])
+    vm = None
+    if c.valid is not None:
+        vmv = c.valid[gather]
+        vm = jnp.concatenate(
+            [vmv, jnp.zeros((cap - total,), bool)])
+    return Column(data, vm, c.dtype, c.dictionary)
+
+
+def flatten_table(t, col_name: str, value_name: str = "value",
+                  index_name: str = "index", outer: bool = False):
+    """LATERAL FLATTEN(input => col): one output row per array element,
+    with VALUE and 0-based INDEX columns added and EVERY source column
+    (including the array) replicated. Rows whose array is empty or null
+    are DROPPED unless `outer`, which emits them once with null
+    value/index (Snowflake FLATTEN semantics; reference:
+    BodoSQL/bodosql/kernels/lateral.py lateral_flatten +
+    bodo/libs/_lateral.cpp)."""
+    import jax
+
+    from bodo_tpu.table.table import REP, Table
+    src = t.gather() if t.distribution != REP else t
+    col = src.columns[col_name]
+    if col.dtype.kind != "list":
+        raise TypeError(f"FLATTEN expects a list column, got "
+                        f"{col.dtype.name}")
+    dic = col.dictionary
+    codes = np.asarray(jax.device_get(col.data))[:src.nrows]
+    codes = np.clip(codes, 0, max(len(dic) - 1, 0))
+    valid = (np.asarray(jax.device_get(col.valid))[:src.nrows]
+             if col.valid is not None else None)
+    lens = np.array([len(v) for v in dic] or [0], dtype=np.int64)
+    reps = lens[codes] if len(dic) else np.zeros(src.nrows, np.int64)
+    if valid is not None:
+        reps = np.where(valid, reps, 0)
+    if outer:
+        filler_src = reps == 0
+        reps = np.maximum(reps, 1)
+    total = int(reps.sum())
+    row_idx = np.repeat(np.arange(src.nrows), reps)
+    within = np.arange(total) - np.repeat(np.cumsum(reps) - reps, reps)
+    # flattened per-dictionary-entry element LUT (empty lists get one
+    # placeholder slot so offsets stay distinct)
+    flat_vals: List = []
+    offs = np.zeros(max(len(dic), 1) + 1, dtype=np.int64)
+    for j, v in enumerate(dic):
+        flat_vals.extend(v if len(v) else [None])
+        offs[j + 1] = len(flat_vals)
+    if not flat_vals:
+        flat_vals = [None]
+        offs[1:] = 1
+    elem_codes = offs[codes][row_idx] + within
+    elems = [flat_vals[int(c_)] for c_ in
+             np.clip(elem_codes, 0, len(flat_vals) - 1)]
+    filler = (filler_src[row_idx] if outer
+              else np.zeros(total, dtype=bool))
+    for i in np.nonzero(filler)[0]:
+        elems[i] = None
+    cap = round_capacity(max(total, 1))
+    cols = {}
+    for n, c in src.columns.items():
+        cols[n] = _gather_column(c, row_idx, total, cap)
+    cols[value_name] = _elem_column(elems, col.dtype.elem, total, cap)
+    idx = np.zeros(cap, np.int64)
+    idx[:total] = np.where(filler, 0, within)
+    ivm = None
+    if filler.any():
+        ivmn = np.zeros(cap, bool)
+        ivmn[:total] = ~filler
+        ivm = jnp.asarray(ivmn)
+    cols[index_name] = Column(jnp.asarray(idx), ivm, dt.INT64, None)
     return Table(cols, total, REP, None)

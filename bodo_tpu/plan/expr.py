@@ -434,9 +434,10 @@ def _json_path_get(s: str, path: str):
     import json as _json
     try:
         v = _json.loads(s)
+        parts = _split_json_path(path)
     except Exception:
         return None
-    for part in _split_json_path(path):
+    for part in parts:
         if isinstance(part, int):
             if not isinstance(v, list) or not (-len(v) <= part < len(v)):
                 return None
@@ -449,13 +450,46 @@ def _json_path_get(s: str, path: str):
 
 
 def _split_json_path(path: str):
-    """'a.b[2].c' / "a['b']" -> ['a', 'b', 2, 'c']."""
-    parts = []
-    for seg in path.replace("]", "").replace("[", ".").split("."):
-        seg = seg.strip().strip("'\"")
-        if not seg:
-            continue
-        parts.append(int(seg) if seg.lstrip("-").isdigit() else seg)
+    """'a.b[2].c' / "a['b']" -> ['a', 'b', 2, 'c']. Quote-aware: a
+    QUOTED segment is always a string key (even '\"2\"', and even when
+    it contains '.' or '['); only bare bracketed integers become list
+    indices. Raises ValueError on malformed paths (unclosed quote or
+    bracket) — callers treat that as no-match."""
+    parts: list = []
+    i, n = 0, len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            i += 1
+        elif c in "'\"":
+            j = path.find(c, i + 1)
+            if j < 0:
+                raise ValueError(f"unclosed quote in path {path!r}")
+            parts.append(path[i + 1:j])
+            i = j + 1
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                raise ValueError(f"unclosed bracket in path {path!r}")
+            seg = path[i + 1:j].strip()
+            if seg[:1] in "'\"":
+                if len(seg) < 2 or seg[-1] != seg[0]:
+                    raise ValueError(f"bad quoted key in path {path!r}")
+                parts.append(seg[1:-1])
+            elif seg.lstrip("-").isdigit():
+                parts.append(int(seg))
+            else:
+                parts.append(seg)
+            i = j + 1
+        else:
+            j = i
+            while j < n and path[j] not in ".[":
+                j += 1
+            seg = path[i:j].strip()
+            if seg:
+                parts.append(int(seg) if seg.lstrip("-").isdigit()
+                             else seg)
+            i = j
     return parts
 
 

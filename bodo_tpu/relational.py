@@ -271,11 +271,18 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
                 dt.STRING, nd if len(nd) else np.array([""], str))
             continue
         d, v = _eval(e.operand, t.device_data(), _dicts(t), _schema(t))
-        vals = np.asarray(jax.device_get(d))
-        host_v = (np.asarray(jax.device_get(v)) if v is not None
-                  else np.ones(len(vals), bool))
+        # format only the LIVE rows: padding would waste host formatting
+        # and inject phantom dictionary entries ('0', '1970-01-01') —
+        # or crash outright on garbage tail values
+        vals = np.asarray(jax.device_get(d))[:t.nrows]
+        host_v = (np.asarray(jax.device_get(v))[:t.nrows]
+                  if v is not None else np.ones(len(vals), bool))
         src_dt = infer_dtype(e.operand, _schema(t))
         fmt = e.strftime_fmt()
+        if fmt is not None and src_dt not in (dt.DATETIME, dt.DATE):
+            raise NotImplementedError(
+                f"TO_CHAR format {e.fmt!r} is only supported for "
+                f"date/datetime operands (got {src_dt.name})")
         if src_dt is dt.DATETIME or src_dt is dt.DATE:
             unit = "ns" if src_dt is dt.DATETIME else "D"
             ts = vals.astype(f"datetime64[{unit}]")
@@ -304,10 +311,14 @@ def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
             out = vals.astype(np.int64).astype(str)
         uniq, inv = (np.unique(out, return_inverse=True) if len(out)
                      else (np.array([], str), np.zeros(0, np.int64)))
-        codes = jnp.asarray(inv.astype(np.int32)
-                            if len(inv) else np.zeros(t.capacity, np.int32))
-        vm = jnp.asarray(host_v) if v is not None else None
-        dm_cols[n] = Column(codes, vm, dt.STRING,
+        cdata = np.zeros(t.capacity, np.int32)
+        cdata[:len(inv)] = inv.astype(np.int32)
+        vm = None
+        if v is not None:
+            vmn = np.zeros(t.capacity, bool)
+            vmn[:len(host_v)] = host_v
+            vm = jnp.asarray(vmn)
+        dm_cols[n] = Column(jnp.asarray(cdata), vm, dt.STRING,
                             uniq if len(uniq) else np.array([""], str))
 
     for n, e in dictmaps.items():

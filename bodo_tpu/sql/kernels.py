@@ -174,6 +174,15 @@ def _nullif(args: List[Expr]) -> Expr:
     return MaskNull(BinOp("==", args[0], args[1]), args[0])
 
 
+def _pos_int(e: Expr, name: str, lo: int = 1) -> int:
+    """Literal int argument with a lower bound (Snowflake raises on
+    position/occurrence < 1 rather than searching a negative slice)."""
+    v = _lit_int(e, name)
+    if v < lo:
+        raise ValueError(f"{name} must be >= {lo}, got {v}")
+    return v
+
+
 def _re_flags(params: str) -> str:
     """Snowflake regexp parameter string -> inline-flag prefix ('i' case
     insensitive, 'c' sensitive, 's' dotall, 'm' multiline). When both
@@ -202,11 +211,11 @@ def _regexp_substr(args: List[Expr]) -> Expr:
     # REGEXP_SUBSTR(s, pat[, position[, occurrence[, params[, group]]]])
     _nargs(args, 2, 6, "regexp_substr")
     pat = _lit_str(args[1], "pattern")
-    pos = _lit_int(args[2], "position") if len(args) > 2 else 1
-    occ = _lit_int(args[3], "occurrence") if len(args) > 3 else 1
+    pos = _pos_int(args[2], "position") if len(args) > 2 else 1
+    occ = _pos_int(args[3], "occurrence") if len(args) > 3 else 1
     if len(args) > 4:
         pat = _re_flags(_lit_str(args[4], "parameters")) + pat
-    grp = _lit_int(args[5], "group") if len(args) > 5 else 0
+    grp = _pos_int(args[5], "group", lo=0) if len(args) > 5 else 0
     return _dictmap("regexp_substr", (pat, pos, occ, grp), args[0])
 
 
@@ -214,8 +223,8 @@ def _regexp_instr(args: List[Expr]) -> Expr:
     # REGEXP_INSTR(s, pat[, position[, occurrence[, option[, params]]]])
     _nargs(args, 2, 6, "regexp_instr")
     pat = _lit_str(args[1], "pattern")
-    pos = _lit_int(args[2], "position") if len(args) > 2 else 1
-    occ = _lit_int(args[3], "occurrence") if len(args) > 3 else 1
+    pos = _pos_int(args[2], "position") if len(args) > 2 else 1
+    occ = _pos_int(args[3], "occurrence") if len(args) > 3 else 1
     opt = _lit_int(args[4], "option") if len(args) > 4 else 0
     if len(args) > 5:
         pat = _re_flags(_lit_str(args[5], "parameters")) + pat
@@ -225,7 +234,7 @@ def _regexp_instr(args: List[Expr]) -> Expr:
 def _regexp_count2(args: List[Expr]) -> Expr:
     _nargs(args, 2, 4, "regexp_count")
     pat = _lit_str(args[1], "pattern")
-    pos = _lit_int(args[2], "position") if len(args) > 2 else 1
+    pos = _pos_int(args[2], "position") if len(args) > 2 else 1
     if len(args) > 3:
         pat = _re_flags(_lit_str(args[3], "parameters")) + pat
     return StrHostFn("regexp_count", (pat, pos), args[0])
@@ -335,8 +344,8 @@ def _regexp_replace(args: List[Expr]) -> Expr:
     _nargs(args, 2, 6, "regexp_replace")
     pat = _lit_str(args[1], "pattern")
     repl = _lit_str(args[2], "replacement") if len(args) > 2 else ""
-    pos = _lit_int(args[3], "position") if len(args) > 3 else 1
-    occ = _lit_int(args[4], "occurrence") if len(args) > 4 else 0
+    pos = _pos_int(args[3], "position") if len(args) > 3 else 1
+    occ = _pos_int(args[4], "occurrence", lo=0) if len(args) > 4 else 0
     if len(args) > 5:
         pat = _re_flags(_lit_str(args[5], "parameters")) + pat
     return _dictmap("regexp_replace", (pat, repl, pos, occ), args[0])

@@ -739,10 +739,15 @@ class Planner:
             if not cs:
                 continue
             p_i, s_i = planned[i]
-            pred = None
-            for c in cs:
-                e = self._expr(c, s_i, None, None)
-                pred = e if pred is None else BinOp("&", pred, e)
+            prev_schema = getattr(self, "_cur_schema", None)
+            self._cur_schema = p_i.schema
+            try:
+                pred = None
+                for c in cs:
+                    e = self._expr(c, s_i, None, None)
+                    pred = e if pred is None else BinOp("&", pred, e)
+            finally:
+                self._cur_schema = prev_schema
             planned[i] = (L.Filter(p_i, pred), s_i)
 
         # greedy cost-based join order (replaces the reference's vendored
@@ -879,16 +884,23 @@ class Planner:
                 conjuncts.append(e)
         split(where)
 
-        plain: Optional[Expr] = None
-        for c in conjuncts:
-            handled, plan = self._try_subquery_conjunct(plan, scope, c)
-            if handled:
-                continue
-            ex = self._expr(c, scope, None, None)
-            plain = ex if plain is None else BinOp("&", plain, ex)
-        if plain is not None:
-            plan = L.Filter(plan, plain)
-        return plan
+        # dtype-sensitive lowering (CAST of string columns etc.) needs
+        # the current plan schema — WHERE runs before _plan_core sets it
+        prev_schema = getattr(self, "_cur_schema", None)
+        self._cur_schema = plan.schema
+        try:
+            plain: Optional[Expr] = None
+            for c in conjuncts:
+                handled, plan = self._try_subquery_conjunct(plan, scope, c)
+                if handled:
+                    continue
+                ex = self._expr(c, scope, None, None)
+                plain = ex if plain is None else BinOp("&", plain, ex)
+            if plain is not None:
+                plan = L.Filter(plan, plain)
+            return plan
+        finally:
+            self._cur_schema = prev_schema
 
     def _try_subquery_conjunct(self, plan, scope, c):
         """Lower IN/EXISTS/scalar-subquery conjuncts to joins.
@@ -1278,7 +1290,11 @@ class Planner:
                 if ty in (dt.FLOAT64, dt.FLOAT32):
                     return StrHostFn("to_number", (), x)
                 if ty in (dt.INT64, dt.INT32):
-                    return Cast(StrHostFn("to_number", (), x), ty)
+                    # Snowflake rounds half away from zero on
+                    # string->integer casts ('99.9' -> 100, not 99)
+                    from bodo_tpu.plan.expr import MathFn
+                    return Cast(MathFn("round", (0,),
+                                       StrHostFn("to_number", (), x)), ty)
             return Cast(x, ty)
         if isinstance(e, P.Extract):
             return DtField(e.field, self._expr(e.operand, scope))

@@ -319,7 +319,16 @@ class Table:
     # ---- distribution ----------------------------------------------------
     def shard(self) -> "Table":
         """REP -> 1D: scatter rows over the mesh data axis
-        (scatterv analogue, reference distributed_api.py:1299)."""
+        (scatterv analogue, reference distributed_api.py:1299).
+
+        Shard i owns global rows [i*per, i*per + counts[i]) — the packed
+        per-shard layout coincides with the source layout, so no
+        per-shard host repack is needed: single-process, the column
+        pads/zero-tails ON DEVICE and `jax.device_put` against the row
+        sharding moves each slice to its device; multi-process (SPMD
+        pods), `jax.make_array_from_callback` materializes only the
+        shards THIS host's devices own — the full table never transits
+        any single host."""
         if self.distribution == ONED:
             return self
         m = mesh_mod.get_mesh()
@@ -329,26 +338,45 @@ class Table:
             [max(0, min(per, self.nrows - i * per)) for i in range(s)],
             dtype=np.int64)
         sharding = mesh_mod.row_sharding(m)
+        target = s * per
+        nrows = self.nrows
+        multi = jax.process_count() > 1
+
+        def _scatter(arr, zero):
+            if multi:
+                host = np.asarray(jax.device_get(arr))
+
+                def cb(idx):
+                    sl = idx[0]
+                    lo = sl.start or 0
+                    hi = sl.stop if sl.stop is not None else target
+                    piece = np.full((hi - lo,) + host.shape[1:],
+                                    zero, host.dtype)
+                    take = min(hi, nrows)
+                    if take > lo:
+                        piece[: take - lo] = host[lo:take]
+                    return piece
+                return jax.make_array_from_callback(
+                    (target,) + host.shape[1:], sharding, cb)
+            d = arr
+            if d.shape[0] < target:
+                pad = jnp.full((target - d.shape[0],) + d.shape[1:],
+                               zero, d.dtype)
+                d = jnp.concatenate([d, pad])
+            elif d.shape[0] > target:
+                d = d[:target]
+            if d.shape[0] > nrows:  # zero the tail (old garbage rows)
+                mask = jnp.arange(target) < nrows
+                d = jnp.where(
+                    mask.reshape((-1,) + (1,) * (d.ndim - 1)), d,
+                    jnp.asarray(zero, d.dtype))
+            return jax.device_put(d, sharding)
+
         new_cols = {}
         for name, col in self.columns.items():
-            host = np.asarray(jax.device_get(col.data))
-            padded = np.zeros((s * per,), dtype=host.dtype)
-            off = 0
-            for i in range(s):  # pack shard i's rows at offset i*per
-                c = int(counts[i])
-                padded[i * per:i * per + c] = host[off:off + c]
-                off += c
-            data = jax.device_put(padded, sharding)
-            valid = None
-            if col.valid is not None:
-                hv = np.asarray(jax.device_get(col.valid))
-                pv = np.zeros((s * per,), dtype=bool)
-                off = 0
-                for i in range(s):
-                    c = int(counts[i])
-                    pv[i * per:i * per + c] = hv[off:off + c]
-                    off += c
-                valid = jax.device_put(pv, sharding)
+            data = _scatter(col.data, 0)
+            valid = (None if col.valid is None
+                     else _scatter(col.valid, False))
             new_cols[name] = Column(data, valid, col.dtype, col.dictionary,
                                     col.vrange)
         return Table(new_cols, self.nrows, ONED, counts)

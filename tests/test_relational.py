@@ -142,3 +142,27 @@ def test_datetime_fields(mesh8):
     np.testing.assert_array_equal(out["h"], ts.hour)
     np.testing.assert_array_equal(out["dow"], ts.dayofweek)
     np.testing.assert_array_equal(out["doy"], ts.dayofyear)
+
+
+def test_shard_no_host_transit(mesh8, monkeypatch):
+    """Single-process shard() must move rows device->device (pad +
+    device_put resharding), never through np/host copies of the column
+    data (round-3/4 review item; reference scatters per-rank,
+    bodo/libs/distributed_api.py:1299)."""
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from bodo_tpu.table.table import Table
+
+    df = pd.DataFrame({"a": np.arange(5000), "b": np.random.rand(5000)})
+    t = Table.from_pandas(df)
+
+    def boom(*a, **k):
+        raise AssertionError("shard() fetched device data to host")
+    monkeypatch.setattr(jax, "device_get", boom)
+    st = t.shard()
+    monkeypatch.undo()
+    assert st.distribution == "1D"
+    pd.testing.assert_frame_equal(st.to_pandas().reset_index(drop=True),
+                                  df, check_dtype=False)

@@ -201,6 +201,38 @@ def test_review_fix_semantics(ctx):
     assert got3.tolist() == exp3.tolist()
 
 
+def test_cast_string_in_where_and_rounding(ctx):
+    c, t = ctx
+    # CAST of a string column inside WHERE must parse values, not codes
+    got = c.sql("select i from t where try_cast(num_s as double) > 50"
+                ).to_pandas()
+    exp = t[pd.to_numeric(t["num_s"], errors="coerce") > 50]["i"]
+    assert sorted(got["i"].tolist()) == sorted(exp.tolist())
+    # string -> integer rounds half away from zero (Snowflake)
+    got2 = _col(c, "select cast(num_s as integer) from t")
+    nums = pd.to_numeric(t["num_s"], errors="coerce")
+    exp2 = np.where(nums.notna(),
+                    np.sign(nums.fillna(0))
+                    * np.floor(np.abs(nums.fillna(0)) + 0.5), np.nan)
+    np.testing.assert_allclose(got2.to_numpy(dtype=float), exp2,
+                               equal_nan=True)
+
+
+def test_json_quoted_numeric_key(mesh8):
+    t = pd.DataFrame({"j": ['{"2": "x", "a.b": "y"}', "not json"]})
+    c = BodoSQLContext({"t": t})
+    got = _col(c, "select json_extract_path_text(j, '\"2\"') from t")
+    assert got.where(got.notna(), None).tolist() == ["x", None]
+    got2 = _col(c, "select json_extract_path_text(j, '\"a.b\"') from t")
+    assert got2.where(got2.notna(), None).tolist() == ["y", None]
+
+
+def test_regexp_position_validation(ctx):
+    c, _t = ctx
+    with pytest.raises(Exception):
+        c.sql("select regexp_substr(s, 'a', 0) from t").to_pandas()
+
+
 def test_to_char_decimal(mesh8):
     t = pd.DataFrame({"p": [1.50, -2.25, 0.05]})
     t["p"] = t["p"].map(lambda x: __import__("decimal").Decimal(

@@ -133,10 +133,12 @@ def check_func(fn: Callable, dfs: Sequence[pd.DataFrame], *,
 
 
 def check_func_spawn(fn: Callable, dfs: Sequence[pd.DataFrame], *,
-                     sort_output: bool = True, rtol: float = 1e-9) -> None:
-    """Run `fn` inside 2 real spawned processes (jax.distributed) and diff
+                     sort_output: bool = True, rtol: float = 1e-9,
+                     n_processes: int = 4) -> None:
+    """Run `fn` inside real spawned processes (jax.distributed) and diff
     rank 0's result against pandas — the reference's multi-process CI
-    shard (`mpiexec -n 3 pytest`)."""
+    shard (`mpiexec -n 3 pytest`). Default 4 ranks so the multi-process
+    paths see a non-trivial process topology, not just pairs."""
     from bodo_tpu.spawn import run_spmd
 
     exp = _normalize(_to_pandas(fn(*[df.copy() for df in dfs])),
@@ -149,6 +151,6 @@ def check_func_spawn(fn: Callable, dfs: Sequence[pd.DataFrame], *,
         out = _fn(*[bd.from_pandas(df.copy()) for df in _dfs])
         return out.to_pandas() if hasattr(out, "to_pandas") else out
 
-    results = run_spmd(worker, n_processes=2)
+    results = run_spmd(worker, n_processes=n_processes)
     got = _normalize(_to_pandas(results[0]), sort_output)
-    _compare(got, exp, rtol, "spawn2")
+    _compare(got, exp, rtol, f"spawn{n_processes}")

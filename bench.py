@@ -263,6 +263,8 @@ def bench_tpch(args):
         os.remove(state_path)  # a completed run must not seed the next
     failed = len(times) - len(ok)
     total_hot = sum(ok)
+    from bodo_tpu.utils import tracing
+    mem = tracing.memory_stats()
     detail = {"orders": args.rows, "queries_ok": len(ok),
               "sqlite_cold_s": round(t_sqlite["cold"], 3),
               "sqlite_hot_s": round(t_sqlite["hot"], 3),
@@ -271,7 +273,11 @@ def bench_tpch(args):
               "device_kind": jax.devices()[0].device_kind,
               "skipped": {str(k): v for k, v in UNSUPPORTED.items()},
               "per_query": {str(k): (None if v is None else round(v, 3))
-                            for k, v in times.items()}}
+                            for k, v in times.items()},
+              "memory": {
+                  "derived_budget_mb": mem["derived_budget_bytes"] >> 20,
+                  "governor_enabled": mem["enabled"],
+                  "n_oom_retries": mem["n_oom_retries"]}}
     value = round(total_hot, 3) if not failed else 0.0
     vs = (round(t_sqlite["hot"] / total_hot, 3)
           if ok and not failed and total_hot > 0 else 0.0)
@@ -468,6 +474,7 @@ def main():
     speedup = t_pandas / t_hot
     from bodo_tpu.ops import pallas_kernels as PK
     scanned = os.path.getsize(pq) + os.path.getsize(csv)
+    mem = tracing.memory_stats()
     detail = {"rows": n_rows, "pandas_s": round(t_pandas, 3),
               "hot_s": round(t_hot, 3), "cold_s": round(t_cold, 3),
               "n_devices": args.mesh,
@@ -475,7 +482,19 @@ def main():
               "device_kind": devs[0].device_kind,
               "scan_mb_per_s": round(scanned / t_hot / 1e6, 1),
               "pallas_traced_into_pipeline": PK.trace_count,
-              "profile_hot": prof}
+              "profile_hot": prof,
+              "memory": {
+                  "derived_budget_mb":
+                      mem["derived_budget_bytes"] >> 20,
+                  "governor_enabled": mem["enabled"],
+                  "n_queued": mem["n_queued"],
+                  "n_oom_retries": mem["n_oom_retries"],
+                  "operators": {
+                      k: {"granted_mb": v["granted"] >> 20,
+                          "peak_mb": v["peak"] >> 20,
+                          "spilled_mb": v["spilled_bytes"] >> 20,
+                          "n_spills": v["n_spills"]}
+                      for k, v in mem["operators"].items()}}}
     if pallas_proof is not None:
         detail["pallas_mxu"] = pallas_proof
     value = round(speedup, 3)

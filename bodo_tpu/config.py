@@ -141,6 +141,25 @@ class Config:
         default_factory=lambda: _env_int(
             "BODO_TPU_STREAM_DEVICE_BUDGET_MB", 0)
     )
+    # Memory governor (runtime/memory_governor.py): derive a real device
+    # budget at mesh init and govern every state-materializing operator
+    # against it — admission control, forced spill mode, OOM-retry.
+    # When stream_device_budget_mb is set it wins (exact legacy
+    # behavior); the governor is the default when nothing is pinned.
+    mem_governor: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_MEM_GOVERNOR", True)
+    )
+    # Fraction of the probed device memory reserved as headroom (XLA
+    # scratch, fragmentation, transient shuffle buffers).
+    mem_headroom_frac: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_MEM_HEADROOM", 0.15)
+    )
+    # Largest slice of the derived budget a single operator may hold as
+    # device-resident state before its grant forces partitioned/spill
+    # mode (the reference's per-operator budget negotiation).
+    mem_op_fraction: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_MEM_OP_FRACTION", 0.5)
+    )
     # Persistent XLA compilation cache directory (the @jit(cache=True)
     # analogue — reference: Numba on-disk JIT cache, caching_tests/).
     # Set to a path to survive process restarts; empty disables. Applied

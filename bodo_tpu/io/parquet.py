@@ -230,8 +230,7 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
         os.makedirs(path, exist_ok=True)
         _clear_part_dir(path)
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("bodo_tpu_pq_write_clean")
+        _global_barrier("bodo_tpu_pq_write_clean")
     per = t.shard_capacity
     # iterate ADDRESSABLE shards only: every process writes exactly the
     # shards it owns, with no cross-process data movement (touching a
@@ -254,6 +253,22 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
         piece = _host_piece(t, data, n)
         pq.write_table(table_to_arrow(piece),
                        os.path.join(path, f"part-{shard:05d}.parquet"))
+
+
+def _global_barrier(name: str) -> None:
+    """Cross-process barrier. Prefers the device collective (overlaps
+    with other device work); falls back to the coordination-service
+    barrier where the backend can't run multiprocess computations
+    (e.g. the CPU backend in older jaxlibs)."""
+    from jax.experimental import multihost_utils
+    try:
+        multihost_utils.sync_global_devices(name)
+    except Exception:
+        from jax._src import distributed
+        client = getattr(distributed.global_state, "client", None)
+        if client is None:
+            raise
+        client.wait_at_barrier(name, 60_000)
 
 
 def _clear_part_dir(path: str) -> None:

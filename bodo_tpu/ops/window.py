@@ -22,6 +22,7 @@ import numpy as np
 from jax import lax
 
 from bodo_tpu.ops import kernels as K
+from bodo_tpu.parallel import collectives
 from bodo_tpu.utils.kernel_cache import bounded_jit
 
 
@@ -80,7 +81,7 @@ def cum_combine(op: str, loc, carry_prefix):
 
 def cum_carry_exscan(op: str, carry, axis: str):
     """Exclusive scan of carries over shards (identity for shard 0)."""
-    n = lax.axis_size(axis)
+    n = collectives.axis_size(axis)
     idx = lax.axis_index(axis)
     gathered = lax.all_gather(carry, axis)          # [S]
     mask = jnp.arange(n) < idx

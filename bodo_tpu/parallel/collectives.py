@@ -25,7 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: shard_map still under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bodo_tpu.config import config
@@ -36,6 +39,15 @@ from bodo_tpu.parallel import mesh as mesh_mod
 # Axis-context collectives (use inside shard_map bodies)
 # --------------------------------------------------------------------------
 
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(ax):
+        # jax < 0.5 has no lax.axis_size; psum of a literal constant
+        # folds to the static axis size inside any bound axis context.
+        return lax.psum(1, ax)
+
+
 def rank(axis: Optional[str] = None):
     """This shard's index along the data axis (MPI_Comm_rank analogue)."""
     return lax.axis_index(axis or config.data_axis)
@@ -43,7 +55,7 @@ def rank(axis: Optional[str] = None):
 
 def size(axis: Optional[str] = None) -> int:
     """Static number of shards along the data axis (MPI_Comm_size analogue)."""
-    return lax.axis_size(axis or config.data_axis)
+    return axis_size(axis or config.data_axis)
 
 
 def dist_sum(x, axis: Optional[str] = None):
@@ -63,7 +75,7 @@ def dist_exscan_sum(x, axis: Optional[str] = None):
     1D_Var offset bookkeeping and dist_cumsum — reference
     bodo/libs/distributed_api.py:664, :2205)."""
     ax = axis or config.data_axis
-    n = lax.axis_size(ax)
+    n = axis_size(ax)
     gathered = lax.all_gather(x, ax)            # [n, ...]
     idx = lax.axis_index(ax)
     mask = (jnp.arange(n) < idx).astype(gathered.dtype)
@@ -94,7 +106,7 @@ def ring_shift(x, shift: int = 1, axis: Optional[str] = None):
     for rolling-window halos (reference bodo/hiframes/rolling.py,
     bodo/libs/parallel_ops.py) — lax.ppermute over the ring."""
     ax = axis or config.data_axis
-    n = lax.axis_size(ax)
+    n = axis_size(ax)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, ax, perm)
 
@@ -161,11 +173,20 @@ def _round_cap(n: int) -> int:
 # shard_map convenience wrapper
 # --------------------------------------------------------------------------
 
+try:  # the replication-check kwarg was renamed check_rep -> check_vma
+    import inspect
+    _SMAP_CHECK_KW = ("check_vma" if "check_vma"
+                      in inspect.signature(shard_map).parameters
+                      else "check_rep")
+except (ValueError, TypeError):  # pragma: no cover - unintrospectable
+    _SMAP_CHECK_KW = "check_vma"
+
+
 def smap(fn, in_specs, out_specs, mesh=None):
     """shard_map over the active mesh with the data axis bound."""
     m = mesh or mesh_mod.get_mesh()
     return shard_map(fn, mesh=m, in_specs=in_specs, out_specs=out_specs,
-                     check_vma=False)
+                     **{_SMAP_CHECK_KW: False})
 
 
 ROW = None  # placeholder; use P(config.data_axis) / P() at call sites

@@ -55,7 +55,7 @@ def _exec(node: L.Node) -> Table:
         return hit
     from bodo_tpu.utils import tracing
     with tracing.event(type(node).__name__) as ev:
-        t = _exec_inner(node)
+        t = _exec_with_oom_retry(node)
         if ev is not None:
             ev["rows"] = t.nrows
     node._cached = t
@@ -63,6 +63,38 @@ def _exec(node: L.Node) -> Table:
         _result_cache.pop(next(iter(_result_cache)))
     _result_cache[key] = t
     return t
+
+
+_MAX_OOM_RETRIES = 3
+
+
+def _exec_with_oom_retry(node: L.Node) -> Table:
+    """OOM-retry envelope at the stage boundary: XLA RESOURCE_EXHAUSTED
+    from a stage turns into (halve the fattest operator grant, spill
+    parked state via the comptroller, re-run the stage) instead of a
+    hard crash. Safe to re-run: child results are memoized on their
+    nodes, so only the failed stage recomputes — under the shrunken
+    grant it takes its partitioned/spill path."""
+    from bodo_tpu.runtime.memory_governor import governor
+    last = None
+    for attempt in range(_MAX_OOM_RETRIES + 1):
+        try:
+            return _exec_inner(node)
+        except Exception as e:  # noqa: BLE001 - filtered by is_oom below
+            gov = governor()
+            if (not config.mem_governor or not gov.is_oom(e)
+                    or attempt == _MAX_OOM_RETRIES):
+                raise
+            last = e
+            from bodo_tpu.utils import tracing
+            with tracing.event("oom_retry", stage=type(node).__name__,
+                               attempt=attempt + 1):
+                if not gov.handle_oom(e):
+                    raise
+            log(1, f"OOM at {type(node).__name__} (attempt "
+                   f"{attempt + 1}): grant halved, parked state "
+                   f"spilled, re-running stage")
+    raise last  # pragma: no cover - loop always returns or raises
 
 
 def apply_projection(t: Table, exprs) -> Table:

@@ -249,6 +249,7 @@ class GroupbyAccumulator:
         self._since_sync = 0
         self._queue: List = []              # dispatched, unmerged partials
         self._template: Optional[Table] = None  # schema source
+        self._grant = None                  # governor admission (lazy)
 
     @property
     def n_state(self) -> int:
@@ -264,6 +265,9 @@ class GroupbyAccumulator:
         nk = len(self.keys)
         if self._template is None:
             self._template = batch
+        if self._grant is None:
+            from bodo_tpu.runtime.memory_governor import governor
+            self._grant = governor().admit("stream_groupby")
         if batch.nrows == 0 and (self.state is not None or self._queue):
             return  # empty batch (selective filter): nothing to merge
         arrays = tuple((batch.column(k).data, batch.column(k).valid)
@@ -347,6 +351,10 @@ class GroupbyAccumulator:
             if tight * 2 <= st.capacity:
                 st = _with_capacity(st, tight)
         self.state = st
+        if self._grant is not None:
+            from bodo_tpu.runtime.memory_governor import \
+                table_device_bytes
+            self._grant.update(table_device_bytes(st))
 
     def _as_state_table(self, batch: Table, pk, pv, ng: int) -> Table:
         cols: Dict[str, Column] = {}
@@ -391,6 +399,8 @@ class GroupbyAccumulator:
         out: Dict[str, Column] = {n: state.columns[n] for n in names[:nk]}
         for oname, col in finals:
             out[oname] = col
+        if self._grant is not None:
+            self._grant.release()
         return Table(out, n_final, REP, None)
 
 
@@ -440,8 +450,10 @@ class MixedGroupbyStream:
         self.rows = None
         if self.acc:
             from bodo_tpu.runtime.comptroller import default_comptroller
+            from bodo_tpu.runtime.memory_governor import governor
             self._comp = default_comptroller()
             self._op = self._comp.register("stream_groupby_acc")
+            self._grant = governor().admit("stream_groupby_acc")
             self.rows = []
             self._acc_cols = list(dict.fromkeys(
                 self.keys + [c for c, _, _ in self.acc]))
@@ -451,10 +463,12 @@ class MixedGroupbyStream:
         for acc in self.nun_accs.values():
             acc.push(batch)
         if self.rows is not None and batch.nrows:
-            part = batch.select(self._acc_cols)
-            self.rows.append(self._comp.park(
-                self._op,
-                _with_capacity(part, _bucket_cap(max(part.nrows, 1)))))
+            from bodo_tpu.runtime.memory_governor import \
+                table_device_bytes
+            part = _with_capacity(batch.select(self._acc_cols),
+                                  _bucket_cap(max(batch.nrows, 1)))
+            self.rows.append(self._comp.park(self._op, part))
+            self._grant.record_spill(table_device_bytes(part))
 
     def finish(self) -> Table:
         base = self.dec.finish()
@@ -467,6 +481,7 @@ class MixedGroupbyStream:
             tables = [p.restore() for p in self.rows]
             self.rows = []
             self._comp.unregister(self._op)
+            self._grant.release()
             if tables:
                 full = R.concat_tables(tables) if len(tables) > 1 \
                     else tables[0]
@@ -499,6 +514,7 @@ class MixedGroupbyStream:
                 p.free()
             self.rows = []
             self._comp.unregister(self._op)
+            self._grant.release()
 
     def _join(self, base: Table, other: Table, fill_zero) -> Table:
         from bodo_tpu.plan.expr import ColRef, Lit, UnOp, Where
@@ -618,22 +634,27 @@ class SortAccumulator:
 
     def __init__(self, by, ascending, na_last: bool):
         from bodo_tpu.runtime.comptroller import default_comptroller
+        from bodo_tpu.runtime.memory_governor import governor
         self._comp = default_comptroller()
         self._op = self._comp.register("stream_sort")
+        self._grant = governor().admit("stream_sort")
         self.by, self.ascending, self.na_last = by, ascending, na_last
         self.parts: List = []
 
     def push(self, batch: Table) -> None:
         if batch.nrows:
-            self.parts.append(self._comp.park(
-                self._op,
-                _with_capacity(batch, _bucket_cap(max(batch.nrows, 1)))))
+            from bodo_tpu.runtime.memory_governor import \
+                table_device_bytes
+            part = _with_capacity(batch, _bucket_cap(max(batch.nrows, 1)))
+            self.parts.append(self._comp.park(self._op, part))
+            self._grant.record_spill(table_device_bytes(part))
 
     def finish(self) -> Table:
         assert self.parts, "empty stream — caller must fall back"
         tables = [p.restore() for p in self.parts]
         self.parts = []
         self._comp.unregister(self._op)
+        self._grant.release()
         t = R.concat_tables(tables) if len(tables) > 1 else tables[0]
         return R.sort_table(t, self.by, self.ascending, self.na_last)
 
@@ -644,6 +665,7 @@ class SortAccumulator:
             p.free()
         self.parts = []
         self._comp.unregister(self._op)
+        self._grant.release()
 
 
 class StreamJoin:
@@ -655,20 +677,25 @@ class StreamJoin:
     def __init__(self, build: Table, left_on, right_on, how, suffixes,
                  null_equal: bool = True):
         from bodo_tpu.runtime.comptroller import default_comptroller
+        from bodo_tpu.runtime.memory_governor import (governor,
+                                                      table_device_bytes)
         self.left_on, self.right_on = left_on, right_on
         self.how, self.suffixes = how, suffixes
         self.null_equal = null_equal
         self._comp = default_comptroller()
         self._op = self._comp.register("stream_join_build")
-        self._off = self._comp.park(
-            self._op,
-            build.gather() if build.distribution != REP else build)
+        b = build.gather() if build.distribution != REP else build
+        self._grant = governor().admit("stream_join_build",
+                                       want=table_device_bytes(b))
+        self._off = self._comp.park(self._op, b)
+        self._grant.record_spill(table_device_bytes(b))
         self._build: Optional[Table] = None
 
     def __call__(self, batch: Table) -> Table:
         if self._build is None:
             self._build = self._off.restore()
             self._comp.unregister(self._op)
+            self._grant.release()
         out = R.join_tables(batch, self._build, self.left_on, self.right_on,
                             self.how, self.suffixes,
                             null_equal=self.null_equal)
@@ -681,6 +708,7 @@ class StreamJoin:
         if self._build is None and not self._off._closed:
             self._off.free()
             self._comp.unregister(self._op)
+            self._grant.release()
 
 
 # ---------------------------------------------------------------------------

@@ -243,6 +243,8 @@ class ShardedGroupbyAccumulator:
         self._template: Optional[Table] = None
         self.peak_state_cap = 0  # observability: max per-shard state rows
         self.n_retries = 0       # observability: overflow replays
+        from bodo_tpu.runtime.memory_governor import governor
+        self._grant = governor().admit("stream_groupby")
 
     # -- schema plumbing ----------------------------------------------------
 
@@ -342,6 +344,8 @@ class ShardedGroupbyAccumulator:
             "ovf": ovf, "out_counts": ng2, "bcap": bcap,
             "recv": min(self.S * self._bucket_cap, self.S * bcap)})
         self.peak_state_cap = max(self.peak_state_cap, self._state_cap)
+        row_bytes = sum(m[1].numpy.itemsize + 1 for m in self._state_meta)
+        self._grant.update(self.S * self._state_cap * row_bytes)
 
     def _resolve_oldest(self) -> None:
         e = self._queue.pop(0)
@@ -460,6 +464,7 @@ class ShardedGroupbyAccumulator:
                 rdt = dt.from_numpy(result_dtype(op, src_dt.numpy))
                 dic = None
             cols[oname] = Column(d, v, rdt, dic)
+        self._grant.release()
         return Table(cols, int(counts.sum()), ONED, counts)
 
 
@@ -594,7 +599,9 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
                           if pj.spilling else ""))
                 return _pjoin_gen(pj, inner)
             if buffered is None:
+                pj.close()
                 return None  # empty build stream
+            pj.close()  # build fit under the broadcast threshold
             log(1, f"streaming join: build streamed over {nbb} batches "
                    f"({buffered.nrows} rows, broadcast)")
             join = ShardedStreamJoin(buffered, node.left_on,
@@ -626,6 +633,7 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
                     return None
                 nbb += 1
             if pj.state is None and not pj.spilling:
+                pj.close()
                 return None
             log(1, f"streaming partitioned join: build state over "
                    f"{nbb} batches")
@@ -671,6 +679,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
             acc.push(b)
             nb += 1
         if acc._template is None:
+            acc._grant.release()
             return None
         out = acc.finish()
         log(1, f"sharded streaming groupby: {nb} batches, "
@@ -689,7 +698,8 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
             if not ss.push(b):
                 return None  # dict drift across batches: whole-table
             nb += 1
-        if ss.state is None:
+        if ss.state is None and not ss.runs:
+            ss.close()
             return None
         out = ss.finish()
         log(1, f"sharded streaming sort: {nb} batches, {out.nrows} rows "
@@ -907,13 +917,21 @@ def _key_membership(p: Table, b: Table, left_on, right_on,
     slot, owner, _r, un1 = HT.claim_slots(bcodes, b_ok, T)
     idx, un2 = HT.probe_slots(bcodes, owner, pcodes, p_ok, T)
     if bool(jax.device_get(un1 | un2)):
-        pl = p.select(list(left_on)).to_pandas()
-        bl = b.select(list(right_on)).to_pandas().drop_duplicates()
-        m = pl.merge(bl, left_on=list(left_on), right_on=list(right_on),
-                     how="left", indicator=True)
-        matched = (m["_merge"] == "both").to_numpy()
-        if not null_equal:
-            matched &= ~pl.isna().any(axis=1).to_numpy()
+        from bodo_tpu.utils import tracing
+        log(1, "stream join drain: membership probe-round exhaustion — "
+               f"falling back to host pandas merge ({p.nrows} probe x "
+               f"{b.nrows} build rows leave the device)")
+        with tracing.event("host_membership_fallback") as ev:
+            pl = p.select(list(left_on)).to_pandas()
+            bl = b.select(list(right_on)).to_pandas().drop_duplicates()
+            m = pl.merge(bl, left_on=list(left_on),
+                         right_on=list(right_on),
+                         how="left", indicator=True)
+            matched = (m["_merge"] == "both").to_numpy()
+            if not null_equal:
+                matched &= ~pl.isna().any(axis=1).to_numpy()
+            if ev is not None:
+                ev["rows"] = p.nrows
         return matched
     return np.asarray(jax.device_get(idx))[:p.nrows] >= 0
 
@@ -944,13 +962,17 @@ class ShardedPartitionedJoin:
         self.mesh = mesh or mesh_mod.get_mesh()
         self.state: Optional[Table] = None
         # larger-than-device build: when the accumulated build state
-        # exceeds the configured device budget, whole state chunks park
+        # exceeds the governed device budget, whole state chunks park
         # into the spillable host pool; probe batches are then deferred
         # (parked too) and drained chunk-against-chunk at the end —
         # device memory stays bounded by ~2 chunks + one join output
         # (reference analogue: JoinPartition build spill + probe-side
-        # chunk replay, bodo/libs/streaming/_join.h:267).
-        self.budget = int(config.stream_device_budget_mb) << 20
+        # chunk replay, bodo/libs/streaming/_join.h:267). The budget is
+        # an admission-control grant from the memory governor (the
+        # legacy stream_device_budget_mb override wins when set).
+        from bodo_tpu.runtime.memory_governor import governor
+        self._grant = governor().admit("stream_join")
+        self.budget = self._grant.budget
         self.build_chunks: List = []    # OffloadedTable (REP row order)
         self.probe_chunks: List = []
         self._pending_probe: Optional[Table] = None
@@ -989,8 +1011,10 @@ class ShardedPartitionedJoin:
                 rk: (sb.column(rk).dtype, sb.column(rk).dictionary)
                 for rk in self.right_on}
         self.state = append_sharded(self.state, sb, self.mesh)
-        if self.budget and _table_device_bytes(self.state) > self.budget:
+        nbytes = _table_device_bytes(self.state)
+        if self._grant.over_budget(nbytes):
             self.build_chunks.append(self._park(self.state))
+            self._grant.record_spill(nbytes)
             self.state = None
         return True
 
@@ -1009,6 +1033,7 @@ class ShardedPartitionedJoin:
         if self._comp is not None:
             self._comp.unregister(self._op)
             self._comp = None
+        self._grant.release()
 
     def _probe_keys_compatible(self, pb: Table) -> None:
         """Fail loudly when probe key columns cannot be compared against
@@ -1054,9 +1079,10 @@ class ShardedPartitionedJoin:
                 self._probe_dicts = _dict_template(b)
             self._pending_probe = append_sharded(self._pending_probe, b,
                                                  self.mesh)
-            if self.budget and _table_device_bytes(
-                    self._pending_probe) > self.budget:
+            nbytes = _table_device_bytes(self._pending_probe)
+            if self._grant.over_budget(nbytes):
                 self.probe_chunks.append(self._park(self._pending_probe))
+                self._grant.record_spill(nbytes)
                 self._pending_probe = None
             return None
         pb = R.shuffle_by_key(b, self.left_on)
@@ -1150,7 +1176,9 @@ class ShardedStreamSort:
         self.mesh = mesh or mesh_mod.get_mesh()
         self.S = mesh_mod.num_shards(self.mesh)
         self.state: Optional[Table] = None
-        self.budget = int(config.stream_device_budget_mb) << 20
+        from bodo_tpu.runtime.memory_governor import governor
+        self._grant = governor().admit("stream_sort")
+        self.budget = self._grant.budget
         self.runs: List[Tuple] = []  # (OffloadedTable, pk np, nbytes)
         self._dicts: Optional[Dict] = None  # survives run parks
         self._comp = None
@@ -1165,7 +1193,7 @@ class ShardedStreamSort:
         if self._dicts is None:
             self._dicts = _dict_template(b)
         self.state = append_sharded(self.state, b, self.mesh)
-        if self.budget and _table_device_bytes(self.state) > self.budget:
+        if self._grant.over_budget(_table_device_bytes(self.state)):
             self._park_run()
         return True
 
@@ -1181,6 +1209,7 @@ class ShardedStreamSort:
         if self._comp is not None:
             self._comp.unregister(self._op)
             self._comp = None
+        self._grant.release()
 
     def _park_run(self) -> None:
         from bodo_tpu.ops.sort import _partition_key
@@ -1199,14 +1228,17 @@ class ShardedStreamSort:
         nbytes = _table_device_bytes(g)
         ot = self._comp.park(self._op, g)
         self.runs.append((ot, pk, nbytes))
+        self._grant.record_spill(nbytes)
         self.state = None
         log(1, f"streaming sort: parked run {len(self.runs)} "
                f"({g.nrows} rows, {nbytes >> 20} MiB)")
 
     def finish(self) -> Table:
         if not self.runs:
-            return R.sort_table(self.state, self.by, self.ascending,
-                                self.na_last)
+            out = R.sort_table(self.state, self.by, self.ascending,
+                               self.na_last)
+            self.close()
+            return out
         if self.state is not None and self.state.nrows > 0:
             self._park_run()
         try:

@@ -103,6 +103,26 @@ def _sig(t: Table) -> Tuple:
 from bodo_tpu.utils.tracing import traced_table_op as _traced
 
 
+def _governed(name):
+    """Reserve governor budget for a whole-table state-materializing
+    operator (admission control; see runtime/memory_governor.py). The
+    reservation sizes from the input tables' device bytes and spans the
+    call; nested operator re-entry is a no-op inside reserve()."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            from bodo_tpu.runtime.memory_governor import (
+                reserve, table_device_bytes)
+            nbytes = sum(table_device_bytes(x) for x in a
+                         if isinstance(x, Table))
+            with reserve(name, nbytes):
+                return fn(*a, **k)
+        return wrapper
+    return deco
+
+
 @_traced
 def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     """Add/replace columns computed from expressions (df.assign analogue).
@@ -640,6 +660,7 @@ def _agg_out_col(src: Column, op: str, vd, vv) -> Column:
 
 
 @_traced
+@_governed("groupby_agg")
 def groupby_agg(t: Table, keys: Sequence[str],
                 aggs: Sequence[Tuple[str, str, str]]) -> Table:
     """Group by `keys`; aggs = [(value_col, op, out_name)].
@@ -1068,6 +1089,7 @@ def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
 # ---------------------------------------------------------------------------
 
 @_traced
+@_governed("sort_table")
 def sort_table(t: Table, by: Sequence[str], ascending=None,
                na_last: bool = True) -> Table:
     by = list(by)
@@ -1121,6 +1143,7 @@ def _suffix_columns(left: Table, right: Table, left_on, right_on,
 
 
 @_traced
+@_governed("join_tables")
 def join_tables(left: Table, right: Table, left_on: Sequence[str],
                 right_on: Sequence[str], how: str = "inner",
                 suffixes=("_x", "_y"), null_equal: bool = True) -> Table:
@@ -1611,7 +1634,7 @@ def _join_sharded(left, right, left_on, right_on, how, suffixes,
             break
         # exact per-shard counts, then one final right-sized run
         cfn_key = ("join_count", _mesh_key(m), nk, how, sig_key,
-                   null_equal, method)
+                   null_equal, method, broadcast)
         cfn = _jit_cache.get(cfn_key)
         if cfn is None:
             ax = config.data_axis

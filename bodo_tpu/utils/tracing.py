@@ -64,8 +64,11 @@ def reset() -> None:
 
 
 def dump(path: Optional[str] = None) -> str:
-    """Write chrome-trace JSON (load in chrome://tracing / Perfetto)."""
-    out = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    """Write chrome-trace JSON (load in chrome://tracing / Perfetto).
+    Includes a `memory` section with the governor's derived budget and
+    per-operator granted/peak/spilled bytes."""
+    out = {"traceEvents": list(_events), "displayTimeUnit": "ms",
+           "memory": memory_stats()}
     text = json.dumps(out)
     if path:
         with open(path, "w") as f:
@@ -73,9 +76,25 @@ def dump(path: Optional[str] = None) -> str:
     return text
 
 
+def memory_stats() -> dict:
+    """Memory-governor snapshot (derived budget + per-operator bytes)."""
+    from bodo_tpu.runtime.memory_governor import governor
+    return governor().stats()
+
+
 def profile() -> Dict[str, dict]:
-    """Per-operator aggregate metrics (query-profile-collector analogue)."""
-    return {k: dict(v) for k, v in _agg.items()}
+    """Per-operator aggregate metrics (query-profile-collector analogue).
+    Operators the memory governor tracked additionally carry
+    granted/peak/spilled bytes under a `mem:<operator>` key."""
+    out = {k: dict(v) for k, v in _agg.items()}
+    for name, m in memory_stats().get("operators", {}).items():
+        out[f"mem:{name}"] = {
+            "count": m.get("count", 0), "total_s": 0.0, "max_s": 0.0,
+            "rows": 0, "granted_bytes": m.get("granted", 0),
+            "peak_bytes": m.get("peak", 0),
+            "spilled_bytes": m.get("spilled_bytes", 0),
+            "n_spills": m.get("n_spills", 0)}
+    return out
 
 
 _op_depth = threading.local()

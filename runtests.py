@@ -1,17 +1,22 @@
 #!/usr/bin/env python
-"""Run the test suite one-subprocess-per-module.
+"""Run the test suite in a few grouped subprocesses.
 
 XLA:CPU's JIT compiler segfaults after pinning thousands of distinct
 compiled kernels in one process; the engine bounds its own caches
 (utils/kernel_cache.py), but a single-process run of the FULL suite
 still accumulates every module's distinct shapes at once. The reference
-engine contains the same class of leak per test module by running each
-module in its own subprocess (reference: bodo/runtests.py:58-100 —
-"Run each test file in a separate process to avoid out-of-memory issues
-in CI"); this is the same harness, pytest-native.
+engine contains the same class of leak by running test files in
+separate processes (reference: bodo/runtests.py:58-100 — "Run each test
+file in a separate process to avoid out-of-memory issues in CI").
+
+One subprocess per module (53 processes) re-pays jax import + kernel
+compile per module and pushes the suite past 20 minutes; a handful of
+grouped subprocesses keeps the per-process kernel count bounded while
+amortizing startup. test_tpch.py stays isolated: it compiles the widest
+kernel set (22 queries) and is the likeliest segfault source.
 
 Usage:
-    python runtests.py              # whole suite, one proc per module
+    python runtests.py              # whole suite, grouped subprocesses
     python runtests.py -k pattern   # forwarded to pytest
     python runtests.py tests/test_sql.py tests/test_groupby.py
 """
@@ -26,6 +31,28 @@ import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
+# Modules that run alone: widest kernel sets / heaviest compile load.
+_ISOLATED = ("test_tpch.py",)
+_N_GROUPS = 4
+
+
+def _group_modules(modules: list[str]) -> list[list[str]]:
+    """Split modules into ~_N_GROUPS similar-sized groups (round-robin
+    over a size-sorted list balances compile-heavy modules), with
+    _ISOLATED modules each in their own group."""
+    iso, rest = [], []
+    for m in modules:
+        (iso if os.path.basename(m) in _ISOLATED else rest).append(m)
+    groups: list[list[str]] = [[m] for m in iso]
+    if rest:
+        n = min(_N_GROUPS, len(rest))
+        buckets: list[list[str]] = [[] for _ in range(n)]
+        by_size = sorted(rest, key=lambda m: -os.path.getsize(m))
+        for i, m in enumerate(by_size):
+            buckets[i % n].append(m)
+        groups.extend(sorted(b) for b in buckets)
+    return groups
+
 
 def main(argv: list[str]) -> int:
     # a non-flag arg is a test module only if it points at a file; other
@@ -36,16 +63,18 @@ def main(argv: list[str]) -> int:
     if not modules:
         modules = sorted(glob.glob(os.path.join(_REPO, "tests",
                                                 "test_*.py")))
+    groups = _group_modules(modules)
     t0 = time.time()
     failed: list[str] = []
     total = 0
-    for i, mod in enumerate(modules):
-        name = os.path.relpath(mod, _REPO)
-        print(f"[{i + 1}/{len(modules)}] {name} ... ",
-              end="", flush=True)
+    for i, group in enumerate(groups):
+        names = " ".join(os.path.relpath(m, _REPO) for m in group)
+        label = names if len(group) == 1 else \
+            f"{len(group)} modules ({names})"
+        print(f"[{i + 1}/{len(groups)}] {label} ... ", end="", flush=True)
         t1 = time.time()
         r = subprocess.run(
-            [sys.executable, "-m", "pytest", mod, "-q", "--no-header",
+            [sys.executable, "-m", "pytest", *group, "-q", "--no-header",
              *passthrough],
             cwd=_REPO, capture_output=True, text=True)
         dt = time.time() - t1
@@ -61,14 +90,14 @@ def main(argv: list[str]) -> int:
         if r.returncode == 5:  # no tests collected (e.g. -k filter)
             continue
         if r.returncode != 0:
-            failed.append(name)
+            failed.append(names)
             sys.stdout.write(r.stdout[-4000:] + r.stderr[-2000:] + "\n")
     dt = time.time() - t0
     if failed:
-        print(f"\nFAILED modules ({len(failed)}/{len(modules)}): "
-              f"{' '.join(failed)}  [{dt:.0f}s]")
+        print(f"\nFAILED groups ({len(failed)}/{len(groups)}): "
+              f"{' | '.join(failed)}  [{dt:.0f}s]")
         return 1
-    print(f"\nall {len(modules)} modules green, {total} tests "
+    print(f"\nall {len(groups)} groups green, {total} tests "
           f"[{dt:.0f}s]")
     return 0
 

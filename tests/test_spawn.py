@@ -17,12 +17,12 @@ def test_run_spmd_psum():
         import numpy as np
         devs = np.array(jax.devices())
         mesh = Mesh(devs, ("d",))
-        from jax import shard_map
+        from bodo_tpu.parallel.collectives import smap
 
         def body(x):
             return jax.lax.psum(x, "d")
-        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("d"),
-                              out_specs=P("d"), check_vma=False))
+        f = jax.jit(smap(body, in_specs=P("d"), out_specs=P("d"),
+                         mesh=mesh))
         n = len(devs)
         import jax.numpy as jnp
         x = jnp.arange(n, dtype=jnp.float64).reshape(n, 1)

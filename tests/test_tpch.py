@@ -34,7 +34,12 @@ def _normalize(df: pd.DataFrame, has_order: bool) -> pd.DataFrame:
         elif out[c].dtype.kind == "f":
             out[c] = np.round(out[c].astype(float), 4)
         elif out[c].dtype == object:
-            out[c] = out[c].astype(str)
+            if out[c].isna().all():
+                # sqlite returns all-NULL aggregates as object None;
+                # treat as float NaN so the numeric compare applies
+                out[c] = out[c].astype("float64")
+            else:
+                out[c] = out[c].astype(str)
     if not has_order:
         out = out.sort_values(list(out.columns)).reset_index(drop=True)
     return out.reset_index(drop=True)

@@ -81,25 +81,48 @@ def _recall(name: str, max_age_h: float = 24.0):
         return None
 
 
+def _resilience():
+    """Load runtime/resilience.py standalone (stdlib-only — no bodo_tpu
+    or jax import, which must wait until after the probe picks a
+    backend), registered under its package name so the later
+    `import bodo_tpu` resolves to THIS instance and the probe's retry
+    counters land in the same stats the bench JSON embeds."""
+    name = "bodo_tpu.runtime.resilience"
+    mod = sys.modules.get(name)
+    if mod is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            name,
+            os.path.join(_REPO, "bodo_tpu", "runtime", "resilience.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
 def _probe_accelerator(timeout_s: int = 75, attempts: int = 6,
                        backoff_s: int = 45):
     """Fight for the accelerator backend: probe in a subprocess (so a
-    hanging device tunnel can't wedge the benchmark itself), retrying
-    with backoff — the TPU tunnel here is flaky and a single failed
-    probe must not convert a transient outage into a CPU-only round.
+    hanging device tunnel can't wedge the benchmark itself) under the
+    shared retry/backoff envelope (runtime/resilience.py) — the TPU
+    tunnel here is flaky and a single failed probe must not convert a
+    transient outage into a CPU-only round.
 
     The probe itself is cheap (device enumeration + a 128x128 matmul);
     the timeout only bounds a hung backend init. Overridable via
     BODO_TPU_BENCH_PROBE_TIMEOUT / _ATTEMPTS / _BACKOFF.
 
-    Returns {"platform": ..., "device_kind": ..., "n": ...} on success,
-    else None."""
+    Returns (result, probe_info): result is {"platform": ...,
+    "device_kind": ..., "n": ...} on success else None; probe_info
+    always records attempts / total probe seconds / outcome so a
+    degraded artifact is self-describing."""
     timeout_s = int(os.environ.get("BODO_TPU_BENCH_PROBE_TIMEOUT",
                                    timeout_s))
     attempts = int(os.environ.get("BODO_TPU_BENCH_PROBE_ATTEMPTS",
                                   attempts))
     backoff_s = int(os.environ.get("BODO_TPU_BENCH_PROBE_BACKOFF",
                                    backoff_s))
+    resil = _resilience()
     probe_src = (
         "import jax, json; d = jax.devices(); "
         "assert d and d[0].platform != 'cpu', d; "
@@ -107,26 +130,45 @@ def _probe_accelerator(timeout_s: int = 75, attempts: int = 6,
         "x = jnp.ones((128, 128)); (x @ x).block_until_ready(); "
         "print(json.dumps({'platform': d[0].platform, "
         "'device_kind': d[0].device_kind, 'n': len(d)}))")
-    for i in range(attempts):
-        if i:
-            print(f"accelerator probe retry {i + 1}/{attempts} "
-                  f"in {backoff_s}s ...", file=sys.stderr)
-            time.sleep(backoff_s)
+    info = {"attempted": True, "ok": False, "attempts": 0,
+            "total_s": 0.0, "timeout_s": timeout_s,
+            "max_attempts": attempts}
+
+    def _once():
+        info["attempts"] += 1
         try:
             r = subprocess.run([sys.executable, "-c", probe_src],
                                timeout=timeout_s, capture_output=True,
                                text=True)
-            if r.returncode == 0:
-                return json.loads(r.stdout.strip().splitlines()[-1])
-            print(f"accelerator probe failed (rc={r.returncode}): "
-                  f"{r.stderr.strip()[-300:]}", file=sys.stderr)
         except subprocess.TimeoutExpired:
-            print(f"accelerator probe timed out after {timeout_s}s",
-                  file=sys.stderr)
-        except Exception as e:  # unparseable probe stdout etc. — retry
-            print(f"accelerator probe error: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-    return None
+            raise RuntimeError(
+                f"accelerator probe timed out after {timeout_s}s")
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"accelerator probe failed (rc={r.returncode}): "
+                f"{r.stderr.strip()[-300:]}")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    t0 = time.monotonic()
+    try:
+        out = resil.retry_call(
+            _once, label="accelerator_probe",
+            policy=resil.RetryPolicy(
+                max_attempts=attempts, base_s=backoff_s, factor=1.0,
+                max_backoff_s=backoff_s,
+                deadline_s=attempts * (timeout_s + backoff_s)),
+            # every probe failure (timeout, bad rc, unparseable stdout)
+            # is worth retrying — the tunnel comes and goes
+            classify=lambda e: "accelerator")
+        info["ok"] = True
+        return out, info
+    except Exception as e:
+        print(f"accelerator probe gave up: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        info["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        return None, info
+    finally:
+        info["total_s"] = round(time.monotonic() - t0, 2)
 
 
 # peak dense f32 TFLOP/s per TPU generation (public specs; one chip).
@@ -277,7 +319,9 @@ def bench_tpch(args):
               "memory": {
                   "derived_budget_mb": mem["derived_budget_bytes"] >> 20,
                   "governor_enabled": mem["enabled"],
-                  "n_oom_retries": mem["n_oom_retries"]}}
+                  "n_oom_retries": mem["n_oom_retries"]},
+              "probe": getattr(args, "probe", {"attempted": False}),
+              "resilience": tracing.resilience_stats()}
     value = round(total_hot, 3) if not failed else 0.0
     vs = (round(t_sqlite["hot"] / total_hot, 3)
           if ok and not failed and total_hot > 0 else 0.0)
@@ -291,6 +335,7 @@ def bench_tpch(args):
         # tunnel down at driver time: report a FRESH recorded on-TPU
         # run with provenance rather than zeroing the round; live CPU
         # numbers stay in detail
+        detail["degraded"] = "accelerator_unavailable"
         rec = _recall(f"tpu_tpch_{args.rows}.json")
         if rec and rec.get("orders") == args.rows:
             detail["live_cpu"] = {"total_hot_s": value, "vs_sqlite": vs}
@@ -354,15 +399,19 @@ def main():
 
     use_cpu = args.cpu
     accel = None
+    probe = {"attempted": False}
     if not use_cpu:
-        accel = _probe_accelerator()
+        accel, probe = _probe_accelerator()
         if accel is None:
             print("ACCELERATOR UNAVAILABLE after retries — falling back "
                   "to CPU mesh (this is a degraded, CPU-only artifact)",
                   file=sys.stderr)
             use_cpu = True
         else:
-            print(f"accelerator up: {accel}", file=sys.stderr)
+            print(f"accelerator up: {accel} "
+                  f"(attempt {probe['attempts']}, {probe['total_s']}s)",
+                  file=sys.stderr)
+    args.probe = probe
     if use_cpu:
         if args.mesh is None:
             args.mesh = 1  # fastest CPU config: 1-device mesh, no shuffles
@@ -494,7 +543,9 @@ def main():
                           "peak_mb": v["peak"] >> 20,
                           "spilled_mb": v["spilled_bytes"] >> 20,
                           "n_spills": v["n_spills"]}
-                      for k, v in mem["operators"].items()}}}
+                      for k, v in mem["operators"].items()}},
+              "probe": getattr(args, "probe", {"attempted": False}),
+              "resilience": tracing.resilience_stats()}
     if pallas_proof is not None:
         detail["pallas_mxu"] = pallas_proof
     value = round(speedup, 3)
@@ -510,7 +561,7 @@ def main():
         # on-hardware run, report it (with provenance) instead of
         # zeroing the round to a CPU artifact; the live CPU numbers
         # stay in detail for transparency.
-        detail["degraded"] = "accelerator unavailable; CPU-only live run"
+        detail["degraded"] = "accelerator_unavailable"
         if rec and rec.get("rows") == n_rows:
             detail["live_cpu"] = {"hot_s": round(t_hot, 3),
                                   "speedup": value}

@@ -171,6 +171,49 @@ class Config:
     sql_plan_cache_dir: str = field(
         default_factory=lambda: _env_str("BODO_TPU_SQL_PLAN_CACHE_DIR", "")
     )
+    # -- resilience (runtime/resilience.py) ----------------------------------
+    # Armed fault-injection spec (see resilience module docstring for the
+    # grammar, e.g. "io.read=raise:OSError,collective=raise:Internal:1:0").
+    # set_config(faults=...) arms in-process AND exports BODO_TPU_FAULTS
+    # so spawned workers inherit the same chaos.
+    faults: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_FAULTS", "")
+    )
+    # Retry envelope: attempts / base backoff / overall deadline for
+    # transient errors (coordination-service init, filesystem flake,
+    # RESOURCE_EXHAUSTED outside the stage envelope).
+    retry_attempts: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_RETRY_ATTEMPTS", 3)
+    )
+    retry_base_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_RETRY_BASE_S", 0.05)
+    )
+    retry_deadline_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_RETRY_DEADLINE_S",
+                                           30.0)
+    )
+    # Graceful degradation: when a sharded collective fails with a
+    # non-OOM internal error, re-execute the stage replicated (gather
+    # inputs, run the REP kernel path) instead of failing the query.
+    degrade_replicated: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_DEGRADE_REPLICATED",
+                                          True)
+    )
+    # Spawn supervision: worker heartbeat cadence and the staleness
+    # window after which a silent-but-alive rank is declared hung.
+    spawn_hb_interval_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_SPAWN_HB_INTERVAL",
+                                           0.5)
+    )
+    spawn_hb_timeout_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_SPAWN_HB_TIMEOUT",
+                                           15.0)
+    )
+    # Gang-level retries of run_spmd when ALL failing ranks look
+    # transient (coordination-service init flake).
+    spawn_gang_retries: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_SPAWN_GANG_RETRIES", 1)
+    )
 
 
 config = Config()
@@ -183,6 +226,15 @@ def set_config(**kwargs) -> None:
         if k not in valid:
             raise ValueError(f"unknown config key: {k}")
         setattr(config, k, v)
+        if k == "faults":
+            # arm in-process AND export to the environment so spawned
+            # workers (which copy os.environ) inherit the same chaos
+            from bodo_tpu.runtime import resilience
+            resilience.arm(v or "")
+            if v:
+                os.environ["BODO_TPU_FAULTS"] = v
+            else:
+                os.environ.pop("BODO_TPU_FAULTS", None)
         if k == "compile_cache_dir" and v:
             # jax reads this lazily per compilation — a runtime override
             # takes effect for subsequent compiles

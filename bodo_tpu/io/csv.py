@@ -14,6 +14,7 @@ import pyarrow as pa
 import pyarrow.csv as pacsv
 
 from bodo_tpu.io.arrow_bridge import arrow_to_table
+from bodo_tpu.runtime import resilience
 from bodo_tpu.table.table import Table
 
 
@@ -26,13 +27,15 @@ def read_csv(path: str, columns: Optional[Sequence[str]] = None,
     convert = {}
     if parse_dates:
         convert = {c: pa.timestamp("ns") for c in parse_dates}
-    at = pacsv.read_csv(
-        path,
-        convert_options=pacsv.ConvertOptions(
-            column_types=convert,
-            include_columns=list(columns) if columns else None,
+    at = resilience.retry_call(
+        lambda: pacsv.read_csv(
+            path,
+            convert_options=pacsv.ConvertOptions(
+                column_types=convert,
+                include_columns=list(columns) if columns else None,
+            ),
         ),
-    )
+        label="read_csv", point="io.read")
     t = arrow_to_table(at)
     _attach_host_ranges(t, at)
     return t
@@ -125,14 +128,17 @@ def iter_csv_arrow(path: str, columns: Optional[Sequence[str]] = None,
     pinned = False
     with open(path, "rb") as f:
         for s, e in zip(bounds, bounds[1:]):
-            f.seek(s)
-            buf = f.read(e - s)
-            at = pacsv.read_csv(
-                _io.BytesIO(header + buf),
-                convert_options=pacsv.ConvertOptions(
-                    column_types=dict(column_types),
-                    include_columns=list(columns) if columns else None,
-                ))
+            def _parse_chunk(s=s, e=e):
+                f.seek(s)
+                buf = f.read(e - s)
+                return pacsv.read_csv(
+                    _io.BytesIO(header + buf),
+                    convert_options=pacsv.ConvertOptions(
+                        column_types=dict(column_types),
+                        include_columns=list(columns) if columns else None,
+                    ))
+            at = resilience.retry_call(_parse_chunk, label="read_csv_chunk",
+                                       point="io.read")
             if not pinned:
                 for fld in at.schema:
                     column_types.setdefault(fld.name, fld.type)

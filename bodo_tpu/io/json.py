@@ -14,11 +14,13 @@ from typing import Optional, Sequence
 import pyarrow.json as pajson
 
 from bodo_tpu.io.arrow_bridge import arrow_to_table
+from bodo_tpu.runtime import resilience
 from bodo_tpu.table.table import Table
 
 
 def read_json(path: str, columns: Optional[Sequence[str]] = None) -> Table:
-    at = pajson.read_json(path)
+    at = resilience.retry_call(lambda: pajson.read_json(path),
+                               label="read_json", point="io.read")
     if columns:
         at = at.select(list(columns))
     return arrow_to_table(at)
@@ -39,11 +41,14 @@ def iter_json_arrow(path: str, columns: Optional[Sequence[str]] = None,
     schema = None
     with open(path, "rb") as f:
         for s, e in zip(bounds, bounds[1:]):
-            f.seek(s)
-            buf = f.read(e - s)
-            po = (pajson.ParseOptions(explicit_schema=schema)
-                  if schema is not None else pajson.ParseOptions())
-            at = pajson.read_json(_io.BytesIO(buf), parse_options=po)
+            def _parse_chunk(s=s, e=e):
+                f.seek(s)
+                buf = f.read(e - s)
+                po = (pajson.ParseOptions(explicit_schema=schema)
+                      if schema is not None else pajson.ParseOptions())
+                return pajson.read_json(_io.BytesIO(buf), parse_options=po)
+            at = resilience.retry_call(_parse_chunk, label="read_json_chunk",
+                                       point="io.read")
             if schema is None:
                 schema = at.schema
             if columns:

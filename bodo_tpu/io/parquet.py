@@ -20,6 +20,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from bodo_tpu.io.arrow_bridge import arrow_to_table, table_to_arrow
+from bodo_tpu.runtime import resilience
 from bodo_tpu.table.table import Table
 
 
@@ -149,8 +150,16 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
     """Read parquet into a replicated Table (caller shards over the mesh).
 
     In a multi-host launch, each process reads only its contiguous
-    stripe of row groups.
+    stripe of row groups. Filesystem flakes (transient OSErrors, armed
+    `io.read` faults) are absorbed by the shared retry envelope.
     """
+    return resilience.retry_call(
+        lambda: _read_parquet_once(path, columns, process_index,
+                                   process_count),
+        label="read_parquet", point="io.read")
+
+
+def _read_parquet_once(path, columns, process_index, process_count) -> Table:
     import jax
     pi = process_index if process_index is not None else jax.process_index()
     pc_ = process_count if process_count is not None else jax.process_count()
@@ -215,7 +224,9 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
         if os.path.isdir(path):
             _clear_part_dir(path)  # prior sharded write left a directory
             os.rmdir(path)
-        pq.write_table(table_to_arrow(t), path)
+        at = table_to_arrow(t)
+        resilience.retry_call(lambda: pq.write_table(at, path),
+                              label="write_parquet", point="io.write")
         return
     import jax
 
@@ -251,8 +262,10 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
         data = local[shard]
         n = int(t.counts[shard])
         piece = _host_piece(t, data, n)
-        pq.write_table(table_to_arrow(piece),
-                       os.path.join(path, f"part-{shard:05d}.parquet"))
+        at = table_to_arrow(piece)
+        dest = os.path.join(path, f"part-{shard:05d}.parquet")
+        resilience.retry_call(lambda: pq.write_table(at, dest),
+                              label="write_parquet", point="io.write")
 
 
 def _global_barrier(name: str) -> None:
@@ -324,7 +337,9 @@ class StreamingParquetWriter:
                 _clear_part_dir(self._path)
                 os.rmdir(self._path)
             self._writer = pq.ParquetWriter(self._path, at.schema)
-        self._writer.write_table(at)
+        resilience.retry_call(lambda: self._writer.write_table(at),
+                              label="stream_write_parquet",
+                              point="io.write")
 
     def close(self) -> None:
         if self._writer is not None:

@@ -9,6 +9,7 @@ node (plan collapse) and in a session-level cache keyed by plan identity.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict
 
 import numpy as np
@@ -18,12 +19,17 @@ from bodo_tpu.config import config
 from bodo_tpu.parallel import mesh as mesh_mod
 from bodo_tpu.plan import logical as L
 from bodo_tpu.plan.optimizer import optimize
+from bodo_tpu.runtime import resilience
 from bodo_tpu.table.table import ONED, REP, Table
 from bodo_tpu.utils.logging import log
 
 # session-level result cache: plan key -> Table
 _result_cache: Dict = {}
 _result_cache_limit = 64
+
+# graceful-degradation state for the executing thread: while a stage is
+# being re-run replicated, _maybe_shard must not re-shard its sources
+_degrade_tls = threading.local()
 
 
 def execute(node: L.Node, optimize_first: bool = True) -> Table:
@@ -39,6 +45,8 @@ def _maybe_shard(t: Table) -> Table:
     small ones replicated so joins against them broadcast instead of
     shuffling (the reference's broadcast-join size heuristic)."""
     if t.distribution == ONED:
+        return t
+    if getattr(_degrade_tls, "force_rep", False):
         return t
     if t.nrows >= config.shard_min_rows and mesh_mod.num_shards() > 1:
         return t.shard()
@@ -69,21 +77,31 @@ _MAX_OOM_RETRIES = 3
 
 
 def _exec_with_oom_retry(node: L.Node) -> Table:
-    """OOM-retry envelope at the stage boundary: XLA RESOURCE_EXHAUSTED
-    from a stage turns into (halve the fattest operator grant, spill
-    parked state via the comptroller, re-run the stage) instead of a
-    hard crash. Safe to re-run: child results are memoized on their
-    nodes, so only the failed stage recomputes — under the shrunken
-    grant it takes its partitioned/spill path."""
+    """Stage-boundary recovery envelope, two legs:
+
+    OOM retry — XLA RESOURCE_EXHAUSTED from a stage turns into (halve
+    the fattest operator grant, spill parked state via the comptroller,
+    re-run the stage) instead of a hard crash. Safe to re-run: child
+    results are memoized on their nodes, so only the failed stage
+    recomputes — under the shrunken grant it takes its partitioned/
+    spill path.
+
+    Graceful degradation — a sharded collective failing with a non-OOM
+    internal error (or an armed `collective` fault) re-executes the
+    stage replicated: materialized 1D inputs are gathered, sources stay
+    REP for the re-run, and the REP kernel paths need no collectives."""
     from bodo_tpu.runtime.memory_governor import governor
     last = None
     for attempt in range(_MAX_OOM_RETRIES + 1):
         try:
             return _exec_inner(node)
-        except Exception as e:  # noqa: BLE001 - filtered by is_oom below
+        except Exception as e:  # noqa: BLE001 - classified below
             gov = governor()
             if (not config.mem_governor or not gov.is_oom(e)
                     or attempt == _MAX_OOM_RETRIES):
+                out = _try_degrade(node, e)
+                if out is not None:
+                    return out
                 raise
             last = e
             from bodo_tpu.utils import tracing
@@ -95,6 +113,40 @@ def _exec_with_oom_retry(node: L.Node) -> Table:
                    f"{attempt + 1}): grant halved, parked state "
                    f"spilled, re-running stage")
     raise last  # pragma: no cover - loop always returns or raises
+
+
+def _try_degrade(node: L.Node, err: Exception):
+    """Re-execute a stage replicated after a sharded-collective failure.
+
+    Returns the replicated result, or None when degradation does not
+    apply (disabled, error not collective-shaped, already inside a
+    degraded re-run) or when the replicated re-run itself fails — the
+    caller then raises the ORIGINAL error. The innermost failing stage
+    degrades first; its replicated result feeds parent stages normally."""
+    if not config.degrade_replicated or \
+            getattr(_degrade_tls, "force_rep", False):
+        return None
+    if not resilience.is_degradable(err):
+        return None
+    stage = type(node).__name__
+    # pull this stage's materialized 1D inputs back to one replicated
+    # copy; un-materialized children re-execute under force_rep below
+    for c in node.children:
+        if c._cached is not None and c._cached.distribution == ONED:
+            c._cached = c._cached.gather()
+    from bodo_tpu.utils import tracing
+    _degrade_tls.force_rep = True
+    try:
+        with tracing.event("degrade_replicated", stage=stage):
+            out = _exec_inner(node)
+    except Exception:  # noqa: BLE001 - degraded re-run failed too
+        return None
+    finally:
+        _degrade_tls.force_rep = False
+    resilience.count_degradation(stage)
+    log(1, f"collective failure at {stage}: re-executed replicated "
+           f"({type(err).__name__})")
+    return out
 
 
 def apply_projection(t: Table, exprs) -> Table:
@@ -112,6 +164,7 @@ def apply_projection(t: Table, exprs) -> Table:
 
 
 def _exec_inner(node: L.Node) -> Table:
+    resilience.maybe_inject("stage.boundary")
     if config.stream_exec and isinstance(node, (L.Aggregate, L.Reduce,
                                                 L.Sort)):
         from bodo_tpu.plan import streaming

@@ -831,6 +831,8 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
     supports it; None → caller falls back to whole-table execution."""
     if not config.stream_exec:
         return None
+    from bodo_tpu.runtime.resilience import maybe_inject
+    maybe_inject("stage.boundary")
     if mesh_mod.num_shards() > 1:
         from bodo_tpu.plan.streaming_sharded import \
             try_stream_execute_sharded

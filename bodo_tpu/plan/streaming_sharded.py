@@ -655,6 +655,8 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
     from bodo_tpu.plan import logical as L
     if not config.stream_exec:
         return None
+    from bodo_tpu.runtime.resilience import maybe_inject
+    maybe_inject("stage.boundary")
     m = mesh_mod.get_mesh()
     if mesh_mod.num_shards(m) <= 1:
         return None

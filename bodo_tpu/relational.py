@@ -123,6 +123,18 @@ def _governed(name):
     return deco
 
 
+def _inject_collective(*tables: Table) -> None:
+    """Host-level `collective` fault point at the sharded-op dispatchers.
+
+    The hooks inside parallel/collectives.py fire at trace time only
+    (kernels are cached), so chaos tests arm THIS point: it fires once
+    per distributed groupby/sort/join call when any input is ONED."""
+    if any(isinstance(x, Table) and x.distribution == ONED
+           and x.num_shards > 1 for x in tables):
+        from bodo_tpu.runtime.resilience import maybe_inject
+        maybe_inject("collective")
+
+
 @_traced
 def assign_columns(t: Table, new: Dict[str, Expr]) -> Table:
     """Add/replace columns computed from expressions (df.assign analogue).
@@ -671,6 +683,7 @@ def groupby_agg(t: Table, keys: Sequence[str],
     multi-operand lexicographic sort and the shuffle moves one key
     column (the reference gets a similar effect from its categorical/
     sorted-key exscan strategies, bodo/libs/groupby/)."""
+    _inject_collective(t)
     keys = list(keys)
     # normalize op aliases: median/quantile_<q> → the "q:<q>" kernel op
     def _norm(op: str) -> str:
@@ -1092,6 +1105,7 @@ def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
 @_governed("sort_table")
 def sort_table(t: Table, by: Sequence[str], ascending=None,
                na_last: bool = True) -> Table:
+    _inject_collective(t)
     by = list(by)
     local = _as_local(t)
     if local is not None:
@@ -1153,6 +1167,7 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
     _nested_loop_join_impl.cpp for cross). null_equal=True gives pandas
     merge semantics (NaN keys match each other); SQL passes False (null
     keys never match, the reference's is_na_equal=false join mode)."""
+    _inject_collective(left, right)
     left_on, right_on = list(left_on), list(right_on)
     assert how in ("inner", "left", "right", "outer", "cross"), \
         f"join how={how} not supported"

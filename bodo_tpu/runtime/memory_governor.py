@@ -277,9 +277,10 @@ class MemoryGovernor:
 
     @staticmethod
     def is_oom(exc: BaseException) -> bool:
-        msg = f"{type(exc).__name__}: {exc}"
-        return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                or "out of memory" in msg)
+        # taxonomy lives in the resilience layer so injected
+        # RESOURCE_EXHAUSTED faults and real XLA OOMs classify the same
+        from bodo_tpu.runtime.resilience import is_resource_exhausted
+        return is_resource_exhausted(exc)
 
     def handle_oom(self, exc: BaseException) -> bool:
         """Shrink the fattest active grant and spill parked state so a

@@ -14,6 +14,17 @@ gathers the per-process return values — the analogue of
 :383). Used for testing the multi-host path without hardware; production
 pods set JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID per
 host and call bodo_tpu.init_runtime() instead.
+
+SUPERVISION (runtime/resilience.py integration): every worker loads the
+resilience module standalone BEFORE importing jax — so an armed
+`spawn.worker_start` kill/raise fires in ~0.2s — then starts a heartbeat
+file the parent watches. The parent waits on the whole gang concurrently
+against one shared deadline and fast-fails the moment any rank dies
+(non-zero exit) or goes silent past the heartbeat window, killing the
+rest of the gang immediately and raising a structured `SpawnError` with
+per-rank diagnostics. When every failing rank's stderr classifies as
+transient (coordination-service init flake), the gang is retried once
+(BODO_TPU_SPAWN_GANG_RETRIES).
 """
 
 from __future__ import annotations
@@ -24,31 +35,100 @@ import socket
 import subprocess
 import sys
 import tempfile
-from typing import Callable, List
+import time
+from typing import Callable, Dict, List, Optional
 
 import cloudpickle
+
+from bodo_tpu.runtime import resilience
 
 _WORKER_CODE = r"""
 import os, pickle, sys
-import cloudpickle
+
+
+def _load_resilience():
+    # standalone load by file path: no bodo_tpu package import, no jax —
+    # an armed spawn.worker_start fault fires before any heavy import
+    path = os.environ.get("BODO_TPU_RESIL_PATH")
+    if not path:
+        return None
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bodo_tpu_resilience_boot", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 
 def main():
     payload_path, out_path = sys.argv[1], sys.argv[2]
+    resil = _load_resilience()
+    if resil is not None:
+        resil.maybe_inject("spawn.worker_start")
+        hb = os.environ.get("BODO_TPU_HB_PATH")
+        if hb:
+            resil.start_heartbeat(hb)
+    import cloudpickle
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address=os.environ["BODO_TPU_COORD"],
-        num_processes=int(os.environ["BODO_TPU_NPROCS"]),
-        process_id=int(os.environ["BODO_TPU_PROC_ID"]),
-    )
+
+    def _init():
+        jax.distributed.initialize(
+            coordinator_address=os.environ["BODO_TPU_COORD"],
+            num_processes=int(os.environ["BODO_TPU_NPROCS"]),
+            process_id=int(os.environ["BODO_TPU_PROC_ID"]),
+        )
+
+    def _reset(exc, attempt):
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+
+    if resil is not None:
+        resil.retry_call(_init, label="jax_distributed_init",
+                         on_retry=_reset)
+    else:
+        _init()
     with open(payload_path, "rb") as f:
         fn = cloudpickle.load(f)
     result = fn(jax.process_index())
-    with open(out_path, "wb") as f:
+    with open(out_path + ".tmp", "wb") as f:
         pickle.dump(result, f)
+    os.replace(out_path + ".tmp", out_path)
+
 
 main()
 """
+
+_POLL_S = 0.05
+_STDERR_TAIL = 800
+
+
+class SpawnError(RuntimeError):
+    """A gang launch failed. `ranks` maps every rank to a diagnostic
+    dict: state ("ok" / "dead" / "hung" / "timeout" / "killed"),
+    returncode, and a stderr tail for ranks that failed. `reason` is the
+    gang-level failure ("worker death", "hung worker", "gang timeout");
+    `transient` is True when every failing rank's stderr classified as a
+    transient flake (the caller may gang-retry)."""
+
+    def __init__(self, reason: str, ranks: Dict[int, dict],
+                 transient: bool = False):
+        self.reason = reason
+        self.ranks = ranks
+        self.transient = transient
+        lines = [f"spawn gang failed ({reason}):"]
+        for i in sorted(ranks):
+            d = ranks[i]
+            line = f"  rank {i}: {d['state']}"
+            if d.get("returncode") is not None:
+                line += f" rc={d['returncode']}"
+            lines.append(line)
+            tail = d.get("stderr")
+            if tail:
+                lines.append("    " + tail.replace("\n", "\n    "))
+        super().__init__("\n".join(lines))
 
 
 def _free_port() -> int:
@@ -57,11 +137,45 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _hb_age(path: str, now: float, fallback_age: float) -> float:
+    """Seconds since the worker's last heartbeat; until the first beat
+    lands the age is measured from gang start (startup grace)."""
+    try:
+        return max(0.0, now - os.path.getmtime(path))
+    except OSError:
+        return fallback_age
+
+
 def run_spmd(fn: Callable[[int], object], n_processes: int = 2,
              timeout: float = 180.0) -> List[object]:
     """Run `fn(process_index)` across n freshly spawned processes joined
     into one jax.distributed cluster. Returns per-process results in rank
-    order. Exceptions in any worker surface with its stderr attached."""
+    order. On failure raises a structured `SpawnError` (per-rank state +
+    stderr) as soon as the first rank dies or goes silent — not after
+    the full timeout — and gang-retries once when every failure looks
+    like a transient coordination flake."""
+    retries = int(resilience._cfg("spawn_gang_retries",
+                                  "BODO_TPU_SPAWN_GANG_RETRIES", 1, int))
+    attempt = 0
+    while True:
+        try:
+            return _run_gang(fn, n_processes, timeout)
+        except SpawnError as e:
+            if attempt >= retries or not e.transient:
+                raise
+            attempt += 1
+            resilience.count_gang_retry()
+            sys.stderr.write(
+                f"bodo_tpu.spawn: gang attempt {attempt} failed with a "
+                f"transient error ({e.reason}); retrying\n")
+
+
+def _run_gang(fn: Callable[[int], object], n_processes: int,
+              timeout: float) -> List[object]:
+    hb_timeout = resilience._cfg("spawn_hb_timeout_s",
+                                 "BODO_TPU_SPAWN_HB_TIMEOUT", 15.0, float)
+    resil_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "runtime", "resilience.py")
     with tempfile.TemporaryDirectory(prefix="bodo_tpu_spawn_") as d:
         payload = os.path.join(d, "fn.pkl")
         with open(payload, "wb") as f:
@@ -70,43 +184,125 @@ def run_spmd(fn: Callable[[int], object], n_processes: int = 2,
         with open(worker_py, "w") as f:
             f.write(_WORKER_CODE)
         coord = f"127.0.0.1:{_free_port()}"
-        procs = []
-        outs = []
-        for i in range(n_processes):
-            out_path = os.path.join(d, f"out_{i}.pkl")
-            outs.append(out_path)
-            env = dict(os.environ)
-            # workers must import this package (cloudpickle references it
-            # by module), wherever the parent had it on its path
-            pkg_root = os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))
-            env.update({
-                "BODO_TPU_COORD": coord,
-                "BODO_TPU_NPROCS": str(n_processes),
-                "BODO_TPU_PROC_ID": str(i),
-                "JAX_PLATFORMS": "cpu",
-                "PYTHONPATH": pkg_root + os.pathsep +
-                env.get("PYTHONPATH", ""),
-            })
-            procs.append(subprocess.Popen(
-                [sys.executable, worker_py, payload, out_path],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-        results = []
-        errs = []
-        for i, p in enumerate(procs):
-            try:
-                _, err = p.communicate(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                _, err = p.communicate()
-                errs.append(f"rank {i}: timeout\n{err.decode()[-800:]}")
-                continue
-            if p.returncode != 0:
-                errs.append(f"rank {i} rc={p.returncode}:\n"
-                            f"{err.decode()[-800:]}")
-        if errs:
-            raise RuntimeError("spawn workers failed:\n" + "\n".join(errs))
-        for out_path in outs:
-            with open(out_path, "rb") as f:
-                results.append(pickle.load(f))
-        return results
+        # workers must import this package (cloudpickle references it
+        # by module), wherever the parent had it on its path
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        procs: List[subprocess.Popen] = []
+        outs: List[str] = []
+        err_paths: List[str] = []
+        hb_paths: List[str] = []
+        handles = []
+        start = time.monotonic()
+        try:
+            for i in range(n_processes):
+                out_path = os.path.join(d, f"out_{i}.pkl")
+                err_path = os.path.join(d, f"err_{i}.log")
+                hb_path = os.path.join(d, f"hb_{i}")
+                outs.append(out_path)
+                err_paths.append(err_path)
+                hb_paths.append(hb_path)
+                env = dict(os.environ)
+                env.update({
+                    "BODO_TPU_COORD": coord,
+                    "BODO_TPU_NPROCS": str(n_processes),
+                    "BODO_TPU_PROC_ID": str(i),
+                    "BODO_TPU_RESIL_PATH": resil_path,
+                    "BODO_TPU_HB_PATH": hb_path,
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": pkg_root + os.pathsep +
+                    env.get("PYTHONPATH", ""),
+                })
+                # stderr goes to a file, not a pipe: the parent polls
+                # instead of blocking in communicate(), and a chatty
+                # worker can never deadlock on a full pipe buffer
+                ef = open(err_path, "wb")
+                of = open(os.path.join(d, f"stdout_{i}.log"), "wb")
+                handles += [ef, of]
+                procs.append(subprocess.Popen(
+                    [sys.executable, worker_py, payload, out_path],
+                    env=env, stdout=of, stderr=ef))
+            reason, failing = _supervise(procs, hb_paths, start, timeout,
+                                         hb_timeout)
+            if reason is None:
+                results = []
+                for i, out_path in enumerate(outs):
+                    if not os.path.exists(out_path):
+                        reason, failing = "missing result", {i}
+                        break
+                else:
+                    for out_path in outs:
+                        with open(out_path, "rb") as f:
+                            results.append(pickle.load(f))
+                    return results
+            # fast-fail: tear down the rest of the gang NOW
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+            ranks: Dict[int, dict] = {}
+            transient = bool(failing)
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if i in failing:
+                    state = ("hung" if reason == "hung worker" else
+                             "timeout" if reason == "gang timeout" else
+                             "dead")
+                elif rc == 0:
+                    state = "ok"
+                else:
+                    state = "killed"  # collateral of the gang teardown
+                diag = {"state": state, "returncode": rc}
+                if state != "ok":
+                    try:
+                        with open(err_paths[i], "rb") as f:
+                            tail = f.read()[-_STDERR_TAIL:].decode(
+                                "utf-8", "replace").strip()
+                    except OSError:
+                        tail = ""
+                    diag["stderr"] = tail
+                    if i in failing:
+                        diag["transient"] = \
+                            resilience.classify_transient_text(tail)
+                        if reason != "worker death" or \
+                                not diag["transient"]:
+                            transient = False
+                ranks[i] = diag
+            raise SpawnError(reason, ranks, transient=transient)
+        finally:
+            for p in procs:
+                if p.poll() is None:  # pragma: no cover - safety net
+                    p.kill()
+            for h in handles:
+                h.close()
+
+
+def _supervise(procs, hb_paths, start, timeout, hb_timeout):
+    """Wait on all ranks concurrently against one shared deadline.
+    Returns (None, set()) when every rank exited 0, else
+    (reason, failing_rank_set) at the FIRST failure — a dead rank is
+    noticed within one poll interval, not after earlier ranks finish."""
+    deadline = start + timeout
+    while True:
+        now = time.monotonic()
+        rcs = [p.poll() for p in procs]
+        dead = {i for i, rc in enumerate(rcs) if rc not in (None, 0)}
+        if dead:
+            return "worker death", dead
+        if all(rc == 0 for rc in rcs):
+            return None, set()
+        hung = set()
+        for i, rc in enumerate(rcs):
+            if rc is None and _hb_age(hb_paths[i], now,
+                                      now - start) > hb_timeout:
+                hung.add(i)
+        if hung:
+            return "hung worker", hung
+        if now >= deadline:
+            return "gang timeout", {i for i, rc in enumerate(rcs)
+                                    if rc is None}
+        time.sleep(_POLL_S)

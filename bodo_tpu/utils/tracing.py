@@ -66,9 +66,10 @@ def reset() -> None:
 def dump(path: Optional[str] = None) -> str:
     """Write chrome-trace JSON (load in chrome://tracing / Perfetto).
     Includes a `memory` section with the governor's derived budget and
-    per-operator granted/peak/spilled bytes."""
+    per-operator granted/peak/spilled bytes, and a `resilience` section
+    with fault/retry/degradation counters."""
     out = {"traceEvents": list(_events), "displayTimeUnit": "ms",
-           "memory": memory_stats()}
+           "memory": memory_stats(), "resilience": resilience_stats()}
     text = json.dumps(out)
     if path:
         with open(path, "w") as f:
@@ -82,10 +83,18 @@ def memory_stats() -> dict:
     return governor().stats()
 
 
+def resilience_stats() -> dict:
+    """Fault-injection / retry / degradation counter snapshot."""
+    from bodo_tpu.runtime import resilience
+    return resilience.stats()
+
+
 def profile() -> Dict[str, dict]:
     """Per-operator aggregate metrics (query-profile-collector analogue).
     Operators the memory governor tracked additionally carry
-    granted/peak/spilled bytes under a `mem:<operator>` key."""
+    granted/peak/spilled bytes under a `mem:<operator>` key; resilience
+    counters (fired faults, retries, degraded stages, gang retries)
+    appear under `resil:<counter>` keys."""
     out = {k: dict(v) for k, v in _agg.items()}
     for name, m in memory_stats().get("operators", {}).items():
         out[f"mem:{name}"] = {
@@ -94,6 +103,20 @@ def profile() -> Dict[str, dict]:
             "peak_bytes": m.get("peak", 0),
             "spilled_bytes": m.get("spilled_bytes", 0),
             "n_spills": m.get("n_spills", 0)}
+    rs = resilience_stats()
+    counters = {}
+    for point, n in rs.get("faults_fired", {}).items():
+        counters[f"resil:fault:{point}"] = n
+    for label, n in rs.get("retries", {}).items():
+        counters[f"resil:retry:{label}"] = n
+    for stage, n in rs.get("degraded_stages", {}).items():
+        counters[f"resil:degraded:{stage}"] = n
+    if rs.get("gang_retries"):
+        counters["resil:gang_retries"] = rs["gang_retries"]
+    for key, n in counters.items():
+        if n:
+            out[key] = {"count": int(n), "total_s": 0.0, "max_s": 0.0,
+                        "rows": 0}
     return out
 
 

@@ -15,6 +15,12 @@ grouped subprocesses keeps the per-process kernel count bounded while
 amortizing startup. test_tpch.py stays isolated: it compiles the widest
 kernel set (22 queries) and is the likeliest segfault source.
 
+Each group runs under a watchdog (BODO_TPU_TEST_TIMEOUT seconds,
+default 900): the child installs faulthandler.dump_traceback_later so a
+hung module dumps every thread's stack to stderr BEFORE the parent's
+kill lands, and the kill is reported as TIMEOUT(module) instead of a
+bare non-zero rc.
+
 Usage:
     python runtests.py              # whole suite, grouped subprocesses
     python runtests.py -k pattern   # forwarded to pytest
@@ -34,6 +40,13 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # Modules that run alone: widest kernel sets / heaviest compile load.
 _ISOLATED = ("test_tpch.py",)
 _N_GROUPS = 4
+
+# Per-group watchdog. pytest's builtin faulthandler plugin installs
+# faulthandler.dump_traceback_later per test (against the REAL stderr
+# fd, immune to output capture), so a wedged test dumps every thread's
+# stack before the parent's kill lands at the group deadline.
+_WATCHDOG_S = float(os.environ.get("BODO_TPU_TEST_TIMEOUT", "1200"))
+_DUMP_S = _WATCHDOG_S * 0.8  # dump fires comfortably before the kill
 
 
 def _group_modules(modules: list[str]) -> list[list[str]]:
@@ -73,10 +86,26 @@ def main(argv: list[str]) -> int:
             f"{len(group)} modules ({names})"
         print(f"[{i + 1}/{len(groups)}] {label} ... ", end="", flush=True)
         t1 = time.time()
-        r = subprocess.run(
-            [sys.executable, "-m", "pytest", *group, "-q", "--no-header",
-             *passthrough],
-            cwd=_REPO, capture_output=True, text=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "pytest", *group, "-q",
+                 "--no-header",
+                 "-o", f"faulthandler_timeout={_DUMP_S:.0f}",
+                 *passthrough],
+                cwd=_REPO, capture_output=True, text=True,
+                timeout=_WATCHDOG_S)
+        except subprocess.TimeoutExpired as e:
+            dt = time.time() - t1
+            print(f"TIMEOUT after {dt:.0f}s")
+            failed.append(f"TIMEOUT({names})")
+            # the faulthandler dump (all thread stacks at the watchdog
+            # deadline) is in the captured stderr — surface it
+            for s in (e.stdout, e.stderr):
+                if s:
+                    if isinstance(s, bytes):
+                        s = s.decode("utf-8", "replace")
+                    sys.stdout.write(s[-6000:] + "\n")
+            continue
         dt = time.time() - t1
         tail = (r.stdout.strip().splitlines() or [""])[-1]
         print(f"{tail}  ({dt:.0f}s)")

@@ -111,13 +111,16 @@ def test_join_spills_under_derived_budget(mesh8, fresh_gov, tiny_floor):
     assert ops["stream_join"]["n_spills"] >= 1, ops
 
 
-def test_oom_retry_reruns_stage(mesh8, fresh_gov, monkeypatch):
+def test_oom_retry_reruns_stage(mesh8, fresh_gov):
     """Acceptance: a RESOURCE_EXHAUSTED from a pipeline stage is caught
     at the stage boundary, the fattest grant is halved, and the stage
-    re-runs to completion (exercised via the probe test hook)."""
+    re-runs to completion (injected through the resilience fault
+    registry — the same `stage.boundary` point chaos runs arm via
+    BODO_TPU_FAULTS, replacing the old _exec_inner monkeypatch)."""
     import bodo_tpu.pandas_api as bd
     from bodo_tpu.plan import physical
     from bodo_tpu.runtime import memory_governor as mg
+    from bodo_tpu.runtime import resilience
 
     gov = mg.governor()
     gov.set_probe_for_testing(256 << 20)
@@ -125,28 +128,19 @@ def test_oom_retry_reruns_stage(mesh8, fresh_gov, monkeypatch):
     try:
         assert hold.budget > mg._MIN_GRANT
         before = hold.budget
-
-        orig = physical._exec_inner
-        boom = [True]
-
-        def flaky(node):
-            if boom[0]:
-                boom[0] = False
-                raise RuntimeError(
-                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
-                    "allocate 9876543210 bytes.")
-            return orig(node)
-
-        monkeypatch.setattr(physical, "_exec_inner", flaky)
+        set_config(faults="stage.boundary=raise:RESOURCE_EXHAUSTED:1:1")
         physical._result_cache.clear()
         df = pd.DataFrame({"k": [3, 1, 2], "v": [1.0, 2.0, 3.0]})
         out = bd.from_pandas(df).sort_values("k").to_pandas()
         assert out["k"].tolist() == [1, 2, 3]
-        assert not boom[0], "stage must have been attempted"
+        assert resilience.stats()["faults_fired"]["stage.boundary"] == 1, \
+            "stage must have been attempted with the fault armed"
         assert gov.n_oom_retries >= 1
         assert hold.budget == before // 2, "fattest grant must be halved"
         assert gov.stats()["n_oom_retries"] >= 1
     finally:
+        set_config(faults="")
+        resilience.reset_stats()
         hold.release()
 
 

@@ -1,0 +1,340 @@
+"""Resilience layer: fault injection, retry envelope, supervised spawn,
+graceful degradation (runtime/resilience.py + spawn.py + plan/physical.py).
+
+The chaos paths under test: an injected collective failure completes the
+query via replicated stage re-execution, a worker killed mid-run_spmd
+surfaces a structured SpawnError in seconds (not the 180s gang timeout),
+an IO flake is absorbed by the retry envelope, and every fault / retry /
+degradation is counted in the tracing profile.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bodo_tpu.config import config, set_config
+from bodo_tpu.runtime import resilience
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    """Disarm the registry and zero counters around every test."""
+    set_config(faults="")
+    resilience.reset_stats()
+    yield
+    set_config(faults="")
+    resilience.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# registry: spec grammar, arming, taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_grammar():
+    fs = resilience.parse_faults(
+        "io.read=raise:OSError:2:3, collective@1=raise:Internal,"
+        "spawn.worker_start=kill, stage.boundary=latency:0.5:1:0")
+    assert [f.kind for f in fs] == ["raise", "raise", "kill", "latency"]
+    assert fs[0].nth == 2 and fs[0].times == 3
+    assert fs[1].rank == 1 and fs[1].arg == "Internal"
+    assert fs[3].times == 0  # unlimited firings
+    for bad in ("io.read", "nope=kill", "io.read=explode",
+                "io.read=raise", "io.read=raise:OSError:0"):
+        with pytest.raises(ValueError):
+            resilience.parse_faults(bad)
+
+
+def test_arm_via_set_config_exports_env():
+    set_config(faults="io.read=raise:OSError")
+    assert os.environ["BODO_TPU_FAULTS"] == "io.read=raise:OSError"
+    assert resilience.armed() == ["io.read=raise:OSError:1:1"]
+    set_config(faults="")
+    assert "BODO_TPU_FAULTS" not in os.environ
+    assert resilience.armed() == []
+
+
+def test_injection_builtin_and_named():
+    set_config(faults="io.read=raise:OSError:1:1,collective=raise:Internal")
+    with pytest.raises(OSError):
+        resilience.maybe_inject("io.read")
+    resilience.maybe_inject("io.read")  # times=1: second call clean
+    with pytest.raises(resilience.FaultInjected) as ei:
+        resilience.maybe_inject("collective")
+    assert ei.value.point == "collective"
+    assert resilience.is_degradable(ei.value)
+    s = resilience.stats()
+    assert s["faults_fired"] == {"io.read": 1, "collective": 1}
+    assert s["point_calls"]["io.read"] == 2
+
+
+def test_latency_injection():
+    set_config(faults="device_put=latency:0.2:1:1")
+    t0 = time.monotonic()
+    resilience.maybe_inject("device_put")
+    assert time.monotonic() - t0 >= 0.15
+    t0 = time.monotonic()
+    resilience.maybe_inject("device_put")  # times=1: second call clean
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_transient_taxonomy():
+    cls = resilience.classify_transient
+    assert cls(RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                            "allocating 1GB")) == "resource_exhausted"
+    assert cls(ConnectionResetError("peer reset")) == "coordination"
+    assert cls(RuntimeError("DEADLINE_EXCEEDED: barrier timed out")) \
+        == "coordination"
+    assert cls(OSError("disk flake")) == "filesystem"
+    # deterministic filesystem errors are NOT retried
+    assert cls(FileNotFoundError("gone")) is None
+    assert cls(PermissionError("denied")) is None
+    assert cls(ValueError("bad schema")) is None
+    # injected named faults are not transient by themselves
+    assert cls(resilience.FaultInjected("io.read", "Flake", 1)) is None
+    assert resilience.classify_transient_text(
+        "Traceback ...\nConnectionRefusedError: [Errno 111]") \
+        == "coordination"
+    assert resilience.classify_transient_text("ValueError: nope") is None
+
+
+# ---------------------------------------------------------------------------
+# retry envelope
+# ---------------------------------------------------------------------------
+
+
+def test_retry_envelope_absorbs_flake():
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise OSError("flake")
+        return 42
+
+    pol = resilience.RetryPolicy(max_attempts=5, base_s=0.001,
+                                 deadline_s=5.0)
+    assert resilience.retry_call(flaky, label="unit", policy=pol) == 42
+    s = resilience.stats()
+    assert s["retries"]["unit"] == 2
+    assert s["retries_by_category"]["filesystem"] == 2
+
+
+def test_retry_envelope_raises_nontransient_immediately():
+    calls = [0]
+
+    def hard_fail():
+        calls[0] += 1
+        raise ValueError("deterministic")
+
+    pol = resilience.RetryPolicy(max_attempts=5, base_s=0.001,
+                                 deadline_s=5.0)
+    with pytest.raises(ValueError):
+        resilience.retry_call(hard_fail, label="unit2", policy=pol)
+    assert calls[0] == 1
+    assert "unit2" not in resilience.stats()["retries"]
+
+
+def test_retry_envelope_exhausts_attempts():
+    calls = [0]
+
+    def always():
+        calls[0] += 1
+        raise OSError("flake")
+
+    with pytest.raises(OSError):
+        resilience.retry_call(
+            always, label="unit3",
+            policy=resilience.RetryPolicy(max_attempts=3, base_s=0.001,
+                                          deadline_s=5.0))
+    assert calls[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# IO flake → retried read succeeds
+# ---------------------------------------------------------------------------
+
+
+def test_csv_read_flake_absorbed(tmp_path):
+    from bodo_tpu.io.csv import read_csv
+    p = str(tmp_path / "t.csv")
+    pd.DataFrame({"a": [1, 2, 3], "b": [0.5, 1.5, 2.5]}).to_csv(
+        p, index=False)
+    set_config(faults="io.read=raise:OSError:1:1")
+    out = read_csv(p).to_pandas()
+    assert out["a"].tolist() == [1, 2, 3]
+    s = resilience.stats()
+    assert s["faults_fired"]["io.read"] == 1
+    assert s["retries"]["read_csv"] >= 1
+    assert s["retries_by_category"]["filesystem"] >= 1
+
+
+def test_parquet_read_flake_absorbed_and_counted(tmp_path, mesh8):
+    from bodo_tpu.io.parquet import read_parquet, write_parquet
+    from bodo_tpu.table.table import Table
+    from bodo_tpu.utils import tracing
+    df = pd.DataFrame({"a": np.arange(10, dtype=np.int64),
+                       "b": np.arange(10) * 0.5})
+    path = str(tmp_path / "t.parquet")
+    write_parquet(Table.from_pandas(df), path)
+    set_config(faults="io.read=raise:OSError:1:1")
+    out = read_parquet(path).to_pandas()
+    np.testing.assert_array_equal(out["a"].to_numpy(), df["a"].to_numpy())
+    s = resilience.stats()
+    assert s["faults_fired"]["io.read"] == 1
+    assert s["retries"]["read_parquet"] >= 1
+    # counters surface in the profile and the chrome-trace dump
+    prof = tracing.profile()
+    assert prof["resil:fault:io.read"]["count"] == 1
+    assert prof["resil:retry:read_parquet"]["count"] >= 1
+    d = json.loads(tracing.dump())
+    assert d["resilience"]["faults_fired"]["io.read"] == 1
+
+
+# ---------------------------------------------------------------------------
+# injected collective failure → replicated stage re-execution
+# ---------------------------------------------------------------------------
+
+
+def test_collective_fault_degrades_replicated(mesh8, monkeypatch):
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import physical
+    from bodo_tpu.utils import tracing
+    monkeypatch.setattr(config, "shard_min_rows", 100)
+    r = np.random.default_rng(7)
+    df = pd.DataFrame({"k": r.integers(0, 10, 5000),
+                       "v": r.normal(size=5000)})
+    exp = (df.groupby("k", as_index=False).agg(s=("v", "sum"))
+           .sort_values("k").reset_index(drop=True))
+    set_config(faults="collective=raise:Internal:1:1")
+    physical._result_cache.clear()
+    got = (bd.from_pandas(df).groupby("k", as_index=False)
+           .agg(s=("v", "sum")).sort_values("k").to_pandas()
+           .reset_index(drop=True))
+    np.testing.assert_allclose(got["s"].to_numpy(), exp["s"].to_numpy())
+    s = resilience.stats()
+    assert s["faults_fired"]["collective"] == 1
+    assert s["degraded_stages"].get("Aggregate", 0) >= 1, s
+    assert any(k.startswith("resil:degraded:")
+               for k in tracing.profile())
+
+
+def test_degradation_disabled_reraises(mesh8, monkeypatch):
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import physical
+    monkeypatch.setattr(config, "shard_min_rows", 100)
+    monkeypatch.setattr(config, "degrade_replicated", False)
+    r = np.random.default_rng(8)
+    df = pd.DataFrame({"k": r.integers(0, 10, 5000),
+                       "v": r.normal(size=5000)})
+    set_config(faults="collective=raise:Internal:1:1")
+    physical._result_cache.clear()
+    with pytest.raises(resilience.FaultInjected):
+        (bd.from_pandas(df).groupby("k", as_index=False)
+         .agg(s=("v", "sum")).to_pandas())
+    assert resilience.stats()["degraded_stages"] == {}
+
+
+# ---------------------------------------------------------------------------
+# injected RESOURCE_EXHAUSTED → governor spill/retry envelope
+# ---------------------------------------------------------------------------
+
+
+def test_injected_resource_exhausted_takes_governor_path(mesh8):
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import physical
+    from bodo_tpu.runtime import memory_governor as mg
+
+    set_config(stream_device_budget_mb=0, mem_governor=True)
+    mg.reset_governor()
+    gov = mg.governor()
+    gov.set_probe_for_testing(256 << 20)
+    hold = gov.admit("victim_state")  # the grant handle_oom will shrink
+    try:
+        before = hold.budget
+        set_config(faults="stage.boundary=raise:RESOURCE_EXHAUSTED:1:1")
+        physical._result_cache.clear()
+        df = pd.DataFrame({"k": [3, 1, 2], "v": [1.0, 2.0, 3.0]})
+        out = bd.from_pandas(df).sort_values("k").to_pandas()
+        assert out["k"].tolist() == [1, 2, 3]
+        assert gov.n_oom_retries >= 1
+        assert hold.budget == before // 2, "fattest grant must be halved"
+        assert resilience.stats()["faults_fired"]["stage.boundary"] == 1
+    finally:
+        hold.release()
+        mg.reset_governor()
+
+
+# ---------------------------------------------------------------------------
+# supervised spawn: fast structured failure, hang detection, gang retry
+# ---------------------------------------------------------------------------
+
+
+def test_spawn_error_structure():
+    from bodo_tpu.spawn import SpawnError
+    e = SpawnError("worker death",
+                   {0: {"state": "ok", "returncode": 0},
+                    1: {"state": "dead", "returncode": 137,
+                        "stderr": "boom"}})
+    s = str(e)
+    assert "rank 1: dead rc=137" in s and "boom" in s
+    assert e.reason == "worker death" and not e.transient
+
+
+@pytest.mark.slow_spawn
+def test_worker_kill_fast_structured_error(monkeypatch):
+    """Acceptance: a killed worker surfaces a structured SpawnError in
+    under 5 seconds — not after the 180s gang timeout."""
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    monkeypatch.setenv("BODO_TPU_FAULTS", "spawn.worker_start@1=kill")
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(lambda rank: rank, 2, timeout=120)
+    dt = time.monotonic() - t0
+    assert dt < 5.0, f"fast-fail took {dt:.1f}s"
+    e = ei.value
+    assert e.reason == "worker death"
+    assert e.ranks[1]["state"] == "dead"
+    assert e.ranks[1]["returncode"] == 137
+    assert "injected kill" in e.ranks[1]["stderr"]
+    assert not e.transient  # a kill is not a coordination flake
+    assert resilience.stats()["gang_retries"] == 0
+
+
+@pytest.mark.slow_spawn
+def test_hung_worker_detected_via_heartbeat(monkeypatch):
+    """A silent-but-alive rank (no heartbeat inside the supervision
+    window) is declared hung and the gang torn down promptly."""
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    monkeypatch.setenv("BODO_TPU_FAULTS",
+                       "spawn.worker_start@0=latency:60")
+    monkeypatch.setattr(config, "spawn_hb_timeout_s", 2.0)
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(lambda rank: rank, 2, timeout=120)
+    dt = time.monotonic() - t0
+    assert dt < 30.0, f"hang detection took {dt:.1f}s"
+    e = ei.value
+    assert e.reason == "hung worker"
+    assert e.ranks[0]["state"] == "hung"
+    assert not e.transient
+
+
+@pytest.mark.slow_spawn
+def test_gang_retry_on_transient_worker_failure(monkeypatch):
+    """When every failing rank's stderr classifies as a coordination
+    flake, the gang is retried once before the SpawnError surfaces."""
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    monkeypatch.setenv("BODO_TPU_FAULTS",
+                       "spawn.worker_start@1=raise:ConnectionResetError")
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(lambda rank: rank, 2, timeout=120)
+    e = ei.value
+    assert e.reason == "worker death"
+    assert e.transient
+    assert e.ranks[1].get("transient") == "coordination"
+    assert resilience.stats()["gang_retries"] == 1

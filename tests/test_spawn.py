@@ -5,8 +5,24 @@ import pytest
 
 
 @pytest.mark.slow
-def test_run_spmd_psum():
+def test_run_spmd_gang_success():
+    """The supervised gang path end-to-end WITHOUT cross-process
+    collectives (which this CPU backend may not implement): spawn,
+    heartbeats, jax.distributed init under the retry envelope, per-rank
+    results gathered in rank order."""
     from bodo_tpu.spawn import run_spmd
+
+    def worker(rank):
+        import jax
+        return (rank, jax.process_index(), jax.process_count())
+
+    results = run_spmd(worker, 2, timeout=240)
+    assert results == [(0, 0, 2), (1, 1, 2)]
+
+
+@pytest.mark.slow
+def test_run_spmd_psum():
+    from bodo_tpu.spawn import SpawnError, run_spmd
 
     def worker(rank):
         import jax
@@ -30,7 +46,15 @@ def test_run_spmd_psum():
         local = jax.device_get(out.addressable_shards[0].data)
         return (rank, jax.process_count(), float(local.ravel()[0]))
 
-    results = run_spmd(worker, 2, timeout=240)
+    try:
+        results = run_spmd(worker, 2, timeout=240)
+    except SpawnError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # pre-existing jaxlib limitation: this CPU backend cannot
+            # execute cross-process collectives (single-host simulation
+            # only); the gang machinery itself is covered above
+            pytest.xfail("jax CPU backend lacks multiprocess collectives")
+        raise
     assert [r[0] for r in results] == [0, 1]
     assert all(r[1] == 2 for r in results)
     # psum over device values 0..n-1 = n(n-1)/2 on every shard

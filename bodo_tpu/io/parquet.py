@@ -337,9 +337,12 @@ class StreamingParquetWriter:
                 _clear_part_dir(self._path)
                 os.rmdir(self._path)
             self._writer = pq.ParquetWriter(self._path, at.schema)
-        resilience.retry_call(lambda: self._writer.write_table(at),
-                              label="stream_write_parquet",
-                              point="io.write")
+        # NOT under the retry envelope: an append to an open
+        # ParquetWriter is stateful, so retrying a partially-completed
+        # write_table could duplicate the batch or corrupt the file.
+        # The injection point still fires so chaos runs cover this sink.
+        resilience.maybe_inject("io.write")
+        self._writer.write_table(at)
 
     def close(self) -> None:
         if self._writer is not None:

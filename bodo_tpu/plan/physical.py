@@ -130,7 +130,10 @@ def _try_degrade(node: L.Node, err: Exception):
         return None
     stage = type(node).__name__
     # pull this stage's materialized 1D inputs back to one replicated
-    # copy; un-materialized children re-execute under force_rep below
+    # copy; un-materialized children re-execute under force_rep below.
+    # Snapshot the originals so a failed re-run leaves the plan's cached
+    # distributions untouched for any later re-execution.
+    snapshot = [(c, c._cached) for c in node.children]
     for c in node.children:
         if c._cached is not None and c._cached.distribution == ONED:
             c._cached = c._cached.gather()
@@ -140,6 +143,8 @@ def _try_degrade(node: L.Node, err: Exception):
         with tracing.event("degrade_replicated", stage=stage):
             out = _exec_inner(node)
     except Exception:  # noqa: BLE001 - degraded re-run failed too
+        for c, cached in snapshot:
+            c._cached = cached
         return None
     finally:
         _degrade_tls.force_rep = False

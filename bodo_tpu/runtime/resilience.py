@@ -465,6 +465,9 @@ def reset_stats() -> None:
 # ---------------------------------------------------------------------------
 
 
+_hb_stop: Optional[threading.Event] = None
+
+
 def start_heartbeat(path: str, interval_s: Optional[float] = None
                     ) -> threading.Event:
     """Touch `path` every `interval_s` from a daemon thread. The spawner
@@ -472,10 +475,12 @@ def start_heartbeat(path: str, interval_s: Optional[float] = None
     supervision window) gets its whole gang torn down with diagnostics
     instead of stalling everyone until the gang timeout. Returns the
     stop event."""
+    global _hb_stop
     if interval_s is None:
         interval_s = _cfg("spawn_hb_interval_s",
                           "BODO_TPU_SPAWN_HB_INTERVAL", 0.5, float)
     stop = threading.Event()
+    _hb_stop = stop
 
     def _beat():
         while not stop.is_set():
@@ -490,3 +495,13 @@ def start_heartbeat(path: str, interval_s: Optional[float] = None
                          daemon=True)
     t.start()
     return stop
+
+
+def stop_heartbeat() -> None:
+    """Silence this process's heartbeat thread. Chaos-test hook: a
+    worker that stops beating AFTER its first beat landed simulates a
+    process wedged mid-computation (the hb file exists but its mtime
+    goes stale), exercising the supervisor's mtime-age path rather than
+    the no-file startup-grace fallback."""
+    if _hb_stop is not None:
+        _hb_stop.set()

@@ -56,6 +56,7 @@ def _load_resilience():
     spec = importlib.util.spec_from_file_location(
         "bodo_tpu_resilience_boot", path)
     mod = importlib.util.module_from_spec(spec)
+    sys.modules["bodo_tpu_resilience_boot"] = mod
     spec.loader.exec_module(mod)
     return mod
 
@@ -137,11 +138,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _hb_age(path: str, now: float, fallback_age: float) -> float:
+def _hb_age(path: str, fallback_age: float) -> float:
     """Seconds since the worker's last heartbeat; until the first beat
-    lands the age is measured from gang start (startup grace)."""
+    lands the age is measured from gang start (startup grace). The
+    heartbeat file's mtime is in wall-clock epoch seconds, so it must be
+    compared against time.time() — not the monotonic clock the
+    supervision deadline uses — or the age would clamp to 0 forever."""
     try:
-        return max(0.0, now - os.path.getmtime(path))
+        return max(0.0, time.time() - os.path.getmtime(path))
     except OSError:
         return fallback_age
 
@@ -297,7 +301,7 @@ def _supervise(procs, hb_paths, start, timeout, hb_timeout):
             return None, set()
         hung = set()
         for i, rc in enumerate(rcs):
-            if rc is None and _hb_age(hb_paths[i], now,
+            if rc is None and _hb_age(hb_paths[i],
                                       now - start) > hb_timeout:
                 hung.add(i)
         if hung:

@@ -325,6 +325,41 @@ def test_hung_worker_detected_via_heartbeat(monkeypatch):
 
 
 @pytest.mark.slow_spawn
+def test_hung_worker_after_first_heartbeat(monkeypatch):
+    """A rank that beat at least once and THEN goes silent is still
+    flagged hung: heartbeat age must come from the file's wall-clock
+    mtime, not the monotonic supervision clock (which would clamp the
+    age to 0 forever once a beat lands)."""
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    monkeypatch.setattr(config, "spawn_hb_timeout_s", 2.0)
+
+    def wedge_after_first_beat(rank):
+        # simulate a worker wedged mid-computation: its heartbeat file
+        # exists (first beats landed) but then goes stale — exercising
+        # the supervisor's mtime-age check, not the no-file startup
+        # fallback. The heartbeat was started by the standalone-loaded
+        # boot module, so stop it through that instance.
+        import sys
+        import time as _time
+        if rank == 0:
+            boot = sys.modules.get("bodo_tpu_resilience_boot")
+            if boot is not None:
+                boot.stop_heartbeat()
+            _time.sleep(120)
+        return rank
+
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(wedge_after_first_beat, 2, timeout=120)
+    dt = time.monotonic() - t0
+    assert dt < 30.0, f"hang detection took {dt:.1f}s"
+    e = ei.value
+    assert e.reason == "hung worker"
+    assert e.ranks[0]["state"] == "hung"
+    assert not e.transient
+
+
+@pytest.mark.slow_spawn
 def test_gang_retry_on_transient_worker_failure(monkeypatch):
     """When every failing rank's stderr classifies as a coordination
     flake, the gang is retried once before the SpawnError surfaces."""

@@ -321,7 +321,8 @@ def bench_tpch(args):
                   "governor_enabled": mem["enabled"],
                   "n_oom_retries": mem["n_oom_retries"]},
               "probe": getattr(args, "probe", {"attempted": False}),
-              "resilience": tracing.resilience_stats()}
+              "resilience": tracing.resilience_stats(),
+              "aqe": tracing.aqe_stats()}
     value = round(total_hot, 3) if not failed else 0.0
     vs = (round(t_sqlite["hot"] / total_hot, 3)
           if ok and not failed and total_hot > 0 else 0.0)
@@ -545,7 +546,8 @@ def main():
                           "n_spills": v["n_spills"]}
                       for k, v in mem["operators"].items()}},
               "probe": getattr(args, "probe", {"attempted": False}),
-              "resilience": tracing.resilience_stats()}
+              "resilience": tracing.resilience_stats(),
+              "aqe": tracing.aqe_stats()}
     if pallas_proof is not None:
         detail["pallas_mxu"] = pallas_proof
     value = round(speedup, 3)

@@ -29,6 +29,8 @@ if config.compile_cache_dir:
     jax.config.update("jax_compilation_cache_dir", config.compile_cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    from bodo_tpu.utils import tracing as _tracing
+    _tracing.install_compile_cache_listener()
 from bodo_tpu.parallel.mesh import (  # noqa: E402
     get_mesh, set_mesh, use_mesh, make_mesh, num_shards, init_runtime,
 )

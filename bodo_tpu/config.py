@@ -160,6 +160,47 @@ class Config:
     mem_op_fraction: float = field(
         default_factory=lambda: _env_float("BODO_TPU_MEM_OP_FRACTION", 0.5)
     )
+    # -- adaptive query execution (plan/adaptive.py) -------------------------
+    # Observe actual cardinalities at stage boundaries and correct the
+    # remaining plan: broadcast promote/demote against governor budgets,
+    # hot-key splits before all_to_all shuffles, undersized streaming-batch
+    # coalescing, and mid-plan join re-ordering on observed rows.
+    aqe: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_AQE", True)
+    )
+    # Broadcast-join byte budget: replicating a build side is allowed while
+    # its observed device bytes stay under this fraction of the governor's
+    # derived per-device budget. Larger builds demote to a shuffle join;
+    # smaller ones promote to broadcast even when the rows-based
+    # bcast_join_threshold planned a shuffle.
+    aqe_bcast_frac: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_AQE_BCAST_FRAC", 0.05)
+    )
+    # A sampled join/shuffle key owning at least this fraction of rows is
+    # "hot": its rows split off and broadcast-join so the all_to_all only
+    # carries the cold remainder.
+    aqe_skew_frac: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_AQE_SKEW_FRAC", 0.3)
+    )
+    # Probe sides smaller than this skip skew detection (sampling costs
+    # more than any skew it could find).
+    aqe_skew_min_rows: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_AQE_SKEW_MIN_ROWS",
+                                         100_000)
+    )
+    # Streaming batches filled below this fraction of the nominal batch
+    # size merge with their successors before the next per-batch kernel.
+    aqe_coalesce_frac: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_AQE_COALESCE_FRAC",
+                                           0.25)
+    )
+    # Persistent runtime-stats store directory (runtime/stats_store.py):
+    # observed cardinalities keyed by normalized subplan fingerprints, so
+    # repeated queries start from observed rather than guessed stats.
+    # Empty = in-process observations only (no persistence).
+    stats_store_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_STATS_DIR", "")
+    )
     # Persistent XLA compilation cache directory (the @jit(cache=True)
     # analogue — reference: Numba on-disk JIT cache, caching_tests/).
     # Set to a path to survive process restarts; empty disables. Applied
@@ -236,14 +277,27 @@ def set_config(**kwargs) -> None:
             else:
                 os.environ.pop("BODO_TPU_FAULTS", None)
         if k == "compile_cache_dir" and v:
-            # jax reads this lazily per compilation — a runtime override
-            # takes effect for subsequent compiles
             import jax
             jax.config.update("jax_compilation_cache_dir", v)
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.1)
             jax.config.update(
                 "jax_persistent_cache_min_entry_size_bytes", 0)
+            try:
+                # jax latches cache-in-use on the FIRST compile of the
+                # process; without a reset, enabling the dir after any
+                # compile has happened is silently a no-op
+                from jax._src import compilation_cache
+                compilation_cache.reset_cache()
+            except Exception:
+                pass
+            from bodo_tpu.utils import tracing
+            tracing.install_compile_cache_listener()
+        if k == "stats_store_dir":
+            # flush + drop the open store so the next lookup re-binds to
+            # the new directory
+            from bodo_tpu.runtime import stats_store
+            stats_store.reset_store()
 
 
 def set_verbose_level(level: int) -> None:

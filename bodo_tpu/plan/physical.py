@@ -67,6 +67,14 @@ def _exec(node: L.Node) -> Table:
         if ev is not None:
             ev["rows"] = t.nrows
     node._cached = t
+    # stage-boundary statistics feedback; a stage that came back from a
+    # degraded replicated re-run is tainted (execution artifact, not a
+    # data property) and must not feed the stats store
+    if getattr(_degrade_tls, "tainted", False):
+        _degrade_tls.tainted = False
+    else:
+        from bodo_tpu.plan import adaptive
+        adaptive.observe_stage(node, t)
     if len(_result_cache) >= _result_cache_limit:
         _result_cache.pop(next(iter(_result_cache)))
     _result_cache[key] = t
@@ -149,6 +157,7 @@ def _try_degrade(node: L.Node, err: Exception):
     finally:
         _degrade_tls.force_rep = False
     resilience.count_degradation(stage)
+    _degrade_tls.tainted = True
     log(1, f"collective failure at {stage}: re-executed replicated "
            f"({type(err).__name__})")
     return out
@@ -199,6 +208,13 @@ def _exec_inner(node: L.Node) -> Table:
         df = pd.DataFrame({k: [v] for k, v in scalars.items()})
         return Table.from_pandas(df)
     if isinstance(node, L.Join):
+        from bodo_tpu.plan import adaptive
+        repl = adaptive.maybe_reoptimize_join(node, _exec)
+        if repl is not None:
+            # observed leaf cardinalities changed the join order:
+            # execute the re-planned subtree (leaf results are memoized,
+            # so only the joins themselves run)
+            return _exec(repl)
         left = _exec(node.left)
         right = _exec(node.right)
         return R.join_tables(left, right, node.left_on, node.right_on,

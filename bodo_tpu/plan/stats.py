@@ -15,27 +15,64 @@ the table is small" from "small because a filter is selective".
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Set, Tuple
 
 from bodo_tpu.plan import logical as L
 from bodo_tpu.plan.expr import (BinOp, Expr, IsIn, StrPredicate, UnOp)
 
-_pq_rows_cache: Dict[str, int] = {}
+# runtime-stats override installed by plan/adaptive.py:
+# fn(node) -> Optional[observed rows]; estimate() consults it first so
+# executed subplans feed their ACTUAL cardinality back into planning.
+_runtime_override = None
+
+# cached row counts keyed by the dataset's content signature (resolved
+# file list + mtimes) — an overwritten dataset changes signature and
+# naturally misses instead of reusing stale counts
+_pq_rows_cache: Dict[Tuple, int] = {}
+_warned_unknown: Set[str] = set()
 
 
-def _parquet_rows(path: str) -> int:
-    hit = _pq_rows_cache.get(path)
+def _dataset_sig(path) -> Tuple[Tuple, Tuple]:
+    """(files, mtimes) of a parquet dataset — the row-count cache key and
+    the persistent stats store's content signature."""
+    import os
+
+    from bodo_tpu.io.parquet import _dataset_files
+    files = tuple(_dataset_files(path))
+    return files, tuple(int(os.stat(f).st_mtime_ns) for f in files)
+
+
+def _note_unknown(path) -> None:
+    """One-time note (tracing + verbose log) when the 1M-row unknown
+    fallback fires — a silently wrong scan estimate is the single worst
+    input to join ordering."""
+    key = str(path)
+    if key in _warned_unknown:
+        return
+    _warned_unknown.add(key)
+    from bodo_tpu.utils import tracing
+    from bodo_tpu.utils.logging import log
+    with tracing.event("stats_unknown_fallback", path=key):
+        pass
+    log(1, f"stats: no row count for {key}; assuming 1,000,000 rows")
+
+
+def _parquet_rows(path) -> int:
+    try:
+        sig = _dataset_sig(path)
+    except Exception:
+        _note_unknown(path)
+        return 1_000_000  # unknown: assume big; don't cache the guess
+    hit = _pq_rows_cache.get(sig)
     if hit is not None:
         return hit
     try:
         import pyarrow.parquet as pq
-
-        from bodo_tpu.io.parquet import _dataset_files
-        n = sum(pq.ParquetFile(f).metadata.num_rows
-                for f in _dataset_files(path))
+        n = sum(pq.ParquetFile(f).metadata.num_rows for f in sig[0])
     except Exception:
-        return 1_000_000  # unknown: assume big; don't cache the guess
-    _pq_rows_cache[path] = n
+        _note_unknown(path)
+        return 1_000_000
+    _pq_rows_cache[sig] = n
     return n
 
 
@@ -65,7 +102,22 @@ def selectivity(e: Expr) -> float:
 
 
 def estimate(node: L.Node) -> Tuple[float, float]:
-    """(estimated rows, raw underlying rows)."""
+    """(estimated rows, raw underlying rows). When the adaptive layer
+    has OBSERVED this subplan's cardinality (this process or the
+    persistent stats store), the observation replaces the estimated
+    component; the raw component keeps its structural meaning (ndv proxy
+    for join_estimate) except for sources, where raw == rows."""
+    if _runtime_override is not None:
+        ov = _runtime_override(node)
+        if ov is not None:
+            est = max(float(ov), 1.0)
+            if isinstance(node, (L.ReadParquet, L.ReadCsv, L.FromPandas)):
+                return est, est
+            return est, _estimate_impl(node)[1]
+    return _estimate_impl(node)
+
+
+def _estimate_impl(node: L.Node) -> Tuple[float, float]:
     if isinstance(node, L.ReadParquet):
         n = float(_parquet_rows(node.path))
         return n, n

@@ -738,7 +738,10 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
         def gen_filter(src):
             for b in src:
                 yield R.filter_table(b, pred)
-        return gen_filter(inner)
+        # a selective filter leaves a tail of near-empty batches; merge
+        # them back up to a useful fill before the next per-batch kernel
+        from bodo_tpu.plan import adaptive
+        return adaptive.coalesce_batches(gen_filter(inner), sharded=False)
     if isinstance(node, L.Projection):
         inner = _build_stream(node.child)
         if inner is None:
@@ -831,6 +834,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
     supports it; None → caller falls back to whole-table execution."""
     if not config.stream_exec:
         return None
+    from bodo_tpu.plan import adaptive
     from bodo_tpu.runtime.resilience import maybe_inject
     maybe_inject("stage.boundary")
     if mesh_mod.num_shards() > 1:
@@ -857,6 +861,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
                 return None
         nb = 0
         for b in src:
+            adaptive.observe_batch(b)
             acc.push(b)
             nb += 1
         if isinstance(acc, GroupbyAccumulator):
@@ -880,6 +885,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
         except NotImplementedError:
             return None
         for b in src:
+            adaptive.observe_batch(b)
             acc.push(b)
         scalars = acc.finish()
         import pandas as pd
@@ -897,6 +903,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
             log(1, f"stream sort disabled, falling back: {e}")
             return None
         for b in src:
+            adaptive.observe_batch(b)
             acc.push(b)
         if not acc.parts:
             acc.close()

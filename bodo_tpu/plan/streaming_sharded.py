@@ -524,7 +524,10 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
         def gen_filter(src):
             for b in src:
                 yield R.filter_table(b, pred)
-        return gen_filter(inner)
+        # coalesce undersized post-filter 1D batches (device-side
+        # append_sharded) before the next per-batch collective
+        from bodo_tpu.plan import adaptive
+        return adaptive.coalesce_batches(gen_filter(inner), sharded=True)
     if isinstance(node, L.Projection):
         inner = build_stream_sharded(node.child, m)
         if inner is None:
@@ -652,6 +655,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
     """Streaming executor over the full mesh: groupby plans stream 1D
     batches through the overlapped-shuffle accumulator. None → caller
     falls back to whole-table execution."""
+    from bodo_tpu.plan import adaptive
     from bodo_tpu.plan import logical as L
     if not config.stream_exec:
         return None
@@ -678,6 +682,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
             return None
         nb = 0
         for b in src:
+            adaptive.observe_batch(b)
             acc.push(b)
             nb += 1
         if acc._template is None:
@@ -697,6 +702,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
         ss = ShardedStreamSort(node.by, node.ascending, node.na_last, m)
         nb = 0
         for b in src1:
+            adaptive.observe_batch(b)
             if not ss.push(b):
                 return None  # dict drift across batches: whole-table
             nb += 1

@@ -1234,24 +1234,29 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
                                  suffixes, null_equal)
             if out is not None:
                 return out
+    from bodo_tpu.plan import adaptive
     if how == "outer" and left.distribution == ONED and \
             right.distribution == REP:
         # a replicated build side would emit its unmatched rows once PER
         # SHARD; shard it so every build row is owned by exactly one shard
         right = right.shard()
+    if left.distribution == ONED and right.distribution == REP and \
+            adaptive.should_demote_broadcast(right):
+        # AQE demotion: the planned broadcast's observed build side
+        # blows the governor budget — shard it and shuffle instead
+        right = right.shard()
     if how != "outer" and \
             left.distribution == ONED and right.distribution == ONED and \
-            right.nrows <= config.bcast_join_threshold and \
-            left.nrows > 4 * right.nrows:
+            adaptive.join_broadcast_decision(right, left):
         # runtime broadcast decision on ACTUAL sizes (not scan-time
         # heuristics): replicating a small build side skips shuffling the
         # big probe side entirely (reference: broadcast join sizing,
-        # bodo/libs/_shuffle.h:153)
+        # bodo/libs/_shuffle.h:153); with AQE on the gate is the build's
+        # observed bytes against the governor's derived budget
         right = right.gather()
     elif how == "inner" and left.distribution == ONED and \
             right.distribution == ONED and \
-            left.nrows <= config.bcast_join_threshold and \
-            right.nrows > 4 * left.nrows:
+            adaptive.join_broadcast_decision(left, right):
         # mirror case: tiny LEFT side — swap (inner join is symmetric),
         # broadcast it, and restore the left-then-right column order
         out = join_tables(right, left, right_on, left_on, "inner",
@@ -1262,6 +1267,10 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
             [rmap[n] for n in right.names if n in rmap]
         return out.select([n for n in names if n in out.columns])
     if left.distribution == ONED and right.distribution == ONED:
+        out = adaptive.try_skew_split_join(left, right, left_on, right_on,
+                                           how, suffixes, null_equal)
+        if out is not None:
+            return out
         return _join_sharded(left, right, left_on, right_on, how, suffixes,
                              null_equal=null_equal)
     if left.distribution == ONED and right.distribution == REP:
@@ -2479,6 +2488,8 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
     shuffle_table analogue, reference bodo/libs/_shuffle.h:41). Rows with
     equal keys land on the same shard."""
     assert t.distribution == ONED
+    from bodo_tpu.plan import adaptive
+    adaptive.observe_shuffle(t, key_cols)
     m = mesh_mod.get_mesh()
     S = mesh_mod.num_shards(m)
     ax = config.data_axis

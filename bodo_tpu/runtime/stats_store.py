@@ -1,0 +1,174 @@
+"""Persistent runtime-statistics store for adaptive execution.
+
+Observed stage cardinalities (plan/adaptive.py) are keyed by a
+NORMALIZED subplan fingerprint — stable across processes — and persisted
+as JSON under ``config.stats_store_dir``, so repeated queries (TPC-H
+reruns, sql/plan_cache.py hits) plan from observed rather than guessed
+cardinalities from their very first stage.
+
+Normalization rules (``fingerprint``):
+
+  * ``FromPandas`` — the plan key's process-local counter id is replaced
+    by (schema names+dtypes, nrows). Two same-shaped frames with equal
+    row counts therefore share a fingerprint; stats are advisory (they
+    steer plan choice, never correctness), so a collision only costs
+    plan quality.
+  * ``ReadParquet`` — the path is replaced by the resolved file list +
+    mtimes, so an overwritten dataset naturally invalidates its stored
+    stats (same signature discipline as plan/stats._parquet_rows).
+  * every other node keeps its structural ``key()`` with child keys
+    substituted by child fingerprints.
+
+The store is a single ``stats.json`` per directory, written atomically
+(tmp + rename), size-capped with oldest-entry eviction, and flushed at
+interpreter exit. Everything here is host-side stdlib — no jax.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from bodo_tpu.config import config
+
+_MAX_ENTRIES = 4096
+
+
+def _norm_key(node) -> tuple:
+    """Structural plan key with process-local identities normalized out."""
+    from bodo_tpu.plan import logical as L
+    if isinstance(node, L.FromPandas):
+        sig = tuple((n, d.name) for n, d in node.schema.items())
+        return ("from_pandas", sig, int(node.table.nrows))
+    if isinstance(node, L.ReadParquet):
+        try:
+            from bodo_tpu.plan.stats import _dataset_sig
+            files, mtimes = _dataset_sig(node.path)
+        except Exception:
+            files, mtimes = (str(node.path),), ()
+        return ("read_parquet", files, mtimes, tuple(node.columns))
+    k = node.key()
+    subs = {c.key(): _norm_key(c) for c in node.children}
+
+    def walk(x):
+        if isinstance(x, tuple):
+            if x in subs:
+                return subs[x]
+            return tuple(walk(y) for y in x)
+        return x
+
+    return walk(k)
+
+
+def fingerprint(node) -> str:
+    """Stable hex digest of a node's normalized subplan key (cached on
+    the node — key construction recurses over the whole subtree)."""
+    fp = getattr(node, "_aqe_fp", None)
+    if fp is None:
+        fp = hashlib.sha256(repr(_norm_key(node)).encode()).hexdigest()[:24]
+        node._aqe_fp = fp
+    return fp
+
+
+class StatsStore:
+    """Thread-safe fingerprint → observed-rows map with optional JSON
+    persistence (path=None keeps it purely in-memory)."""
+
+    def __init__(self, path: Optional[str]):
+        self._path = path
+        self._mu = threading.Lock()
+        self._data: Dict[str, dict] = {}
+        self._dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    raw = json.load(f)
+                if isinstance(raw, dict):
+                    self._data = {
+                        k: v for k, v in raw.items()
+                        if isinstance(v, dict) and "rows" in v}
+            except (OSError, ValueError):
+                pass  # corrupt/unreadable store: start fresh
+
+    def lookup(self, fp: str) -> Optional[float]:
+        with self._mu:
+            e = self._data.get(fp)
+            return float(e["rows"]) if e is not None else None
+
+    def record(self, fp: str, rows: int, nbytes: int = 0) -> None:
+        with self._mu:
+            self._data[fp] = {"rows": int(rows), "bytes": int(nbytes),
+                              "ts": time.time()}
+            self._dirty = True
+            if len(self._data) > _MAX_ENTRIES:
+                drop = sorted(self._data.items(),
+                              key=lambda kv: kv[1].get("ts", 0.0))
+                for k, _ in drop[:len(self._data) - _MAX_ENTRIES]:
+                    del self._data[k]
+
+    def flush(self) -> None:
+        """Atomic write-out (tmp + rename); no-op when clean/in-memory."""
+        with self._mu:
+            if not self._dirty or not self._path:
+                return
+            tmp = f"{self._path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self._path)
+                self._dirty = False
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._data)
+
+
+_store: Optional[StatsStore] = None
+_store_dir: Optional[str] = None
+_store_mu = threading.Lock()
+
+
+def get_store() -> StatsStore:
+    """The store bound to config.stats_store_dir (rebinds on change)."""
+    global _store, _store_dir
+    d = config.stats_store_dir
+    with _store_mu:
+        if _store is None or d != _store_dir:
+            if _store is not None:
+                _store.flush()
+            path = None
+            if d:
+                try:
+                    os.makedirs(d, exist_ok=True)
+                    path = os.path.join(d, "stats.json")
+                except OSError:
+                    path = None
+            _store = StatsStore(path)
+            _store_dir = d
+    return _store
+
+
+def reset_store() -> None:
+    """Flush + drop the open store (set_config(stats_store_dir=...))."""
+    global _store
+    with _store_mu:
+        if _store is not None:
+            _store.flush()
+        _store = None
+
+
+@atexit.register
+def _flush_at_exit() -> None:  # pragma: no cover - interpreter teardown
+    with _store_mu:
+        if _store is not None:
+            _store.flush()

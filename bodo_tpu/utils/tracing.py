@@ -66,10 +66,16 @@ def reset() -> None:
 def dump(path: Optional[str] = None) -> str:
     """Write chrome-trace JSON (load in chrome://tracing / Perfetto).
     Includes a `memory` section with the governor's derived budget and
-    per-operator granted/peak/spilled bytes, and a `resilience` section
-    with fault/retry/degradation counters."""
+    per-operator granted/peak/spilled bytes, a `resilience` section with
+    fault/retry/degradation counters, an `aqe` section with adaptive
+    decision counters + q-error summary, and `compile_cache` hit/miss
+    counts when the persistent jit cache is active."""
     out = {"traceEvents": list(_events), "displayTimeUnit": "ms",
-           "memory": memory_stats(), "resilience": resilience_stats()}
+           "memory": memory_stats(), "resilience": resilience_stats(),
+           "aqe": aqe_stats()}
+    cc = compile_cache_stats()
+    if cc["hits"] or cc["misses"]:
+        out["compile_cache"] = cc
     text = json.dumps(out)
     if path:
         with open(path, "w") as f:
@@ -87,6 +93,49 @@ def resilience_stats() -> dict:
     """Fault-injection / retry / degradation counter snapshot."""
     from bodo_tpu.runtime import resilience
     return resilience.stats()
+
+
+def aqe_stats() -> dict:
+    """Adaptive-execution snapshot: decision counters + q-error summary."""
+    from bodo_tpu.plan import adaptive
+    return adaptive.stats()
+
+
+# persistent-compile-cache observability: jax's monitoring module emits
+# /jax/compilation_cache/cache_hits|cache_misses events when
+# jax_compilation_cache_dir is set; we fold them into hit/miss counters
+_cc_lock = threading.Lock()
+_cc_counts = {"hits": 0, "misses": 0}
+_cc_installed = False
+
+
+def install_compile_cache_listener() -> None:
+    """Idempotently subscribe to jax's compilation-cache events so the
+    profile can report persistent jit-cache hits/misses. Safe to call on
+    jax builds without the monitoring hooks (silently does nothing)."""
+    global _cc_installed
+    if _cc_installed:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _listen(event: str, *a, **kw) -> None:
+            if event.endswith("/cache_hits"):
+                with _cc_lock:
+                    _cc_counts["hits"] += 1
+            elif event.endswith("/cache_misses"):
+                with _cc_lock:
+                    _cc_counts["misses"] += 1
+
+        monitoring.register_event_listener(_listen)
+        _cc_installed = True
+    except Exception:
+        pass
+
+
+def compile_cache_stats() -> dict:
+    with _cc_lock:
+        return dict(_cc_counts)
 
 
 def profile() -> Dict[str, dict]:
@@ -113,10 +162,25 @@ def profile() -> Dict[str, dict]:
         counters[f"resil:degraded:{stage}"] = n
     if rs.get("gang_retries"):
         counters["resil:gang_retries"] = rs["gang_retries"]
+    aq = aqe_stats()
+    for decision, n in aq.get("decisions", {}).items():
+        counters[f"aqe:{decision}"] = n
     for key, n in counters.items():
         if n:
             out[key] = {"count": int(n), "total_s": 0.0, "max_s": 0.0,
                         "rows": 0}
+    qe = aq.get("q_error", {})
+    if qe.get("count"):
+        out["aqe:q_error"] = {
+            "count": int(qe["count"]), "total_s": 0.0, "max_s": 0.0,
+            "rows": 0, "mean": qe.get("mean"), "p50": qe.get("p50"),
+            "p90": qe.get("p90"), "max": qe.get("max")}
+    cc = compile_cache_stats()
+    if cc["hits"] or cc["misses"]:
+        out["cache:compile"] = {
+            "count": cc["hits"] + cc["misses"], "total_s": 0.0,
+            "max_s": 0.0, "rows": 0, "hits": cc["hits"],
+            "misses": cc["misses"]}
     return out
 
 

@@ -38,7 +38,7 @@ import time
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Modules that run alone: widest kernel sets / heaviest compile load.
-_ISOLATED = ("test_tpch.py",)
+_ISOLATED = ("test_tpch.py", "test_adaptive.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

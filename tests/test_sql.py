@@ -277,3 +277,24 @@ def test_exists_residual_variants(mesh8):
         (select 1 from t2 where t2.k = t1.k and abs(v) > t1.v)
         """).to_pandas()
     assert sorted(got["k"]) == [1, 2]
+
+
+def test_sql_distribution_sweep(tables, mesh8):
+    """check_sql: same query, every distribution mode, sqlite oracle."""
+    from tests.utils import check_sql
+    check_sql("""
+        select o_custkey, count(*) as n, sum(o_totalprice) as total
+        from orders where o_status <> 'P'
+        group by o_custkey order by o_custkey
+    """, tables)
+    check_sql("""
+        select c.c_nation as nation, sum(o.o_totalprice) as revenue,
+               count(*) as n
+        from orders o join customer c on o.o_custkey = c.c_custkey
+        where c.c_acctbal > 0
+        group by c.c_nation
+    """, tables)
+    check_sql("""
+        select o_status, avg(o_totalprice) as av
+        from orders group by o_status having count(*) > 10
+    """, tables)

@@ -132,6 +132,36 @@ def check_func(fn: Callable, dfs: Sequence[pd.DataFrame], *,
         _compare(got, exp, rtol, mode)
 
 
+def _sqlite_oracle(query: str, tables) -> pd.DataFrame:
+    """Run a query against an in-memory sqlite of the same tables."""
+    import sqlite3
+    con = sqlite3.connect(":memory:")
+    try:
+        for name, df in tables.items():
+            df.to_sql(name, con, index=False)
+        return pd.read_sql_query(query, con)
+    finally:
+        con.close()
+
+
+def check_sql(query: str, tables, *, modes: Sequence[str] = MODES,
+              sort_output: bool = True, rtol: float = 1e-6,
+              expected: Optional[pd.DataFrame] = None) -> None:
+    """SQL variant of check_func: run `query` through BodoSQLContext once
+    per distribution mode and diff against the sqlite oracle (or an
+    explicit `expected` frame when the query isn't sqlite-compatible)."""
+    from bodo_tpu.sql import BodoSQLContext
+
+    exp_raw = expected if expected is not None else \
+        _sqlite_oracle(query, tables)
+    exp = _normalize(exp_raw, sort_output)
+    for mode in modes:
+        with _mode(mode):
+            ctx = BodoSQLContext(dict(tables))
+            got = _normalize(_to_pandas(ctx.sql(query)), sort_output)
+        _compare(got, exp, rtol, f"sql:{mode}")
+
+
 def check_func_spawn(fn: Callable, dfs: Sequence[pd.DataFrame], *,
                      sort_output: bool = True, rtol: float = 1e-9,
                      n_processes: int = 4) -> None:

@@ -100,6 +100,22 @@ class Config:
     shard_min_rows: int = field(
         default_factory=lambda: _env_int("BODO_TPU_SHARD_MIN_ROWS", 100_000)
     )
+    # -- pipelined I/O (runtime/io_pool.py) ----------------------------------
+    # Batches decoded ahead of the consumer by the streaming sources'
+    # Prefetcher (batch k+1 decodes on a host thread while batch k runs
+    # on the device). 0 disables prefetching entirely. The effective
+    # depth derates under memory-governor pressure (depth x batch bytes
+    # is admission-charged against the derived budget).
+    prefetch_depth: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_PREFETCH_DEPTH", 2)
+    )
+    # Workers in the shared I/O thread pool used for parallel parquet
+    # row-group decode and CSV chunk parse. <= 0 means auto:
+    # min(8, cpu_count), at least 2 (Arrow releases the GIL, so decode
+    # overlaps file I/O even on one core).
+    io_threads: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_IO_THREADS", 0)
+    )
     # -- frontend ------------------------------------------------------------
     # Fall back to real pandas for unsupported args (reference:
     # bodo/pandas/utils.py:346 check_args_fallback).
@@ -293,6 +309,11 @@ def set_config(**kwargs) -> None:
                 pass
             from bodo_tpu.utils import tracing
             tracing.install_compile_cache_listener()
+        if k == "io_threads":
+            # drop the shared executor so the next I/O rebuilds it at
+            # the new width
+            from bodo_tpu.runtime import io_pool
+            io_pool.reset_pool()
         if k == "stats_store_dir":
             # flush + drop the open store so the next lookup re-binds to
             # the new directory

@@ -118,32 +118,54 @@ def iter_csv_arrow(path: str, columns: Optional[Sequence[str]] = None,
                    chunk_bytes: int = CHUNK_BYTES):
     """Yield one arrow Table per newline-aligned byte-range chunk.
 
-    The first chunk's inferred schema is pinned for every later chunk so
-    dtypes cannot drift mid-file (a chunk whose values no longer parse
-    under the pinned schema raises instead of silently widening)."""
+    The first chunk parses synchronously and its inferred schema is
+    pinned for every later chunk so dtypes cannot drift mid-file (a
+    chunk whose values no longer parse under the pinned schema raises
+    instead of silently widening). Remaining chunks parse on the shared
+    I/O pool with ordered reassembly (runtime/io_pool.py) — output is
+    identical to the serial parse; host memory stays bounded by the
+    pool's in-flight window (~(threads+1) x chunk_bytes). Each task
+    opens its own file handle, so no seek races across threads."""
     import io as _io
 
     header, bounds = _newline_bounds(path, chunk_bytes)
     column_types = {c: pa.timestamp("ns") for c in (parse_dates or [])}
-    pinned = False
-    with open(path, "rb") as f:
-        for s, e in zip(bounds, bounds[1:]):
-            def _parse_chunk(s=s, e=e):
+
+    def parse_range(span, types):
+        s, e = span
+
+        def _once():
+            with open(path, "rb") as f:
                 f.seek(s)
                 buf = f.read(e - s)
-                return pacsv.read_csv(
-                    _io.BytesIO(header + buf),
-                    convert_options=pacsv.ConvertOptions(
-                        column_types=dict(column_types),
-                        include_columns=list(columns) if columns else None,
-                    ))
-            at = resilience.retry_call(_parse_chunk, label="read_csv_chunk",
-                                       point="io.read")
-            if not pinned:
-                for fld in at.schema:
-                    column_types.setdefault(fld.name, fld.type)
-                pinned = True
-            yield at
+            return pacsv.read_csv(
+                _io.BytesIO(header + buf),
+                convert_options=pacsv.ConvertOptions(
+                    column_types=dict(types),
+                    include_columns=list(columns) if columns else None,
+                ))
+        return resilience.retry_call(_once, label="read_csv_chunk",
+                                     point="io.read")
+
+    spans = list(zip(bounds, bounds[1:]))
+    if not spans:
+        return
+    first = parse_range(spans[0], column_types)
+    for fld in first.schema:
+        column_types.setdefault(fld.name, fld.type)
+    yield first
+    rest = spans[1:]
+    if not rest:
+        return
+    from bodo_tpu.runtime import io_pool
+    pinned = dict(column_types)
+    if len(rest) > 1 and io_pool.io_thread_count() > 1:
+        io_pool.count("parallel_reads")
+        yield from io_pool.pool_map_ordered(
+            lambda span: parse_range(span, pinned), rest)
+    else:
+        for span in rest:
+            yield parse_range(span, pinned)
 
 
 def slice_arrow_batches(src, chunksize: int):

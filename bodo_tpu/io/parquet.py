@@ -88,20 +88,105 @@ def _opened(path: str):
             src.close()
 
 
-def _attach_footer_ranges(t, files) -> None:
+# ---------------------------------------------------------------------------
+# footer/metadata cache
+# ---------------------------------------------------------------------------
+# One footer parse per (path, mtime, size): the reader previously opened
+# every file twice in the multi-process path (once to count row groups,
+# once to decode) and _attach_footer_ranges re-read every footer after
+# the decode. The cache also serves the AQE stats-store fingerprint
+# (runtime/stats_store.py) and the planner's row-count estimate
+# (plan/stats.py), so a whole plan costs one footer read per file.
+
+_FOOTER_CACHE_MAX = 256
+_footer_cache: dict = {}   # signature -> pq.FileMetaData (insertion-ordered)
+import threading as _threading  # noqa: E402
+
+_footer_lock = _threading.Lock()
+
+
+def file_signature(path: str):
+    """(path, mtime_ns, size) identity of one file — the cache key and
+    the stats-store fingerprint component. Remote paths resolve via
+    fsspec info (mtime falls back to a created/LastModified stamp)."""
+    if _is_remote(path):
+        info = _fs_of(path).info(path.split("://", 1)[1])
+        stamp = info.get("mtime") or info.get("LastModified") \
+            or info.get("created")
+        if hasattr(stamp, "timestamp"):
+            stamp = stamp.timestamp()
+        try:
+            stamp = int(float(stamp) * 1e9)
+        except (TypeError, ValueError):
+            stamp = 0
+        return (path, stamp, int(info.get("size") or 0))
+    st = os.stat(path)
+    return (path, st.st_mtime_ns, st.st_size)
+
+
+def footer_metadata(path: str, sig=None):
+    """Cached parquet footer (pq.FileMetaData) for `path`, keyed on its
+    current (path, mtime, size) signature — an overwritten file misses
+    and re-reads."""
+    from bodo_tpu.runtime import io_pool
+    if sig is None:
+        sig = file_signature(path)
+    with _footer_lock:
+        md = _footer_cache.get(sig)
+        if md is not None:
+            io_pool.count("footer_hits")
+            return md
+    with _opened(path) as src:
+        md = pq.ParquetFile(src).metadata
+    with _footer_lock:
+        io_pool.count("footer_misses")
+        _footer_cache[sig] = md
+        while len(_footer_cache) > _FOOTER_CACHE_MAX:
+            _footer_cache.pop(next(iter(_footer_cache)))
+    return md
+
+
+def clear_footer_cache() -> None:
+    with _footer_lock:
+        _footer_cache.clear()
+
+
+def dataset_signature(path):
+    """Fingerprint of a whole dataset: tuple of per-file signatures.
+    Shared by the AQE stats store so persisted cardinalities invalidate
+    when any file changes."""
+    return tuple(file_signature(f) for f in _dataset_files(path))
+
+
+def dataset_nbytes(path) -> int:
+    """Total on-disk bytes of a dataset (0 when unknown) — sizes the
+    read_parquet admission reservation in plan/physical.py."""
+    try:
+        return sum(sig[2] for sig in dataset_signature(path))
+    except Exception:
+        return 0
+
+
+def _attach_footer_ranges(t, files, row_groups=None) -> None:
     """Column.vrange from parquet row-group statistics (free from the
-    footer — the reference planner reads the same stats for pushdown,
-    bodo/io/parquet_pio.py). Integer and timestamp columns only; any
-    file/row-group without stats clears that column's bound."""
+    cached footer — the reference planner reads the same stats for
+    pushdown, bodo/io/parquet_pio.py). Integer and timestamp columns
+    only; any file/row-group without stats clears that column's bound.
+    `row_groups` (optional dict file -> row-group indices) restricts
+    stats to the row groups actually read — a process's stripe must not
+    claim exact bounds from rows it never loaded."""
     import numpy as np
 
     from bodo_tpu.table import dtypes as dt
     ranges: dict = {}
     try:
         for f in files:
-            with _opened(f) as src:
-                md = pq.ParquetFile(src).metadata
-            for rg in range(md.num_row_groups):
+            if row_groups is not None and f not in row_groups:
+                continue
+            md = footer_metadata(f)
+            rgs = (row_groups[f] if row_groups is not None
+                   else range(md.num_row_groups))
+            for rg in rgs:
                 g = md.row_group(rg)
                 for ci in range(g.num_columns):
                     col = g.column(ci)
@@ -159,6 +244,67 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
         label="read_parquet", point="io.read")
 
 
+def _scan_units(files):
+    """(file, row_group, total_byte_size) scan units, footers from the
+    cache (each file's footer parsed at most once per mtime)."""
+    units = []
+    for f in files:
+        md = footer_metadata(f)
+        units.extend((f, rg, md.row_group(rg).total_byte_size)
+                     for rg in range(md.num_row_groups))
+    return units
+
+
+def _stripe_by_bytes(weights, pi: int, pc: int):
+    """Contiguous [lo, hi) slice of units owned by process `pi`, striped
+    by BYTE weight rather than unit count (the reference's scan-unit
+    distribution weighs row groups the same way — a dataset whose last
+    file holds one fat row group must not land entirely on one rank).
+    Unit i belongs to the process whose 1/pc-band contains the unit's
+    byte midpoint; the owner is nondecreasing in i, so each process gets
+    a contiguous run and the union over processes is an exact partition."""
+    total = sum(weights)
+    if total <= 0:  # degenerate/statless footers: unit-count striping
+        from bodo_tpu.io import stripe
+        return stripe(len(weights), pi, pc)
+    lo = hi = None
+    acc = 0
+    for i, w in enumerate(weights):
+        owner = min(int(pc * (acc + w / 2.0) / total), pc - 1)
+        acc += w
+        if owner == pi:
+            if lo is None:
+                lo = i
+            hi = i + 1
+    return (0, 0) if lo is None else (lo, hi)
+
+
+def _decode_row_group(unit, columns):
+    """Pool task: decode one (file, row_group) with the cached footer —
+    the file opens once for data pages only. Fires the io.read fault
+    point so armed chaos reaches pool threads too."""
+    f, rg, _ = unit
+    resilience.maybe_inject("io.read")
+    with _opened(f) as src:
+        pf = pq.ParquetFile(src, metadata=footer_metadata(f))
+        return pf.read_row_group(
+            rg, columns=list(columns) if columns else None)
+
+
+def _read_units(units, columns):
+    """Decode scan units into one arrow table: pool map with ordered
+    reassembly (byte-identical to a serial read) when the pool has >1
+    worker and there is >1 unit; serial otherwise."""
+    from bodo_tpu.runtime import io_pool
+    if len(units) > 1 and io_pool.io_thread_count() > 1:
+        io_pool.count("parallel_reads")
+        tables = list(io_pool.pool_map_ordered(
+            lambda u: _decode_row_group(u, columns), units))
+    else:
+        tables = [_decode_row_group(u, columns) for u in units]
+    return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
 def _read_parquet_once(path, columns, process_index, process_count) -> Table:
     import jax
     pi = process_index if process_index is not None else jax.process_index()
@@ -168,47 +314,33 @@ def _read_parquet_once(path, columns, process_index, process_count) -> Table:
     files = list(path) if isinstance(path, (list, tuple)) \
         else _dataset_files(path)
 
+    units = _scan_units(files)
     if pc_ == 1:
-        if not _is_remote(files[0]):
-            at = pq.read_table(files if len(files) > 1 else files[0],
-                               columns=list(columns) if columns else None)
-        else:
-            parts = []
-            for f in files:
-                with _opened(f) as src:
-                    parts.append(pq.read_table(
-                        src, columns=list(columns) if columns else None))
-            at = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
-        t = arrow_to_table(at)
-        _attach_footer_ranges(t, files)
-        return t
-
-    # row-group assignment across processes (reference: parquet_reader.cpp
-    # get_scan_units distribution); each file opened/parsed once
-    units = []  # (file, row_group)
-    for f in files:
-        with _opened(f) as src:
-            nrg = pq.ParquetFile(src).metadata.num_row_groups
-        units.extend((f, rg) for rg in range(nrg))
-    from bodo_tpu.io import stripe
-    lo, hi = stripe(len(units), pi, pc_)
-    mine: dict = {}
-    for f, rg in units[lo:hi]:
-        mine.setdefault(f, []).append(rg)
-    tables = []
-    for f, rgs in mine.items():
-        with _opened(f) as src:
-            pf = pq.ParquetFile(src)
-            for rg in rgs:
-                tables.append(pf.read_row_group(
-                    rg, columns=list(columns) if columns else None))
-    if tables:
-        at = pa.concat_tables(tables)
+        lo, hi = 0, len(units)
+    else:
+        # row-group assignment across processes (reference:
+        # parquet_reader.cpp get_scan_units distribution), byte-weighted
+        lo, hi = _stripe_by_bytes([u[2] for u in units], pi, pc_)
+    mine = units[lo:hi]
+    if mine:
+        at = _read_units(mine, columns)
+    elif units:  # fewer units than processes: empty slice, schema kept
+        at = _decode_row_group(units[0], columns).slice(0, 0)
     else:
         with _opened(files[0]) as src:
             at = pq.read_table(src, columns=list(columns) if columns
                                else None).slice(0, 0)
-    return arrow_to_table(at)
+    t = arrow_to_table(at)
+    # footer stats attach on EVERY path (the multi-process return used
+    # to skip them, losing min/max pushdown on multi-host reads), but
+    # restricted to the row groups this process actually read — whole-
+    # dataset bounds would be marked exact yet possibly unattained here.
+    own = {}
+    for f, rg, _w in mine:
+        own.setdefault(f, []).append(rg)
+    if own:
+        _attach_footer_ranges(t, files, row_groups=own)
+    return t
 
 
 def write_parquet(t: Table, path: str, index: bool = False) -> None:

@@ -187,7 +187,16 @@ def _exec_inner(node: L.Node) -> Table:
             return out
     if isinstance(node, L.ReadParquet):
         from bodo_tpu.io import read_parquet
+        from bodo_tpu.io.parquet import dataset_nbytes
+        from bodo_tpu.runtime.memory_governor import reserve
         log(1, f"read_parquet({node.path}) columns={node.columns}")
+        # admission-control the materializing scan against the derived
+        # budget (on-disk bytes as the want estimate; 0 = unknown, skip)
+        nbytes = dataset_nbytes(node.path)
+        if nbytes > 0:
+            with reserve("read_parquet", nbytes):
+                return _maybe_shard(
+                    read_parquet(node.path, columns=node.columns))
         return _maybe_shard(read_parquet(node.path, columns=node.columns))
     if isinstance(node, L.ReadCsv):
         from bodo_tpu.io import read_csv

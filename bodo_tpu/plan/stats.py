@@ -33,13 +33,15 @@ _warned_unknown: Set[str] = set()
 
 
 def _dataset_sig(path) -> Tuple[Tuple, Tuple]:
-    """(files, mtimes) of a parquet dataset — the row-count cache key and
-    the persistent stats store's content signature."""
-    import os
-
-    from bodo_tpu.io.parquet import _dataset_files
-    files = tuple(_dataset_files(path))
-    return files, tuple(int(os.stat(f).st_mtime_ns) for f in files)
+    """(files, (mtime, size) stamps) of a parquet dataset — the
+    row-count cache key and the persistent stats store's content
+    signature. Built from the I/O layer's shared file signatures
+    (io/parquet.file_signature), the same identity that keys the footer
+    cache, so one stat() serves pushdown, planning, and AQE."""
+    from bodo_tpu.io.parquet import dataset_signature
+    sigs = dataset_signature(path)
+    return (tuple(s[0] for s in sigs),
+            tuple((s[1], s[2]) for s in sigs))
 
 
 def _note_unknown(path) -> None:
@@ -67,8 +69,11 @@ def _parquet_rows(path) -> int:
     if hit is not None:
         return hit
     try:
-        import pyarrow.parquet as pq
-        n = sum(pq.ParquetFile(f).metadata.num_rows for f in sig[0])
+        # footers come from the shared cache — a plan whose scan already
+        # read the data pays nothing here
+        from bodo_tpu.io.parquet import footer_metadata
+        n = sum(footer_metadata(f, sig=(f, *stamp)).num_rows
+                for f, stamp in zip(sig[0], sig[1]))
     except Exception:
         _note_unknown(path)
         return 1_000_000

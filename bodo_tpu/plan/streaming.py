@@ -132,42 +132,43 @@ def remap_codes(c: Column, new_dict: np.ndarray) -> Column:
 def parquet_batches(path: str, columns: Optional[Sequence[str]],
                     batch_rows: int) -> Iterator[Table]:
     """Stream a parquet dataset as fixed-capacity REP Tables (the
-    reference's ArrowReader streaming read, bodo/io/arrow_reader.h:170)."""
+    reference's ArrowReader streaming read, bodo/io/arrow_reader.h:170).
+
+    Each raw iter_batches pull runs under the retry envelope (the
+    `io.read` fault point fires per pull, so armed faults surface on
+    whatever thread consumes this generator — including a Prefetcher
+    worker — and transient flakes retry in place). Re-slicing to the
+    fixed batch size goes through slice_arrow_batches, which is linear:
+    the pending tail concatenates once per input chunk instead of
+    rebuilding pa.Table.from_batches per carried-over row group."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
     from bodo_tpu.io.arrow_bridge import arrow_to_table
-    from bodo_tpu.io.parquet import _dataset_files, _opened
+    from bodo_tpu.io.csv import slice_arrow_batches
+    from bodo_tpu.io.parquet import _dataset_files, _opened, footer_metadata
+    from bodo_tpu.runtime import resilience
 
     cap = round_capacity(batch_rows)
     tracker = DictTracker()
     cols = list(columns) if columns else None
-    pending: List[pa.RecordBatch] = []
-    pending_rows = 0
+    _END = object()
 
-    def flush() -> Table:
-        nonlocal pending, pending_rows
-        at = pa.Table.from_batches(pending[:])
-        pending, pending_rows = [], 0
-        return tracker.absorb(arrow_to_table(at, capacity=cap))
+    def raw() -> Iterator[pa.Table]:
+        for f in _dataset_files(path):
+            with _opened(f) as src:
+                pf = pq.ParquetFile(src, metadata=footer_metadata(f))
+                it = pf.iter_batches(batch_size=batch_rows, columns=cols)
+                while True:
+                    rb = resilience.retry_call(
+                        lambda: next(it, _END),
+                        label="parquet_batch", point="io.read")
+                    if rb is _END:
+                        break
+                    yield pa.Table.from_batches([rb])
 
-    for f in _dataset_files(path):
-        with _opened(f) as src:
-            pf = pq.ParquetFile(src)
-            for rb in pf.iter_batches(batch_size=batch_rows, columns=cols):
-                pending.append(rb)
-                pending_rows += rb.num_rows
-                while pending_rows >= batch_rows:
-                    # split off exactly batch_rows
-                    at = pa.Table.from_batches(pending)
-                    head = at.slice(0, batch_rows)
-                    tail = at.slice(batch_rows)
-                    pending = tail.to_batches() if tail.num_rows else []
-                    pending_rows = tail.num_rows
-                    yield tracker.absorb(arrow_to_table(head,
-                                                        capacity=cap))
-    if pending_rows:
-        yield flush()
+    for at in slice_arrow_batches(raw(), batch_rows):
+        yield tracker.absorb(arrow_to_table(at, capacity=cap))
 
 
 def csv_batches(path: str, columns: Optional[Sequence[str]],
@@ -720,11 +721,20 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
     is not streamable."""
     batch_rows = config.streaming_batch_size
 
+    # scan sources run behind a Prefetcher: batch k+1 decodes on a host
+    # thread while batch k runs on the device (runtime/io_pool.py). The
+    # wrapper is lazy + self-closing, so a stream that try_stream_execute
+    # builds and then abandons costs no thread.
+    from bodo_tpu.runtime.io_pool import prefetched
     if isinstance(node, L.ReadParquet):
-        return parquet_batches(node.path, node.columns, batch_rows)
+        return prefetched(
+            parquet_batches(node.path, node.columns, batch_rows),
+            label="parquet")
     if isinstance(node, L.ReadCsv):
-        return csv_batches(node.path, node.columns, node.parse_dates,
-                           batch_rows)
+        return prefetched(
+            csv_batches(node.path, node.columns, node.parse_dates,
+                        batch_rows),
+            label="csv")
     if isinstance(node, L.FromPandas):
         if node.table.distribution != REP:
             return None

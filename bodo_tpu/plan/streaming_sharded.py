@@ -138,8 +138,13 @@ def parquet_batches_sharded(path: str, columns: Optional[Sequence[str]],
     (bounded host memory), scatter each over the mesh at a FIXED per-shard
     capacity so every downstream kernel compiles once."""
     from bodo_tpu.plan.streaming import parquet_batches
-    return _shard_batches(parquet_batches(path, columns, batch_rows),
-                          batch_rows, mesh)
+    from bodo_tpu.runtime.io_pool import prefetched
+    # prefetch below the scatter: Arrow decode of window k+1 overlaps
+    # the device-side shard/recapacity of window k
+    return _shard_batches(
+        prefetched(parquet_batches(path, columns, batch_rows),
+                   label="parquet_sharded"),
+        batch_rows, mesh)
 
 
 def csv_batches_sharded(path: str, columns: Optional[Sequence[str]],
@@ -149,8 +154,11 @@ def csv_batches_sharded(path: str, columns: Optional[Sequence[str]],
     fixed-capacity scatter; reference: the parallel chunked CSV scan,
     bodo/io/_csv_json_reader.cpp)."""
     from bodo_tpu.plan.streaming import csv_batches
-    return _shard_batches(csv_batches(path, columns, parse_dates,
-                                      batch_rows), batch_rows, mesh)
+    from bodo_tpu.runtime.io_pool import prefetched
+    return _shard_batches(
+        prefetched(csv_batches(path, columns, parse_dates, batch_rows),
+                   label="csv_sharded"),
+        batch_rows, mesh)
 
 
 def _shard_batches(src: Iterator[Table], batch_rows: int,

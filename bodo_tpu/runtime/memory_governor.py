@@ -200,7 +200,8 @@ class MemoryGovernor:
 
     # -- admission -----------------------------------------------------------
 
-    def admit(self, name: str, want: int = 0) -> OperatorGrant:
+    def admit(self, name: str, want: int = 0,
+              wait: bool = True) -> OperatorGrant:
         """Reserve memory for an operator that materializes state.
 
         Grants min(want or the default per-operator slice, what's left
@@ -208,6 +209,11 @@ class MemoryGovernor:
         request queues (bounded wait for a release), then receives a
         reduced slice — small grants are how the governor forces an
         operator into partitioned/spill mode.
+
+        ``wait=False`` never queues: an oversubscribed request gets the
+        minimal grant immediately. I/O prefetch workers use this — a
+        derated lookahead depth is the right pressure response there,
+        not a stalled stream.
         """
         # explicit legacy budget wins: exact old behavior
         legacy = int(config.stream_device_budget_mb) << 20
@@ -241,6 +247,9 @@ class MemoryGovernor:
                     # reduced grant: operator runs, but parks/spills
                     # earlier — the governed response to pressure
                     budget = free
+                    break
+                if not wait:
+                    budget = _MIN_GRANT
                     break
                 import time as _time
                 if deadline is None:

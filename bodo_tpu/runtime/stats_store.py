@@ -13,9 +13,10 @@ Normalization rules (``fingerprint``):
     row counts therefore share a fingerprint; stats are advisory (they
     steer plan choice, never correctness), so a collision only costs
     plan quality.
-  * ``ReadParquet`` — the path is replaced by the resolved file list +
-    mtimes, so an overwritten dataset naturally invalidates its stored
-    stats (same signature discipline as plan/stats._parquet_rows).
+  * ``ReadParquet`` — the path is replaced by the resolved per-file
+    (path, mtime, size) signatures from io/parquet's footer cache, so an
+    overwritten dataset naturally invalidates its stored stats (same
+    signature discipline as plan/stats._parquet_rows).
   * every other node keeps its structural ``key()`` with child keys
     substituted by child fingerprints.
 
@@ -47,11 +48,13 @@ def _norm_key(node) -> tuple:
         return ("from_pandas", sig, int(node.table.nrows))
     if isinstance(node, L.ReadParquet):
         try:
-            from bodo_tpu.plan.stats import _dataset_sig
-            files, mtimes = _dataset_sig(node.path)
+            # shared content signature from the I/O layer's footer
+            # cache keying: (path, mtime, size) per file
+            from bodo_tpu.io.parquet import dataset_signature
+            sigs = dataset_signature(node.path)
         except Exception:
-            files, mtimes = (str(node.path),), ()
-        return ("read_parquet", files, mtimes, tuple(node.columns))
+            sigs = ((str(node.path), 0, 0),)
+        return ("read_parquet", sigs, tuple(node.columns))
     k = node.key()
     subs = {c.key(): _norm_key(c) for c in node.children}
 

@@ -68,11 +68,12 @@ def dump(path: Optional[str] = None) -> str:
     Includes a `memory` section with the governor's derived budget and
     per-operator granted/peak/spilled bytes, a `resilience` section with
     fault/retry/degradation counters, an `aqe` section with adaptive
-    decision counters + q-error summary, and `compile_cache` hit/miss
-    counts when the persistent jit cache is active."""
+    decision counters + q-error summary, an `io` section with prefetch
+    decode/stall/overlap and footer-cache counters, and `compile_cache`
+    hit/miss counts when the persistent jit cache is active."""
     out = {"traceEvents": list(_events), "displayTimeUnit": "ms",
            "memory": memory_stats(), "resilience": resilience_stats(),
-           "aqe": aqe_stats()}
+           "aqe": aqe_stats(), "io": io_stats()}
     cc = compile_cache_stats()
     if cc["hits"] or cc["misses"]:
         out["compile_cache"] = cc
@@ -99,6 +100,14 @@ def aqe_stats() -> dict:
     """Adaptive-execution snapshot: decision counters + q-error summary."""
     from bodo_tpu.plan import adaptive
     return adaptive.stats()
+
+
+def io_stats() -> dict:
+    """Pipelined-I/O snapshot: prefetch decode/stall seconds, hit and
+    depth counters, footer-cache hits, parallel decode units, and the
+    derived overlap ratio (runtime/io_pool.py)."""
+    from bodo_tpu.runtime import io_pool
+    return io_pool.io_stats()
 
 
 # persistent-compile-cache observability: jax's monitoring module emits
@@ -143,7 +152,10 @@ def profile() -> Dict[str, dict]:
     Operators the memory governor tracked additionally carry
     granted/peak/spilled bytes under a `mem:<operator>` key; resilience
     counters (fired faults, retries, degraded stages, gang retries)
-    appear under `resil:<counter>` keys."""
+    appear under `resil:<counter>` keys; the pipelined-I/O layer
+    contributes `io:*` counter rows plus time-valued `io:decode`,
+    `io:stall`, and `io:overlap` rows (overlap = decode hidden behind
+    consumer compute)."""
     out = {k: dict(v) for k, v in _agg.items()}
     for name, m in memory_stats().get("operators", {}).items():
         out[f"mem:{name}"] = {
@@ -165,10 +177,28 @@ def profile() -> Dict[str, dict]:
     aq = aqe_stats()
     for decision, n in aq.get("decisions", {}).items():
         counters[f"aqe:{decision}"] = n
+    ios = io_stats()
+    for key in ("prefetch_hits", "prefetch_streams", "prefetch_depth",
+                "stalls", "footer_hits", "footer_misses",
+                "parallel_units", "parallel_reads", "decode_batches"):
+        counters[f"io:{key}"] = ios.get(key, 0)
     for key, n in counters.items():
         if n:
             out[key] = {"count": int(n), "total_s": 0.0, "max_s": 0.0,
                         "rows": 0}
+    # time-valued io rows: decode seconds (worker-side), consumer stall
+    # seconds, and the decode time hidden behind compute
+    if ios.get("decode_batches"):
+        out["io:decode"] = {"count": int(ios["decode_batches"]),
+                            "total_s": ios["decode_s"], "max_s": 0.0,
+                            "rows": 0, "bytes": int(ios["decode_bytes"])}
+        out["io:stall"] = {"count": int(ios["stalls"]),
+                           "total_s": ios["stall_s"], "max_s": 0.0,
+                           "rows": 0}
+        out["io:overlap"] = {"count": int(ios["decode_batches"]),
+                             "total_s": ios["overlap_s"], "max_s": 0.0,
+                             "rows": 0,
+                             "ratio": round(ios["overlap_ratio"], 4)}
     qe = aq.get("q_error", {})
     if qe.get("count"):
         out["aqe:q_error"] = {

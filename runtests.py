@@ -37,8 +37,11 @@ import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
-# Modules that run alone: widest kernel sets / heaviest compile load.
-_ISOLATED = ("test_tpch.py", "test_adaptive.py")
+# Modules that run alone: widest kernel sets / heaviest compile load —
+# and test_io_pipeline.py, whose chaos cases (mid-stream Prefetcher
+# close, armed io.read faults, thread-leak assertions) must not share a
+# process with modules that leave streams open.
+_ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,451 @@
+"""Pipelined I/O subsystem tests (runtime/io_pool.py + io/parquet.py).
+
+Determinism (parallel/prefetched reads byte-identical to the serial
+reader and the pandas oracle), footer-cache behavior, byte-weighted
+striping, fault injection through pool/prefetch threads, mid-stream
+shutdown hygiene (no leaked threads), remote-filesystem coverage via
+memory:// fsspec paths, and the io:* observability counters."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import bodo_tpu
+from bodo_tpu.config import config, set_config
+from bodo_tpu.runtime import io_pool
+
+
+@pytest.fixture(autouse=True)
+def _fresh_io_state():
+    """Every test starts with clean io counters, a cold footer cache,
+    and the default knobs — and restores whatever it changed."""
+    from bodo_tpu.io.parquet import clear_footer_cache
+    old = (config.prefetch_depth, config.io_threads)
+    clear_footer_cache()
+    io_pool.reset_io_stats()
+    yield
+    set_config(prefetch_depth=old[0], io_threads=old[1])
+    set_config(faults="")
+
+
+@pytest.fixture
+def stream_mode(mesh8):
+    """1-device mesh + streaming executor with small batches."""
+    import jax
+    old_mesh = bodo_tpu.parallel.mesh.get_mesh()
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(jax.devices()[:1]))
+    old = (config.stream_exec, config.streaming_batch_size)
+    set_config(stream_exec=True, streaming_batch_size=1000)
+    yield
+    set_config(stream_exec=old[0], streaming_batch_size=old[1])
+    bodo_tpu.set_mesh(old_mesh)
+
+
+def _write_pq(path, n=5000, row_group_size=500, seed=0):
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": np.arange(n),
+        "b": r.normal(size=n),
+        "c": r.choice(["x", "yy", "zzz"], n),
+        "w": r.integers(0, 100, n).astype(np.int32),
+    })
+    pq.write_table(pa.Table.from_pandas(df), str(path),
+                   row_group_size=row_group_size)
+    return df
+
+
+def _no_leaked_prefetch_threads(timeout_s=3.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.name.startswith("bodo-tpu-prefetch")
+                  and t.is_alive()]
+        if not leaked:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher mechanics
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_preserves_order_and_completeness():
+    src = iter(range(200))
+    out = list(io_pool.Prefetcher(src, depth=4, label="t"))
+    assert out == list(range(200))
+    s = io_pool.io_stats()
+    assert s["decode_batches"] == 200
+    assert s["prefetch_streams"] == 1
+
+
+def test_prefetcher_worker_exception_reraises_at_consumer():
+    def src():
+        yield 1
+        yield 2
+        raise ValueError("boom on worker")
+    pf = io_pool.Prefetcher(src(), depth=2, label="t")
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(ValueError, match="boom on worker"):
+        next(pf)
+    # the stream is dead after the error, not wedged
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert _no_leaked_prefetch_threads()
+
+
+def test_prefetcher_close_midstream_no_leaked_threads():
+    """Chaos: abandon a stream mid-flight, repeatedly; every worker must
+    exit — including one blocked on the depth throttle."""
+    def slow():
+        for i in range(1000):
+            time.sleep(0.002)
+            yield i
+    for _ in range(5):
+        pf = io_pool.Prefetcher(slow(), depth=2, label="t")
+        assert next(pf) == 0
+        pf.close()
+    assert _no_leaked_prefetch_threads()
+    # closed streams report exhausted, and double-close is safe
+    pf = io_pool.Prefetcher(iter(range(3)), depth=2, label="t")
+    next(pf)
+    pf.close()
+    pf.close()
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetched_wrapper_abandonment_closes_worker():
+    """A half-consumed prefetched() generator cleans up via GC/close."""
+    gen = io_pool.prefetched(iter(range(100)), label="t", depth=2)
+    assert next(gen) == 0
+    gen.close()  # generator close runs the finally -> Prefetcher.close
+    assert _no_leaked_prefetch_threads()
+
+
+def test_prefetched_depth_zero_is_passthrough():
+    src = iter(range(5))
+    assert io_pool.prefetched(src, depth=0) is src
+
+
+def test_prefetcher_never_started_costs_nothing():
+    before = io_pool.io_stats()["prefetch_streams"]
+    pf = io_pool.Prefetcher(iter(range(10)), depth=2, label="t")
+    pf.close()
+    assert io_pool.io_stats()["prefetch_streams"] == before
+    assert pf._thread is None
+
+
+def test_pool_map_ordered_matches_serial_and_propagates():
+    items = list(range(50))
+    got = list(io_pool.pool_map_ordered(lambda x: x * x, items))
+    assert got == [x * x for x in items]
+
+    def maybe_fail(x):
+        if x == 7:
+            raise RuntimeError("task 7 failed")
+        return x
+    it = io_pool.pool_map_ordered(maybe_fail, range(20))
+    got = []
+    with pytest.raises(RuntimeError, match="task 7"):
+        for v in it:
+            got.append(v)
+    assert got == list(range(7))  # ordered up to the failing position
+
+
+def test_governor_nonblocking_admission(mesh8):
+    """wait=False admission returns immediately with the minimal grant
+    when the budget is fully reserved — a prefetch worker must derate,
+    never queue behind the 5s admission timeout."""
+    from bodo_tpu.runtime import memory_governor as MG
+    MG.reset_governor()
+    gov = MG.governor()
+    gov.set_probe_for_testing(256 << 20)
+    try:
+        # two max-fraction grants drain the derived budget below the
+        # minimum grant, the state where admit(wait=True) would queue
+        hogs = [gov.admit(f"hog{i}", want=1 << 40) for i in range(2)]
+        assert sum(h.budget for h in hogs) + MG._MIN_GRANT \
+            > gov.derived_budget()
+        t0 = time.monotonic()
+        g = gov.admit("io_prefetch:test", want=1 << 30, wait=False)
+        assert time.monotonic() - t0 < 1.0
+        assert g.budget == MG._MIN_GRANT
+        g.release()
+        for h in hogs:
+            h.release()
+    finally:
+        gov.set_probe_for_testing(None)
+        MG.reset_governor()
+
+
+def test_prefetcher_derates_depth_under_pressure(mesh8):
+    """Depth x batch-bytes exceeding the grant shrinks the EFFECTIVE
+    depth instead of stalling; the grant is released on close."""
+    from bodo_tpu.runtime import memory_governor as MG
+    MG.reset_governor()
+    gov = MG.governor()
+    gov.set_probe_for_testing(256 << 20)
+    try:
+        class Fat:
+            nbytes = 64 << 20
+        pf = io_pool.Prefetcher(iter([Fat() for _ in range(6)]),
+                                depth=4, label="t")
+        out = list(pf)
+        assert len(out) == 6
+        assert 1 <= pf._eff <= 4
+        assert gov.stats()["operators"].get("io_prefetch:t") is not None
+        # released: nothing left in the active grant list
+        assert not gov._grants
+    finally:
+        gov.set_probe_for_testing(None)
+        MG.reset_governor()
+
+
+# ---------------------------------------------------------------------------
+# parquet: determinism, footer cache, striping, vrange
+# ---------------------------------------------------------------------------
+
+def test_parallel_parquet_matches_serial_and_pandas(mesh8, tmp_path):
+    from bodo_tpu.io.parquet import read_parquet
+    p = tmp_path / "t.parquet"
+    df = _write_pq(p, n=5000, row_group_size=500)
+    set_config(io_threads=1)
+    serial = read_parquet(str(p)).to_pandas()
+    set_config(io_threads=4)
+    par = read_parquet(str(p)).to_pandas()
+    pd.testing.assert_frame_equal(par, serial)
+    pd.testing.assert_frame_equal(
+        par.reset_index(drop=True),
+        df.reset_index(drop=True), check_dtype=False)
+    assert io_pool.io_stats()["parallel_reads"] >= 1
+
+
+def test_footer_cache_hits_and_mtime_invalidation(tmp_path):
+    from bodo_tpu.io.parquet import clear_footer_cache, footer_metadata
+    p = str(tmp_path / "t.parquet")
+    _write_pq(p, n=100, row_group_size=50)
+    clear_footer_cache()
+    io_pool.reset_io_stats()
+    md1 = footer_metadata(p)
+    md2 = footer_metadata(p)
+    assert md2 is md1  # same cached object
+    s = io_pool.io_stats()
+    assert s["footer_misses"] == 1 and s["footer_hits"] == 1
+    # overwrite: signature changes, cache must miss and see new contents
+    _write_pq(p, n=300, row_group_size=50, seed=1)
+    os.utime(p, ns=(1, 1))
+    md3 = footer_metadata(p)
+    assert md3.num_rows == 300
+    assert io_pool.io_stats()["footer_misses"] == 2
+
+
+def test_byte_weighted_striping_partition_properties():
+    from bodo_tpu.io.parquet import _stripe_by_bytes
+    cases = [
+        ([10, 10, 10, 1000, 10], 3),
+        ([1], 4),
+        ([5, 5, 5, 5], 2),
+        ([1000, 1, 1, 1, 1, 1], 4),
+        ([0, 0, 0], 2),  # statless footers: unit-count fallback
+    ]
+    for weights, pc in cases:
+        slices = [_stripe_by_bytes(weights, pi, pc) for pi in range(pc)]
+        covered = [i for lo, hi in slices for i in range(lo, hi)]
+        # exact partition: every unit exactly once, contiguous per proc
+        assert sorted(covered) == list(range(len(weights))), (weights, pc)
+        assert len(covered) == len(set(covered)), (weights, pc)
+    # the skewed case must NOT give the fat unit's owner extra units
+    slices = [_stripe_by_bytes([10, 10, 10, 1000, 10], pi, 3)
+              for pi in range(3)]
+    fat_owner = next(i for i, (lo, hi) in enumerate(slices)
+                     if lo <= 3 < hi)
+    lo, hi = slices[fat_owner]
+    assert hi - lo == 1  # the 1000-byte row group rides alone
+
+
+def test_vrange_survives_multiprocess_read(mesh8, tmp_path):
+    """The multi-process path used to return without attaching footer
+    ranges — multi-host reads silently lost min/max pushdown stats."""
+    from bodo_tpu.io.parquet import read_parquet
+    p = str(tmp_path / "t.parquet")
+    df = _write_pq(p, n=4000, row_group_size=400)
+    total = 0
+    union_lo, union_hi = None, None
+    for pi in range(2):
+        t = read_parquet(p, process_index=pi, process_count=2)
+        vr = t.columns["a"].vrange
+        assert vr is not None, "multi-process read lost vrange"
+        assert vr[2] is True
+        # a process's bounds cover exactly ITS rows, not the dataset's
+        got = t.to_pandas()["a"]
+        assert vr[0] == got.min() and vr[1] == got.max()
+        total += t.nrows
+        union_lo = vr[0] if union_lo is None else min(union_lo, vr[0])
+        union_hi = vr[1] if union_hi is None else max(union_hi, vr[1])
+    assert total == len(df)
+    assert (union_lo, union_hi) == (df["a"].min(), df["a"].max())
+
+
+def test_multiprocess_union_matches_serial(mesh8, tmp_path):
+    from bodo_tpu.io.parquet import read_parquet
+    p = str(tmp_path / "t.parquet")
+    _write_pq(p, n=3000, row_group_size=250)
+    serial = read_parquet(p).to_pandas()
+    parts = [read_parquet(p, process_index=pi, process_count=3).to_pandas()
+             for pi in range(3)]
+    union = pd.concat(parts, ignore_index=True)
+    pd.testing.assert_frame_equal(union, serial.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# streaming sources: linear re-slicing, fault injection, remote fs
+# ---------------------------------------------------------------------------
+
+def test_parquet_batches_reslice_matches_table(mesh8, tmp_path):
+    """Row groups much larger than batch_rows exercise the carry-over
+    loop (previously quadratic, rebuilt from_batches per yield)."""
+    from bodo_tpu.plan.streaming import parquet_batches
+    p = str(tmp_path / "t.parquet")
+    df = _write_pq(p, n=7000, row_group_size=3000)
+    batches = list(parquet_batches(p, None, 640))
+    assert all(b.nrows == 640 for b in batches[:-1])
+    got = pd.concat([b.to_pandas() for b in batches], ignore_index=True)
+    pd.testing.assert_frame_equal(got, df.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def test_csv_parallel_chunks_match_serial(mesh8, tmp_path):
+    from bodo_tpu.io.csv import iter_csv_arrow
+    p = str(tmp_path / "t.csv")
+    df = pd.DataFrame({"a": np.arange(20000),
+                       "b": np.random.default_rng(0).normal(size=20000)})
+    df.to_csv(p, index=False)
+    chunk = 64 << 10  # force many byte-range chunks
+    set_config(io_threads=1)
+    serial = pa.concat_tables(list(iter_csv_arrow(p, chunk_bytes=chunk)))
+    set_config(io_threads=4)
+    par = pa.concat_tables(list(iter_csv_arrow(p, chunk_bytes=chunk)))
+    assert par.equals(serial)
+    assert par.num_rows == len(df)
+    assert io_pool.io_stats()["parallel_reads"] >= 1
+
+
+def test_armed_fault_on_prefetch_worker_retries_and_succeeds(mesh8,
+                                                             tmp_path):
+    """An io.read fault fired on the prefetch worker thread is absorbed
+    by the per-pull retry envelope; the stream completes and the retry
+    is counted."""
+    from bodo_tpu.plan.streaming import parquet_batches
+    from bodo_tpu.runtime import resilience
+    p = str(tmp_path / "t.parquet")
+    df = _write_pq(p, n=3000, row_group_size=300)
+    before = resilience.stats()["retries"].get("parquet_batch", 0)
+    set_config(faults="io.read=raise:OSError:2:1")
+    try:
+        src = io_pool.prefetched(parquet_batches(p, None, 500),
+                                 label="t", depth=2)
+        got = pd.concat([b.to_pandas() for b in src], ignore_index=True)
+    finally:
+        set_config(faults="")
+    pd.testing.assert_frame_equal(got, df.reset_index(drop=True),
+                                  check_dtype=False)
+    assert resilience.stats()["retries"].get("parquet_batch", 0) > before
+
+
+def test_permanent_fault_on_worker_surfaces_at_consumer(mesh8, tmp_path):
+    """A non-transient exception on the worker re-raises at the
+    consumer (not swallowed, not wedged) and the worker exits."""
+    from bodo_tpu.plan.streaming import parquet_batches
+    p = str(tmp_path / "t.parquet")
+    _write_pq(p, n=2000, row_group_size=200)
+    set_config(faults="io.read=raise:ValueError:2:1")
+    try:
+        src = io_pool.prefetched(parquet_batches(p, None, 500),
+                                 label="t", depth=2)
+        with pytest.raises(ValueError, match="injected fault"):
+            for _ in src:
+                pass
+    finally:
+        set_config(faults="")
+    assert _no_leaked_prefetch_threads()
+
+
+def test_memory_fsspec_through_prefetching_reader(mesh8):
+    """Remote-filesystem coverage: memory:// parquet through the
+    prefetching streaming source and the footer cache."""
+    import fsspec
+    from bodo_tpu.plan.streaming import parquet_batches
+    df = _write_pq("/tmp/_unused.parquet", n=1500, row_group_size=300)
+    os.unlink("/tmp/_unused.parquet")
+    fs = fsspec.filesystem("memory")
+    with fs.open("/iobench/data.parquet", "wb") as f:
+        pq.write_table(pa.Table.from_pandas(df), f)
+    url = "memory://iobench/data.parquet"
+    src = io_pool.prefetched(parquet_batches(url, None, 400),
+                             label="remote", depth=2)
+    got = pd.concat([b.to_pandas() for b in src], ignore_index=True)
+    pd.testing.assert_frame_equal(got, df.reset_index(drop=True),
+                                  check_dtype=False)
+    # whole-table remote read also lands vrange from the cached footer
+    from bodo_tpu.io.parquet import read_parquet
+    t = read_parquet(url)
+    assert t.columns["a"].vrange == (0, 1499, True)
+    assert io_pool.io_stats()["footer_hits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: executor integration + observability
+# ---------------------------------------------------------------------------
+
+def test_streaming_executor_overlap_counters(stream_mode, tmp_path):
+    """A streaming-executor run shows nonzero io:* counters in
+    tracing.profile() and an `io` section in dump()."""
+    import json
+
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.utils import tracing
+    p = str(tmp_path / "t.parquet")
+    df = _write_pq(p, n=6000, row_group_size=600)
+    out = (bd.read_parquet(p).groupby("w", as_index=False)
+           .agg(s=("b", "sum"))).to_pandas()
+    exp = df.groupby("w", as_index=False).agg(s=("b", "sum"))
+    np.testing.assert_allclose(
+        out.sort_values("w")["s"].to_numpy(),
+        exp.sort_values("w")["s"].to_numpy(), rtol=1e-9)
+    s = io_pool.io_stats()
+    assert s["prefetch_streams"] >= 1
+    assert s["decode_batches"] > 0
+    prof = tracing.profile()
+    assert prof["io:decode"]["total_s"] > 0
+    assert "io:overlap" in prof
+    assert prof["io:prefetch_streams"]["count"] >= 1
+    j = json.loads(tracing.dump())
+    assert j["io"]["decode_batches"] > 0
+    assert "overlap_ratio" in j["io"]
+
+
+def test_sharded_streaming_source_prefetches(mesh8, tmp_path):
+    from bodo_tpu.plan.streaming_sharded import parquet_batches_sharded
+    p = str(tmp_path / "t.parquet")
+    df = _write_pq(p, n=4000, row_group_size=500)
+    total = 0
+    for b in parquet_batches_sharded(p, None, 1024, mesh=mesh8):
+        total += b.nrows
+    assert total == len(df)
+    assert io_pool.io_stats()["prefetch_streams"] >= 1
+
+
+def test_set_config_io_threads_resets_pool():
+    p1 = io_pool.io_pool()
+    set_config(io_threads=3)
+    p2 = io_pool.io_pool()
+    assert p2 is not p1
+    assert io_pool.io_thread_count() == 3

@@ -450,6 +450,83 @@ def bench_scan(args, n_rows: int):
     return 0
 
 
+def bench_lockstep(args, n_rows: int):
+    """--suite lockstep: overhead of the shardcheck SPMD lockstep
+    checker (analysis/lockstep.py) on a sharded groupby+sort pipeline.
+    Runs the identical pipeline with the checker off and armed
+    (single-process, side-channel dir set, so every dispatch pays the
+    fingerprint + log write but no peer wait); the JSON metric is the
+    fractional slowdown, with per-collective microseconds in detail."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    import bodo_tpu
+    from bodo_tpu import relational
+    from bodo_tpu.analysis import lockstep
+    from bodo_tpu.config import set_config
+    from bodo_tpu.plan import physical
+    from bodo_tpu.table.table import Table
+
+    devs = jax.devices()[:args.mesh]
+    args.mesh = len(devs)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+    set_config(shard_min_rows=0)
+    rng = np.random.default_rng(0)
+    pdf = pd.DataFrame({"k": rng.integers(0, 128, n_rows),
+                        "v": rng.random(n_rows)})
+    t = physical._maybe_shard(Table.from_pandas(pdf))
+    reps = 3 if args.quick else 10
+
+    def pipeline():
+        g = relational.groupby_agg(t, ["k"], [("v", "sum", "vs")])
+        out = relational.sort_table(g if g.distribution == "1D" else t,
+                                    ["k"])
+        jax.block_until_ready(next(iter(out.columns.values())).data)
+
+    def measure() -> float:
+        pipeline()  # warm the kernel cache
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            pipeline()
+        return (time.perf_counter() - t0) / reps
+
+    base_s = measure()
+    with tempfile.TemporaryDirectory(prefix="bodo_tpu_lockstep_") as d:
+        set_config(lockstep=True, lockstep_dir=d)
+        try:
+            lockstep_s = measure()
+            ls = lockstep.stats()  # read BEFORE disabling (reset)
+        finally:
+            set_config(lockstep=False, lockstep_dir="")
+    collectives = ls["collectives"]
+    overhead = (lockstep_s - base_s) / base_s if base_s > 0 else 0.0
+    per_disp = collectives / (reps + 1)  # dispatches per pipeline run
+    per_us = ((lockstep_s - base_s) / per_disp * 1e6
+              if per_disp else 0.0)
+    print(f"lockstep: base {base_s:.4f}s armed {lockstep_s:.4f}s "
+          f"({collectives} dispatches fingerprinted)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "lockstep_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "vs_baseline": round(1.0 + overhead, 4),
+        "detail": {"rows": n_rows, "reps": reps,
+                   "base_s": round(base_s, 4),
+                   "lockstep_s": round(lockstep_s, 4),
+                   "collectives": int(collectives),
+                   "per_collective_us": round(max(per_us, 0.0), 2),
+                   "mismatches": int(ls["mismatches"]),
+                   "n_devices": args.mesh,
+                   "platform": devs[0].platform,
+                   "probe": getattr(args, "probe",
+                                    {"attempted": False})},
+    }))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=None,
@@ -465,7 +542,8 @@ def main():
                          "has one physical core, so a multi-device CPU "
                          "mesh only adds shuffle cost; use --cpu --mesh 8 "
                          "as a collectives correctness probe)")
-    ap.add_argument("--suite", choices=["taxi", "tpch", "scan"],
+    ap.add_argument("--suite",
+                    choices=["taxi", "tpch", "scan", "lockstep"],
                     default="taxi")
     ap.add_argument("--resume", action="store_true",
                     help="tpch: append per-query results to a state file "
@@ -475,6 +553,11 @@ def main():
                     help="use the streaming batch executor (bounded device "
                          "memory; plan/streaming.py)")
     args = ap.parse_args()
+    if args.suite == "lockstep":
+        if args.mesh is None:
+            args.mesh = 8  # collectives must actually dispatch
+        if args.rows is None and not args.quick:
+            args.rows = 500_000  # checker cost, not scan cost
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -533,6 +616,8 @@ def main():
         if args.mesh is None:
             args.mesh = 1
         return bench_scan(args, n_rows)
+    if args.suite == "lockstep":
+        return bench_lockstep(args, n_rows)
 
     import pandas as pd  # noqa: F401
 

@@ -1,0 +1,35 @@
+"""shardcheck — the SPMD safety analyzer, three layers:
+
+  plan_validator   distribution/shape typing over the logical plan DAG
+                   (runs automatically before execution;
+                   `validate_plan` is the explicit API)
+  lint             stdlib-ast rules over the codebase itself
+                   (`python -m bodo_tpu.analysis`)
+  lockstep         runtime collective-dispatch lockstep checker
+                   (debug mode, BODO_TPU_LOCKSTEP=1)
+
+Submodules import lazily: `lockstep` is on the hot collective-dispatch
+path and must not drag the plan layer in, and `plan_validator` pulls
+plan.expr (jax) which the stdlib-only lint CLI path defers as long as
+possible.
+"""
+
+from __future__ import annotations
+
+_LAZY = ("plan_validator", "lint", "lockstep")
+
+__all__ = ["PlanInvariantError", "LockstepError", "validate_plan",
+           "dist_of", *_LAZY]
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY:
+        return importlib.import_module(f"{__name__}.{name}")
+    if name in ("PlanInvariantError", "validate_plan", "dist_of"):
+        mod = importlib.import_module(f"{__name__}.plan_validator")
+        return getattr(mod, name)
+    if name == "LockstepError":
+        from bodo_tpu.analysis.lockstep import LockstepError
+        return LockstepError
+    raise AttributeError(name)

@@ -1,0 +1,10 @@
+"""`python -m bodo_tpu.analysis` — run the shardcheck lint CLI.
+
+Exit 0 when every finding is inline-suppressed or baselined; exit 1 on
+any new finding (the `runtests.py lint` CI gate)."""
+
+import sys
+
+from bodo_tpu.analysis import lint
+
+sys.exit(lint.main(sys.argv[1:]))

@@ -1,0 +1,572 @@
+"""shardcheck codebase lint (shardcheck layer 2) — stdlib-`ast` rules
+for SPMD safety over the bodo_tpu package itself.
+
+Rules:
+
+  rank-divergent-collective
+      A collective call lexically inside control flow whose condition
+      depends on the process/shard identity (rank, process_index,
+      BODO_TPU_PROC_ID, axis_index). In gang-scheduled SPMD one rank
+      skipping a collective hangs every other rank (Pathways,
+      arXiv:2203.12533) — divergent ranks must never reach a
+      collective.
+
+  trace-time-side-effect
+      A host side effect (I/O, environ, time, random, fault injection)
+      inside a function that is traced by jax (contains lax collectives
+      or is passed to smap/shard_map). Traced bodies run ONCE at trace
+      time and never again from the compiled-kernel cache, so the side
+      effect silently stops firing — the PR-2 trace-time-vs-
+      dispatch-time distinction as a checked rule.
+
+  retry-non-idempotent
+      A non-idempotent operation (write/send/append) inside a callable
+      passed to `resilience.retry_call`. A transient failure AFTER the
+      effect lands re-runs the effect (duplicate rows / double
+      writes) — the ParquetWriter class of bug from the PR-2 review.
+
+  unlocked-shared-state
+      A write to module-level mutable state outside any `with <lock>:`
+      block, in modules that define threading locks (i.e. modules whose
+      state is demonstrably shared across threads — the io_pool/pool
+      worker-thread model). Modules with no locks are single-threaded
+      by design and out of scope.
+
+Suppressions: `# shardcheck: ignore[rule]` (or bare
+`# shardcheck: ignore` for all rules) on the finding's line or the
+line directly above. Grandfathered findings live in
+`analysis/baseline.json`, matched line-number-insensitively on
+(rule, file, enclosing function, source text) so unrelated edits don't
+resurrect them; `python -m bodo_tpu.analysis --write-baseline`
+regenerates it.
+
+Exit status (CLI): 0 when every finding is suppressed or baselined,
+1 otherwise — `runtests.py lint` gates on this.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+RULES = {
+    "rank-divergent-collective":
+        "collective dispatched under rank-dependent control flow",
+    "trace-time-side-effect":
+        "host side effect inside a jax-traced function body",
+    "retry-non-idempotent":
+        "non-idempotent operation inside the retry envelope",
+    "unlocked-shared-state":
+        "module-global state written without holding a lock",
+}
+
+# names that identify process/shard identity in a branch condition
+_RANK_NAMES = {"rank", "process_index", "process_id", "proc_id",
+               "current_rank", "axis_index"}
+_RANK_ENV = {"BODO_TPU_PROC_ID"}
+
+# axis-context collectives (lax + this package's wrappers) and the
+# host-level dispatch helpers: calling any of these from one rank only
+# wedges the gang
+_COLLECTIVE_NAMES = {
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "pshuffle", "psum_scatter",
+    "dist_sum", "dist_max", "dist_min", "dist_exscan_sum",
+    "all_gather_rows", "all_to_all_rows", "ring_shift", "bcast_from",
+    "shuffle_rows", "shuffle_by_key",
+}
+# lax-only subset used to classify a function as jax-traced
+_LAX_COLLECTIVES = {"psum", "pmax", "pmin", "all_gather", "all_to_all",
+                    "ppermute", "pshuffle", "psum_scatter",
+                    "axis_index"}
+
+_SIDE_EFFECT_NAMES = {"open", "print", "maybe_inject", "_inject",
+                      "input"}
+_SIDE_EFFECT_MODULES = {"os", "time", "random"}
+# pure/trace-safe exceptions within those modules
+_SIDE_EFFECT_OK = {"time.monotonic", "time.perf_counter", "time.time",
+                   "os.path", "random.Random"}
+
+_NONIDEMPOTENT = {"write", "writelines", "write_table", "send",
+                  "sendall", "appendleft", "append_row"}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_LOCKISH_RE = re.compile(r"(lock|_mu$|mutex|cv$|cond)", re.IGNORECASE)
+_MUTATORS = {"append", "extend", "add", "update", "pop", "popitem",
+             "clear", "remove", "discard", "insert", "setdefault",
+             "appendleft"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shardcheck:\s*ignore(?:\[([\w\-, ]+)\])?")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    col: int
+    func: str          # enclosing function qualname ("" = module)
+    text: str          # source line, stripped
+    message: str
+
+    def key(self):
+        """Line-number-insensitive identity for baseline matching."""
+        return (self.rule, self.path, self.func, self.text)
+
+    def render(self) -> str:
+        where = f" (in {self.func})" if self.func else ""
+        return (f"{self.path}:{self.line}:{self.col}: [{self.rule}] "
+                f"{self.message}{where}\n    {self.text}")
+
+
+_stats = {"runs": 0, "files": 0, "findings": 0, "suppressed": 0,
+          "baselined": 0}
+
+
+def stats() -> dict:
+    return dict(_stats)
+
+
+def _terminal(func) -> str:
+    """Rightmost name of a call target (foo / mod.foo / a.b.foo)."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return func.id if isinstance(func, ast.Name) else ""
+
+
+def _root(node) -> str:
+    """Leftmost name of an attribute chain (os.environ.get -> os)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _test_is_rank_divergent(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Call) and _terminal(n.func) in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Constant) and n.value in _RANK_ENV:
+            return True
+    return False
+
+
+class _ModuleInfo(ast.NodeVisitor):
+    """Pre-pass: module-level names, locks, traced functions, and
+    retry_call targets."""
+
+    def __init__(self):
+        self.globals: Set[str] = set()        # module-level bindings
+        self.mutables: Set[str] = set()       # dict/list/set/deque/...
+        self.locks: Set[str] = set()          # Lock()/RLock()/...
+        self.smap_fn_names: Set[str] = set()  # passed to smap/shard_map
+
+    def visit_Module(self, node: ast.Module):
+        for stmt in node.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets
+                           if isinstance(t, ast.Name)]
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            for t in targets:
+                self.globals.add(t.id)
+                if isinstance(value, ast.Call):
+                    name = _terminal(value.func)
+                    if name in _LOCK_FACTORIES:
+                        self.locks.add(t.id)
+                    elif name in ("dict", "list", "set", "deque",
+                                  "defaultdict", "OrderedDict",
+                                  "Counter"):
+                        self.mutables.add(t.id)
+                elif isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                        ast.DictComp, ast.ListComp,
+                                        ast.SetComp)):
+                    self.mutables.add(t.id)
+        # whole-tree scan for smap/shard_map(fn, ...) first args
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and \
+                    _terminal(n.func) in ("smap", "shard_map") and \
+                    n.args and isinstance(n.args[0], ast.Name):
+                self.smap_fn_names.add(n.args[0].id)
+
+
+def _contains_lax_collective(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and \
+                _terminal(n.func) in _LAX_COLLECTIVES:
+            return True
+    return False
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, src_lines: List[str],
+                 info: _ModuleInfo):
+        self.rel = rel
+        self.lines = src_lines
+        self.info = info
+        self.findings: List[Finding] = []
+        self._func: List[str] = []       # qualname stack
+        self._div_depth = 0              # rank-divergent control flow
+        self._locks_held = 0             # `with <lock>:` nesting
+        self._traced_depth = 0           # inside a jax-traced function
+        self._local_defs: List[Dict[str, ast.AST]] = [{}]
+
+    # -- helpers ----------------------------------------------------------
+
+    def _add(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        text = self.lines[line - 1].strip() if \
+            0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            rule=rule, path=self.rel, line=line,
+            col=getattr(node, "col_offset", 0),
+            func=".".join(self._func), text=text, message=message))
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self._func + [name])
+
+    # -- scopes -----------------------------------------------------------
+
+    def _visit_func(self, node):
+        self._local_defs[-1][node.name] = node
+        traced = (node.name in self.info.smap_fn_names or
+                  _contains_lax_collective(node))
+        self._func.append(node.name)
+        self._local_defs.append({})
+        if traced:
+            self._traced_depth += 1
+        # a lock held at the call site does not cover the function body
+        saved_locks, self._locks_held = self._locks_held, 0
+        self.generic_visit(node)
+        self._locks_held = saved_locks
+        if traced:
+            self._traced_depth -= 1
+        self._local_defs.pop()
+        self._func.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- rank-divergent control flow --------------------------------------
+
+    def _visit_branch(self, node):
+        divergent = _test_is_rank_divergent(node.test)
+        if divergent:
+            self._div_depth += 1
+        self.generic_visit(node)
+        if divergent:
+            self._div_depth -= 1
+
+    visit_If = _visit_branch
+    visit_While = _visit_branch
+    visit_IfExp = _visit_branch
+
+    # -- with <lock>: -----------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        lockish = 0
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # reserve(...), lock() factories
+            name = _dotted(expr)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf in self.info.locks or _LOCKISH_RE.search(leaf or ""):
+                lockish += 1
+        self._locks_held += lockish
+        self.generic_visit(node)
+        self._locks_held -= lockish
+
+    # -- calls ------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        t = _terminal(node.func)
+        if self._div_depth and t in _COLLECTIVE_NAMES:
+            self._add(
+                "rank-divergent-collective", node,
+                f"collective {t!r} dispatched under rank-dependent "
+                f"control flow: ranks taking the other branch never "
+                f"enter the collective and the gang hangs")
+        if self._traced_depth:
+            dotted = _dotted(node.func)
+            if (t in _SIDE_EFFECT_NAMES or
+                (_root(node.func) in _SIDE_EFFECT_MODULES and
+                 not any(dotted.startswith(ok)
+                         for ok in _SIDE_EFFECT_OK))):
+                self._add(
+                    "trace-time-side-effect", node,
+                    f"{dotted or t!r} inside a jax-traced body fires "
+                    f"at TRACE time only (compiled kernels are cached "
+                    f"and replay without it)")
+        if t == "retry_call" and node.args:
+            self._check_retry_target(node)
+        # dict.setdefault-style mutations via call are handled in the
+        # mutation visitors below; nothing else to do here
+        self.generic_visit(node)
+
+    def _check_retry_target(self, node: ast.Call) -> None:
+        target = node.args[0]
+        body: Optional[ast.AST] = None
+        if isinstance(target, ast.Lambda):
+            body = target
+        elif isinstance(target, ast.Name):
+            for scope in reversed(self._local_defs):
+                if target.id in scope:
+                    body = scope[target.id]
+                    break
+        if body is None:
+            return
+        for n in ast.walk(body):
+            if isinstance(n, ast.Call):
+                meth = _terminal(n.func)
+                if meth in _NONIDEMPOTENT and \
+                        isinstance(n.func, ast.Attribute):
+                    self._add(
+                        "retry-non-idempotent", node,
+                        f"retry envelope wraps non-idempotent "
+                        f"`.{meth}(...)`: a transient failure after "
+                        f"the effect lands replays it (duplicate "
+                        f"write)")
+                    return
+
+    # -- shared-state mutation --------------------------------------------
+
+    def _mutation(self, node, name: str, how: str) -> None:
+        if not self.info.locks:           # module has no threads/locks
+            return
+        if not self._func:                # module top level: init time
+            return
+        if self._locks_held:
+            return
+        self._add(
+            "unlocked-shared-state", node,
+            f"module-global {name!r} {how} without holding any of "
+            f"this module's locks "
+            f"({', '.join(sorted(self.info.locks))})")
+
+    def visit_Global(self, node: ast.Global):
+        # remember rebindable globals for this function scope
+        self._global_decls = getattr(self, "_global_decls", {})
+        self._global_decls.setdefault(".".join(self._func),
+                                      set()).update(node.names)
+        self.generic_visit(node)
+
+    def _rebinds_global(self, name: str) -> bool:
+        decls = getattr(self, "_global_decls", {})
+        return name in decls.get(".".join(self._func), set())
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._check_store(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_store(node.target, node)
+        self.generic_visit(node)
+
+    def _check_store(self, target, node) -> None:
+        if isinstance(target, ast.Name):
+            if target.id in self.info.globals and \
+                    self._rebinds_global(target.id):
+                self._mutation(node, target.id, "rebound")
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Name) and \
+                    base.id in self.info.mutables:
+                self._mutation(node, base.id, "item-assigned")
+
+    def visit_Expr(self, node: ast.Expr):
+        # `_cache.update(...)`-style mutator method calls
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute):
+            base = v.func.value
+            if isinstance(base, ast.Name) and \
+                    base.id in self.info.mutables and \
+                    v.func.attr in _MUTATORS:
+                self._mutation(node, base.id,
+                               f"mutated via .{v.func.attr}()")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# suppressions / baseline
+# ---------------------------------------------------------------------------
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule set (None = all rules). A comment
+    suppresses its own line and the line below it."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        tokens = tokenize.generate_tokens(
+            iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = None
+            if m.group(1):
+                rules = {r.strip() for r in m.group(1).split(",")}
+            for line in (tok.start[0], tok.start[0] + 1):
+                prev = out.get(line, set())
+                out[line] = None if rules is None or prev is None \
+                    else prev | rules
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _is_suppressed(f: Finding,
+                   supp: Dict[int, Optional[Set[str]]]) -> bool:
+    if f.line not in supp:
+        return False
+    rules = supp[f.line]
+    return rules is None or f.rule in rules
+
+
+def load_baseline(path: str) -> List[tuple]:
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    return [(e["rule"], e["file"], e.get("func", ""), e["text"])
+            for e in raw if isinstance(e, dict)]
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    entries = [{"rule": f.rule, "file": f.path, "func": f.func,
+                "text": f.text} for f in findings]
+    with open(path, "w") as fh:
+        json.dump(entries, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "baseline.json")
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    """Lint one file; findings suppressed inline are dropped (counted
+    in stats)."""
+    root = root or os.path.dirname(path)
+    rel = os.path.relpath(path, root)
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=rel,
+                        line=e.lineno or 1, col=0, func="",
+                        text="", message=str(e))]
+    info = _ModuleInfo()
+    info.visit_Module(tree)
+    checker = _Checker(path, rel, source.splitlines(), info)
+    checker.visit(tree)
+    supp = _suppressions(source)
+    kept = []
+    for f in checker.findings:
+        if _is_suppressed(f, supp):
+            _stats["suppressed"] += 1
+        else:
+            kept.append(f)
+    _stats["files"] += 1
+    return kept
+
+
+def lint_paths(paths, root: Optional[str] = None) -> List[Finding]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files += [os.path.join(dirpath, fn)
+                          for fn in filenames if fn.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+    out: List[Finding] = []
+    for f in sorted(files):
+        out += lint_file(f, root=root)
+    return out
+
+
+def lint_package() -> List[Finding]:
+    """Lint the installed bodo_tpu package (what the CI gate runs)."""
+    return lint_paths([_PKG_DIR], root=os.path.dirname(_PKG_DIR))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m bodo_tpu.analysis",
+        description="shardcheck: SPMD safety lint over bodo_tpu/")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    args = ap.parse_args(argv)
+    _stats["runs"] += 1
+    if args.paths:
+        findings = lint_paths(args.paths, root=os.getcwd())
+    else:
+        findings = lint_package()
+    _stats["findings"] += len(findings)
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"shardcheck: wrote {len(findings)} baseline entries to "
+              f"{args.baseline}")
+        return 0
+    baseline = set() if args.no_baseline else \
+        set(load_baseline(args.baseline))
+    fresh = []
+    for f in findings:
+        if f.key() in baseline:
+            _stats["baselined"] += 1
+        else:
+            fresh.append(f)
+    for f in fresh:
+        print(f.render())
+    n_base = len(findings) - len(fresh)
+    print(f"shardcheck: {_stats['files']} files, "
+          f"{len(findings)} findings "
+          f"({n_base} baselined, {_stats['suppressed']} suppressed "
+          f"inline, {len(fresh)} new)")
+    return 1 if fresh else 0

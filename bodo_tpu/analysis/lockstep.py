@@ -1,0 +1,293 @@
+"""Runtime SPMD lockstep checker (shardcheck layer 3).
+
+Debug mode (`BODO_TPU_LOCKSTEP=1` / `set_config(lockstep=True)`): every
+host-level collective dispatch in relational.py's dispatchers
+(`_inject_collective`, the PR-2 fault-injection plumbing) is
+fingerprinted as `op@file:line` and assigned a per-process sequence
+number. Each process appends its (seq, fingerprint) stream to an
+append-only side-channel file in the gang's shared temp directory (the
+same directory that carries the spawn heartbeats), and cross-checks its
+peers' streams before proceeding:
+
+  * a peer that dispatched a DIFFERENT collective at the same sequence
+    number -> immediate :class:`LockstepError` naming both ranks and
+    both call sites (divergent control flow through a gang-scheduled
+    op — the Pathways failure class that otherwise hangs the gang);
+  * a peer that has NOT reached this sequence number within
+    `config.lockstep_timeout_s` -> :class:`LockstepError` naming the
+    lagging rank and its last-seen dispatch (a skipped collective or a
+    wedged process), in seconds instead of the 180s gang timeout.
+
+Single-process runs (or runs without a shared directory) still count
+and fingerprint dispatches — that is what the bench.py overhead suite
+measures — but have no peers to check.
+
+The checker is ~free when disabled: one config attribute read per
+dispatch. spawn.py exports BODO_TPU_LOCKSTEP_DIR pointing at each
+gang's fresh temp dir so seq numbers never collide with a previous
+gang's logs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from bodo_tpu.config import config
+
+_POLL_S = 0.02
+
+
+class LockstepError(RuntimeError):
+    """SPMD lockstep violation: a rank diverged at a host-level
+    collective dispatch. Carries the sequence number, this rank, the
+    peer rank, and both fingerprints (op@file:line).
+
+    NOTE: messages deliberately avoid the resilience layer's transient/
+    degradable marker strings — divergence is a correctness bug that
+    must surface, never be retried or degraded away (resilience.py
+    additionally excludes this class by name)."""
+
+    def __init__(self, message: str, seq: int = 0, rank: int = 0,
+                 peer: Optional[int] = None, site: str = "",
+                 peer_site: str = ""):
+        self.seq = seq
+        self.rank = rank
+        self.peer = peer
+        self.site = site
+        self.peer_site = peer_site
+        super().__init__(message)
+
+
+_lock = threading.Lock()
+_checker = None       # Checker | False (disabled after warning) | None
+_stats = {"collectives": 0, "wait_s": 0.0, "max_wait_s": 0.0,
+          "mismatches": 0, "timeouts": 0}
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+def reset() -> None:
+    """Drop the active checker and zero counters (tests; also called by
+    set_config when any lockstep knob changes so the next dispatch
+    rebinds to the new settings)."""
+    global _checker
+    with _lock:
+        if _checker:
+            _checker.close()
+        _checker = None
+        for k in _stats:
+            _stats[k] = 0 if k != "wait_s" and k != "max_wait_s" else 0.0
+
+
+def _rank() -> int:
+    v = os.environ.get("BODO_TPU_PROC_ID")
+    if v not in (None, ""):
+        return int(v)
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            return 0
+    return 0
+
+
+def _nprocs() -> int:
+    v = os.environ.get("BODO_TPU_NPROCS")
+    if v not in (None, ""):
+        return int(v)
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_count())
+        except Exception:
+            return 1
+    return 1
+
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _call_site() -> str:
+    """First stack frame OUTSIDE the bodo_tpu package (the user-level
+    call that led to this collective), as basename:lineno — stable
+    across ranks regardless of checkout path or cwd."""
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename
+        if not fname.startswith(_PKG_DIR):
+            return f"{os.path.basename(fname)}:{f.f_lineno}"
+        f = f.f_back
+    return "<internal>"
+
+
+def pre_collective(op: str) -> None:
+    """Record + cross-check one host-level collective dispatch. Called
+    by relational._inject_collective / shuffle_by_key right before the
+    sharded kernel dispatches. No-op unless config.lockstep."""
+    if not config.lockstep:
+        return
+    c = _get_checker()
+    if c is not None:
+        c.check(op, _call_site())
+
+
+def _get_checker() -> Optional["Checker"]:
+    global _checker
+    c = _checker
+    if c is not None:
+        return c or None  # False sentinel -> disabled
+    with _lock:
+        if _checker is not None:
+            return _checker or None
+        d = config.lockstep_dir
+        nprocs = _nprocs()
+        if nprocs > 1 and not d:
+            sys.stderr.write(
+                "bodo_tpu.lockstep: BODO_TPU_LOCKSTEP=1 in a multi-"
+                "process run but no BODO_TPU_LOCKSTEP_DIR shared "
+                "directory; lockstep checking disabled\n")
+            _checker = False
+            return None
+        _checker = Checker(d or None, _rank(), nprocs)
+        return _checker
+
+
+class _PeerLog:
+    """Incremental reader of one peer's append-only dispatch log."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._buf = ""
+        self._entries: Dict[int, str] = {}
+        self._last = 0
+
+    def _refresh(self) -> None:
+        try:
+            with open(self.path, "r") as f:
+                f.seek(self._pos)
+                data = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return
+        if not data:
+            return
+        self._buf += data
+        lines = self._buf.split("\n")
+        self._buf = lines.pop()  # partial trailing line (if any)
+        for line in lines:
+            if "\t" not in line:
+                continue
+            s, fp = line.split("\t", 1)
+            try:
+                seq = int(s)
+            except ValueError:
+                continue
+            self._entries[seq] = fp
+            self._last = max(self._last, seq)
+
+    def entry(self, seq: int) -> Optional[str]:
+        if seq not in self._entries:
+            self._refresh()
+        return self._entries.get(seq)
+
+    def last(self) -> str:
+        self._refresh()
+        if not self._last:
+            return "nothing (no collective dispatched yet)"
+        return f"#{self._last} {self._entries[self._last]}"
+
+
+class Checker:
+    """Per-process lockstep state: own sequence counter + log writer,
+    plus incremental readers over every peer's log."""
+
+    def __init__(self, dirpath: Optional[str], rank: int, nprocs: int):
+        self.dir = dirpath
+        self.rank = int(rank)
+        self.nprocs = int(nprocs)
+        self.seq = 0
+        self._mu = threading.Lock()
+        self._f = None
+        if dirpath:
+            try:
+                os.makedirs(dirpath, exist_ok=True)
+                self._f = open(
+                    os.path.join(dirpath, f"lockstep_{self.rank}.log"),
+                    "a")
+            except OSError as e:  # unusable dir: record-only mode
+                sys.stderr.write(
+                    f"bodo_tpu.lockstep: cannot open log in "
+                    f"{dirpath!r} ({e}); peer checking disabled\n")
+        self._peers: Dict[int, _PeerLog] = {}
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    def check(self, op: str, site: str) -> None:
+        fingerprint = f"{op}@{site}"
+        with self._mu:
+            self.seq += 1
+            seq = self.seq
+            if self._f is not None:
+                self._f.write(f"{seq}\t{fingerprint}\n")
+                self._f.flush()
+        with _lock:
+            _stats["collectives"] += 1
+        if self.nprocs <= 1 or self._f is None:
+            return
+        t0 = time.monotonic()
+        deadline = t0 + float(config.lockstep_timeout_s)
+        for peer in range(self.nprocs):
+            if peer == self.rank:
+                continue
+            plog = self._peers.get(peer)
+            if plog is None:
+                plog = self._peers[peer] = _PeerLog(os.path.join(
+                    self.dir, f"lockstep_{peer}.log"))
+            while True:
+                got = plog.entry(seq)
+                if got is not None:
+                    if got != fingerprint:
+                        with _lock:
+                            _stats["mismatches"] += 1
+                        raise LockstepError(
+                            f"SPMD lockstep divergence at dispatch "
+                            f"#{seq}: rank {self.rank} issued "
+                            f"{fingerprint} but rank {peer} issued "
+                            f"{got} — the ranks took different "
+                            f"control-flow paths into a gang-scheduled "
+                            f"op (this would have wedged the gang)",
+                            seq=seq, rank=self.rank, peer=peer,
+                            site=fingerprint, peer_site=got)
+                    break
+                if time.monotonic() >= deadline:
+                    with _lock:
+                        _stats["timeouts"] += 1
+                    raise LockstepError(
+                        f"SPMD lockstep divergence at dispatch #{seq} "
+                        f"({fingerprint}): rank {peer} did not reach "
+                        f"dispatch #{seq} within "
+                        f"{float(config.lockstep_timeout_s):.1f}s; its "
+                        f"last dispatch was {plog.last()} — rank "
+                        f"{peer} skipped the op or is wedged",
+                        seq=seq, rank=self.rank, peer=peer,
+                        site=fingerprint)
+                time.sleep(_POLL_S)
+        wait = time.monotonic() - t0
+        with _lock:
+            _stats["wait_s"] += wait
+            _stats["max_wait_s"] = max(_stats["max_wait_s"], wait)

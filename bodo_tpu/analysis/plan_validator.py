@@ -1,0 +1,342 @@
+"""Plan-graph distribution/shape validator (shardcheck layer 1).
+
+Type-checks the logical plan DAG before execution: every operator's
+inputs must resolve against its children's schemas, and the abstract
+distribution of each subtree must satisfy the operator's contract.
+Violations raise a structured :class:`PlanInvariantError` naming the
+node, the rule, and the path from the plan root — BEFORE any kernel
+traces or collectives dispatch, so a mis-typed plan fails in
+milliseconds instead of wedging a gang-scheduled pod (the Pathways
+divergent-collective failure class, arXiv:2203.12533).
+
+Abstract distribution lattice (host-side, data-independent):
+
+    REP   the subtree's result is always replicated on every process
+    DIST  the result MAY be row-sharded (1D) over the mesh data axis —
+          whether it actually is depends on runtime row counts
+          (physical._maybe_shard's shard_min_rows policy)
+
+The per-operator propagation rules live in two declarative tables:
+
+  * OP_DIST — the abstract output distribution of each logical node as
+    a function of its children's (what the *plan* may produce).
+  * RUNTIME_RESULT_DIST — the distribution the relational-layer kernel
+    actually RETURNS, for ops whose kernel result is pinned regardless
+    of input distribution (gather-based paths). `check_kernel_result`
+    cross-checks the real Table against this declaration at runtime, so
+    a future rewrite of a kernel's distribution behavior (e.g. the
+    planned shard-wise concat/append rebalance replacing the
+    gather-to-host union path, relational.py concat_tables) cannot
+    silently change typing: the rewrite must update the declaration —
+    and therefore this validator — in the same change.
+
+Entry points:
+
+    validate_plan(node)          full-DAG validation; returns root dist
+    dist_of(node)                abstract distribution of a subtree
+    validate_rewrite(orig, new)  AQE re-plans must preserve schema+dist
+    check_kernel_result(op, d)   runtime cross-check vs. declared dist
+
+`physical.execute` calls `validate_plan` automatically when
+`config.plan_validate` is on (default); `validate_plan` is also public
+API for plan-building frontends.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from bodo_tpu.plan.expr import expr_columns
+
+# abstract distribution lattice
+REP = "REP"
+DIST = "DIST"  # may be row-sharded (1D) at runtime
+
+_stats = {"plans": 0, "nodes": 0, "violations": 0, "kernel_checks": 0}
+
+
+class PlanInvariantError(TypeError):
+    """A plan (or a runtime kernel result) violates a distribution or
+    shape invariant. Carries the offending node, the rule name, and the
+    path from the plan root for structured handling."""
+
+    def __init__(self, message: str, node=None, rule: str = "",
+                 path: str = ""):
+        self.node = node
+        self.rule = rule
+        self.path = path
+        detail = message
+        if rule:
+            detail = f"[{rule}] {detail}"
+        if node is not None:
+            detail += f"\n  node: {node!r}"
+        if path:
+            detail += f"\n  path: {path}"
+        super().__init__(detail)
+
+
+def stats() -> dict:
+    return dict(_stats)
+
+
+def reset_stats() -> None:
+    for k in _stats:
+        _stats[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# distribution propagation
+# ---------------------------------------------------------------------------
+
+def _any_dist(child_dists) -> str:
+    return DIST if DIST in child_dists else REP
+
+
+# node class name -> rule computing output dist from child dists.
+# Sources are DIST (physical._maybe_shard may shard them); gather-based
+# ops (Reduce/Limit) pin to REP; reshard-after-gather ops (Union,
+# NonEquiJoin, Explode) are DIST even over all-REP children because
+# _maybe_shard re-shards their kernel's replicated result when it grows
+# past shard_min_rows.
+OP_DIST = {
+    "ReadParquet": lambda n, ds: DIST,
+    "ReadCsv": lambda n, ds: DIST,
+    "FromPandas": lambda n, ds: DIST,
+    "Projection": lambda n, ds: ds[0],
+    "Filter": lambda n, ds: ds[0],
+    "Aggregate": lambda n, ds: ds[0],
+    "Distinct": lambda n, ds: ds[0],
+    "Window": lambda n, ds: ds[0],
+    "RankWindow": lambda n, ds: ds[0],
+    "AggWindow": lambda n, ds: ds[0],
+    "Sort": lambda n, ds: ds[0],
+    "Join": lambda n, ds: _any_dist(ds),
+    "Reduce": lambda n, ds: REP,
+    "Limit": lambda n, ds: REP,
+    "Union": lambda n, ds: DIST,
+    "NonEquiJoin": lambda n, ds: DIST,
+    "Explode": lambda n, ds: DIST,
+}
+
+# what the relational-layer kernel RETURNS for ops whose result
+# distribution is pinned by the kernel's implementation strategy (all
+# currently gather-to-host paths). checked at runtime by
+# check_kernel_result; see module docstring for why this is declared.
+RUNTIME_RESULT_DIST = {
+    "union": REP,        # relational.concat_tables gathers 1D inputs
+    "head": REP,         # relational.head_table gathers
+    "reduce": REP,       # relational.reduce_table returns host scalars
+    "nonequi_join": REP,  # ops/nonequi runs on gathered inputs
+}
+
+
+def check_kernel_result(op: str, distribution: str) -> None:
+    """Cross-check a kernel's actual result distribution against its
+    RUNTIME_RESULT_DIST declaration (no-op for undeclared ops)."""
+    _stats["kernel_checks"] += 1
+    declared = RUNTIME_RESULT_DIST.get(op)
+    if declared is None:
+        return
+    # table-layer constants: "REP" / "1D"
+    actual = REP if distribution == "REP" else DIST
+    if declared == REP and actual != REP:
+        _stats["violations"] += 1
+        raise PlanInvariantError(
+            f"kernel {op!r} returned a {distribution}-distributed table "
+            f"but its declared result distribution is REP; if the kernel "
+            f"was rewritten to keep results sharded (e.g. shard-wise "
+            f"concat/append), update RUNTIME_RESULT_DIST and the "
+            f"operator's OP_DIST rule together",
+            rule="kernel-result-dist")
+
+
+# ---------------------------------------------------------------------------
+# per-node shape checks
+# ---------------------------------------------------------------------------
+
+def _err(node, path, rule, msg):
+    _stats["violations"] += 1
+    raise PlanInvariantError(msg, node=node, rule=rule, path=path)
+
+
+def _check_refs(node, path, exprs_cols, child, what: str):
+    """Expression/key column references must resolve in the child schema
+    ("*" is the row-UDF wildcard: reads the whole row)."""
+    missing = {c for c in exprs_cols if c != "*"} - set(child.schema)
+    if missing:
+        _err(node, path, "unknown-column",
+             f"{type(node).__name__} {what} references columns "
+             f"{sorted(missing)} not in child schema "
+             f"{sorted(child.schema)}")
+
+
+def _is_string(dtype) -> bool:
+    return getattr(dtype, "kind", None) in ("string",) or \
+        getattr(dtype, "name", "") == "string"
+
+
+def _check_node(node, path: str) -> None:
+    name = type(node).__name__
+    if name in ("ReadParquet", "ReadCsv", "FromPandas"):
+        if node.children:
+            _err(node, path, "arity", f"{name} must be a leaf")
+        return
+    kids = node.children
+    if name == "Projection":
+        for n, e in node.exprs:
+            _check_refs(node, path, expr_columns(e), kids[0],
+                        f"expr {n!r}")
+    elif name == "Filter":
+        _check_refs(node, path, expr_columns(node.predicate), kids[0],
+                    "predicate")
+        if set(node.schema) != set(kids[0].schema):
+            _err(node, path, "schema-drift",
+                 "Filter must preserve its child's schema")
+    elif name == "Aggregate":
+        _check_refs(node, path, set(node.keys), kids[0], "keys")
+        _check_refs(node, path, {c for c, _, _ in node.aggs}, kids[0],
+                    "agg inputs")
+        if not node.keys:
+            _err(node, path, "empty-keys",
+                 "Aggregate with no keys must be a Reduce")
+    elif name == "Reduce":
+        _check_refs(node, path, {c for c, _, _ in node.aggs}, kids[0],
+                    "agg inputs")
+    elif name == "Distinct":
+        _check_refs(node, path, set(node.subset), kids[0], "subset")
+    elif name == "Sort":
+        _check_refs(node, path, set(node.by), kids[0], "sort keys")
+        if len(node.by) != len(node.ascending):
+            _err(node, path, "sort-spec",
+                 f"{len(node.by)} sort keys but "
+                 f"{len(node.ascending)} ascending flags")
+    elif name == "Limit":
+        if not isinstance(node.n, int) or node.n < 0:
+            _err(node, path, "limit-n",
+                 f"Limit n must be a non-negative int, got {node.n!r}")
+    elif name in ("Window", "RankWindow", "AggWindow"):
+        if name != "Window":
+            _check_refs(node, path, set(node.partition_by), kids[0],
+                        "partition_by")
+            _check_refs(node, path, set(node.order_by), kids[0],
+                        "order_by")
+        cols = {s[0] for s in node.specs} if name == "Window" else \
+            {s[1] for s in node.specs} if name == "AggWindow" else set()
+        _check_refs(node, path, {c for c in cols if isinstance(c, str)},
+                    kids[0], "spec inputs")
+    elif name == "Union":
+        first = list(kids[0].schema)
+        for c in kids[1:]:
+            if list(c.schema) != first:
+                _err(node, path, "union-schema",
+                     f"Union children disagree on schema: {first} vs "
+                     f"{list(c.schema)}")
+    elif name == "Join":
+        if node.how != "cross":
+            if not node.left_on or \
+                    len(node.left_on) != len(node.right_on):
+                _err(node, path, "join-keys",
+                     f"Join needs matching non-empty key lists, got "
+                     f"left_on={node.left_on} right_on={node.right_on}")
+            _check_refs(node, path, set(node.left_on), kids[0],
+                        "left_on")
+            _check_refs(node, path, set(node.right_on), kids[1],
+                        "right_on")
+            for lk, rk in zip(node.left_on, node.right_on):
+                lt, rt = kids[0].schema[lk], kids[1].schema[rk]
+                # conservative: only a string/non-string mismatch is
+                # certainly wrong (numerics promote, dates compare)
+                if _is_string(lt) != _is_string(rt):
+                    _err(node, path, "join-key-dtype",
+                         f"join key dtype mismatch: {lk}:{lt.name} vs "
+                         f"{rk}:{rt.name}")
+    elif name == "NonEquiJoin":
+        overlap = set(kids[0].schema) & set(kids[1].schema)
+        if overlap:
+            _err(node, path, "nonequi-names",
+                 f"NonEquiJoin children share column names {overlap}")
+        combined = set(kids[0].schema) | set(kids[1].schema)
+        missing = {c for c in expr_columns(node.pred) if c != "*"} \
+            - combined
+        if missing:
+            _err(node, path, "unknown-column",
+                 f"NonEquiJoin predicate references {sorted(missing)} "
+                 f"outside the combined schema")
+    elif name == "Explode":
+        if node.column not in kids[0].schema:
+            _err(node, path, "unknown-column",
+                 f"Explode column {node.column!r} not in child schema")
+        elif getattr(kids[0].schema[node.column], "kind", "") != "list":
+            _err(node, path, "explode-dtype",
+                 f"Explode input {node.column!r} is not a list column")
+
+
+# ---------------------------------------------------------------------------
+# walk
+# ---------------------------------------------------------------------------
+
+def _validate(node, path: str, onpath: set,
+              memo: Dict[int, str]) -> str:
+    got = memo.get(id(node))
+    if got is not None:
+        return got
+    if id(node) in onpath:
+        _err(node, path, "cycle", "plan DAG contains a cycle")
+    onpath.add(id(node))
+    _stats["nodes"] += 1
+    name = type(node).__name__
+    sub = f"{path}/{name}" if path else name
+    kid_dists = [_validate(c, sub, onpath, memo)
+                 for c in node.children]
+    _check_node(node, sub)
+    rule = OP_DIST.get(name)
+    # unknown/future node types: validated children, permissive DIST
+    d = rule(node, kid_dists) if rule is not None else DIST
+    onpath.discard(id(node))
+    memo[id(node)] = d
+    return d
+
+
+def validate_plan(node) -> str:
+    """Validate a whole logical plan; returns the root's abstract
+    distribution (REP/DIST). Raises PlanInvariantError on the first
+    violation. Cheap: one DFS, no execution, results memoized per call
+    (shared sub-DAGs validate once)."""
+    _stats["plans"] += 1
+    return _validate(node, "", set(), {})
+
+
+def dist_of(node) -> str:
+    """Abstract distribution of a subtree without full validation."""
+    name = type(node).__name__
+    rule = OP_DIST.get(name)
+    if rule is None:
+        return DIST
+    return rule(node, [dist_of(c) for c in node.children])
+
+
+def validate_rewrite(orig, repl) -> None:
+    """AQE re-plans (plan/adaptive.py join re-ordering) must preserve
+    the original subtree's schema (names+dtypes, in order) and abstract
+    distribution — a rewrite that widens REP to DIST (or reorders
+    columns) would silently change downstream typing."""
+    validate_plan(repl)
+    if list(orig.schema) != list(repl.schema):
+        _stats["violations"] += 1
+        raise PlanInvariantError(
+            f"AQE rewrite changed the output schema: "
+            f"{list(orig.schema)} -> {list(repl.schema)}",
+            node=repl, rule="rewrite-schema")
+    for n in orig.schema:
+        if orig.schema[n] is not repl.schema[n] and \
+                orig.schema[n].name != repl.schema[n].name:
+            _stats["violations"] += 1
+            raise PlanInvariantError(
+                f"AQE rewrite changed dtype of {n!r}: "
+                f"{orig.schema[n].name} -> {repl.schema[n].name}",
+                node=repl, rule="rewrite-dtype")
+    if dist_of(orig) == REP and dist_of(repl) != REP:
+        _stats["violations"] += 1
+        raise PlanInvariantError(
+            "AQE rewrite widened a replicated subtree to a possibly "
+            "sharded one", node=repl, rule="rewrite-dist")

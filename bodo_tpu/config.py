@@ -271,6 +271,35 @@ class Config:
     spawn_gang_retries: int = field(
         default_factory=lambda: _env_int("BODO_TPU_SPAWN_GANG_RETRIES", 1)
     )
+    # -- shardcheck / SPMD safety (analysis/) --------------------------------
+    # Validate every logical plan against the distribution/shape
+    # invariants before execution (analysis/plan_validator.py).
+    # Violations raise PlanInvariantError instead of wrong answers or a
+    # wedged gang; cost is one host-side DFS per plan.
+    plan_validate: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_PLAN_VALIDATE", True)
+    )
+    # Lockstep debug mode (analysis/lockstep.py): fingerprint every
+    # host-level collective dispatch and cross-check sequence/site
+    # against peer processes, converting divergent control flow into a
+    # structured LockstepError in seconds instead of a gang hang.
+    # set_config(lockstep=...) exports BODO_TPU_LOCKSTEP so spawned
+    # workers inherit the mode.
+    lockstep: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_LOCKSTEP", False)
+    )
+    # Shared directory for the per-rank dispatch logs (spawn.py points
+    # this at each gang's fresh temp dir; multi-process runs without it
+    # disable checking with a warning).
+    lockstep_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_LOCKSTEP_DIR", "")
+    )
+    # How long a rank waits for its peers to reach the same dispatch
+    # sequence number before declaring divergence.
+    lockstep_timeout_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_LOCKSTEP_TIMEOUT",
+                                           10.0)
+    )
 
 
 config = Config()
@@ -319,6 +348,22 @@ def set_config(**kwargs) -> None:
             # the new directory
             from bodo_tpu.runtime import stats_store
             stats_store.reset_store()
+        if k in ("lockstep", "lockstep_dir", "lockstep_timeout_s"):
+            # drop the live checker so the next dispatch rebinds to the
+            # new mode/dir; export the env (like faults) so spawned
+            # workers inherit the debug mode
+            from bodo_tpu.analysis import lockstep as _lockstep
+            _lockstep.reset()
+            if k == "lockstep":
+                if v:
+                    os.environ["BODO_TPU_LOCKSTEP"] = "1"
+                else:
+                    os.environ.pop("BODO_TPU_LOCKSTEP", None)
+            if k == "lockstep_dir":
+                if v:
+                    os.environ["BODO_TPU_LOCKSTEP_DIR"] = v
+                else:
+                    os.environ.pop("BODO_TPU_LOCKSTEP_DIR", None)
 
 
 def set_verbose_level(level: int) -> None:

@@ -357,6 +357,9 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
             _clear_part_dir(path)  # prior sharded write left a directory
             os.rmdir(path)
         at = table_to_arrow(t)
+        # idempotent: a retry replays a whole-file overwrite of the same
+        # path, so a torn first attempt is simply rewritten
+        # shardcheck: ignore[retry-non-idempotent]
         resilience.retry_call(lambda: pq.write_table(at, path),
                               label="write_parquet", point="io.write")
         return
@@ -396,6 +399,9 @@ def write_parquet(t: Table, path: str, index: bool = False) -> None:
         piece = _host_piece(t, data, n)
         at = table_to_arrow(piece)
         dest = os.path.join(path, f"part-{shard:05d}.parquet")
+        # idempotent: the part path is deterministic per shard and the
+        # retry overwrites the whole file, never appends
+        # shardcheck: ignore[retry-non-idempotent]
         resilience.retry_call(lambda: pq.write_table(at, dest),
                               label="write_parquet", point="io.write")
 

@@ -43,7 +43,9 @@ from bodo_tpu.runtime.resilience import maybe_inject as _inject
 # time (these run inside shard_map/jit bodies, and compiled kernels are
 # cached) — it arms chaos for fresh compilations. The per-call host-level
 # `collective` point lives at the distributed-op dispatchers in
-# relational.py, which is what stage-degradation tests use.
+# relational.py, which is what stage-degradation tests use. The
+# shardcheck trace-time-side-effect lint flags exactly this pattern;
+# the inline suppressions below mark it as the one intentional case.
 
 if hasattr(lax, "axis_size"):
     axis_size = lax.axis_size
@@ -65,17 +67,17 @@ def size(axis: Optional[str] = None) -> int:
 
 
 def dist_sum(x, axis: Optional[str] = None):
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     return lax.psum(x, axis or config.data_axis)
 
 
 def dist_max(x, axis: Optional[str] = None):
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     return lax.pmax(x, axis or config.data_axis)
 
 
 def dist_min(x, axis: Optional[str] = None):
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     return lax.pmin(x, axis or config.data_axis)
 
 
@@ -83,7 +85,7 @@ def dist_exscan_sum(x, axis: Optional[str] = None):
     """Exclusive prefix sum over shards (MPI_Exscan analogue; used for
     1D_Var offset bookkeeping and dist_cumsum — reference
     bodo/libs/distributed_api.py:664, :2205)."""
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     ax = axis or config.data_axis
     n = axis_size(ax)
     gathered = lax.all_gather(x, ax)            # [n, ...]
@@ -97,7 +99,7 @@ def all_gather_rows(x, axis: Optional[str] = None):
     """Concatenate each shard's rows in rank order: [cap,...] -> [S*cap,...]
     (MPI_Allgatherv analogue; padding travels with the shard and is
     resolved by the caller via per-shard row counts)."""
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     ax = axis or config.data_axis
     return lax.all_gather(x, ax, tiled=True)
 
@@ -108,7 +110,7 @@ def all_to_all_rows(x, axis: Optional[str] = None):
     concatenated in rank order. This is the alltoallv of the reference's
     shuffle (bodo/libs/_shuffle.h:41, streaming/_shuffle.h:777) with
     capacity-padded buckets instead of variable counts."""
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     ax = axis or config.data_axis
     return lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=True)
 
@@ -117,7 +119,7 @@ def ring_shift(x, shift: int = 1, axis: Optional[str] = None):
     """Send local block to rank+shift (mod S): the neighbor-exchange used
     for rolling-window halos (reference bodo/hiframes/rolling.py,
     bodo/libs/parallel_ops.py) — lax.ppermute over the ring."""
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     ax = axis or config.data_axis
     n = axis_size(ax)
     perm = [(i, (i + shift) % n) for i in range(n)]
@@ -127,7 +129,7 @@ def ring_shift(x, shift: int = 1, axis: Optional[str] = None):
 def bcast_from(x, root: int = 0, axis: Optional[str] = None):
     """Broadcast shard `root`'s block to all shards (MPI_Bcast analogue,
     reference bodo/libs/distributed_api.py:2578)."""
-    _inject("collective")
+    _inject("collective")  # shardcheck: ignore[trace-time-side-effect]
     ax = axis or config.data_axis
     gathered = lax.all_gather(x, ax)
     return gathered[root]

@@ -86,7 +86,8 @@ def set_estimate_injector(fn) -> None:
     tests can assert each adaptive correction actually triggers. None
     uninstalls."""
     global _injector
-    _injector = fn
+    with _lock:
+        _injector = fn
 
 
 # ---------------------------------------------------------------------------

@@ -37,6 +37,13 @@ def execute(node: L.Node, optimize_first: bool = True) -> Table:
         node = optimize(node)
         if config.dump_plans:
             _dump(node)
+    if config.plan_validate:
+        # shardcheck layer 1: reject ill-typed plans (distribution /
+        # schema invariant violations) before any kernel traces or
+        # collectives dispatch — PlanInvariantError in milliseconds
+        # instead of wrong answers or a wedged gang
+        from bodo_tpu.analysis.plan_validator import validate_plan
+        validate_plan(node)
     return _exec(node)
 
 
@@ -222,7 +229,13 @@ def _exec_inner(node: L.Node) -> Table:
         if repl is not None:
             # observed leaf cardinalities changed the join order:
             # execute the re-planned subtree (leaf results are memoized,
-            # so only the joins themselves run)
+            # so only the joins themselves run). The rewrite must
+            # preserve the original subtree's schema and abstract
+            # distribution — validated before anything executes.
+            if config.plan_validate:
+                from bodo_tpu.analysis.plan_validator import \
+                    validate_rewrite
+                validate_rewrite(node, repl)
             return _exec(repl)
         left = _exec(node.left)
         right = _exec(node.right)

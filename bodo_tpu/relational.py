@@ -123,16 +123,24 @@ def _governed(name):
     return deco
 
 
-def _inject_collective(*tables: Table) -> None:
+def _inject_collective(*tables: Table, op: str = "collective") -> None:
     """Host-level `collective` fault point at the sharded-op dispatchers.
 
     The hooks inside parallel/collectives.py fire at trace time only
     (kernels are cached), so chaos tests arm THIS point: it fires once
-    per distributed groupby/sort/join call when any input is ONED."""
+    per distributed groupby/sort/join call when any input is ONED.
+
+    Under BODO_TPU_LOCKSTEP the dispatch is additionally fingerprinted
+    (`op` + user call site + sequence number) and cross-checked against
+    peer processes, so a rank that diverged into a different collective
+    raises a structured LockstepError instead of wedging the gang
+    (analysis/lockstep.py)."""
     if any(isinstance(x, Table) and x.distribution == ONED
            and x.num_shards > 1 for x in tables):
         from bodo_tpu.runtime.resilience import maybe_inject
         maybe_inject("collective")
+        from bodo_tpu.analysis import lockstep
+        lockstep.pre_collective(op)
 
 
 @_traced
@@ -683,7 +691,7 @@ def groupby_agg(t: Table, keys: Sequence[str],
     multi-operand lexicographic sort and the shuffle moves one key
     column (the reference gets a similar effect from its categorical/
     sorted-key exscan strategies, bodo/libs/groupby/)."""
-    _inject_collective(t)
+    _inject_collective(t, op="groupby_agg")
     keys = list(keys)
     # normalize op aliases: median/quantile_<q> → the "q:<q>" kernel op
     def _norm(op: str) -> str:
@@ -1105,7 +1113,7 @@ def _groupby_agg_colocated(t: Table, keys, aggs) -> Table:
 @_governed("sort_table")
 def sort_table(t: Table, by: Sequence[str], ascending=None,
                na_last: bool = True) -> Table:
-    _inject_collective(t)
+    _inject_collective(t, op="sort_table")
     by = list(by)
     local = _as_local(t)
     if local is not None:
@@ -1167,7 +1175,7 @@ def join_tables(left: Table, right: Table, left_on: Sequence[str],
     _nested_loop_join_impl.cpp for cross). null_equal=True gives pandas
     merge semantics (NaN keys match each other); SQL passes False (null
     keys never match, the reference's is_na_equal=false join mode)."""
-    _inject_collective(left, right)
+    _inject_collective(left, right, op="join_tables")
     left_on, right_on = list(left_on), list(right_on)
     assert how in ("inner", "left", "right", "outer", "cross"), \
         f"join how={how} not supported"
@@ -2487,7 +2495,20 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
     """Hash-partition rows over the mesh by key columns (the standalone
     shuffle_table analogue, reference bodo/libs/_shuffle.h:41). Rows with
     equal keys land on the same shard."""
-    assert t.distribution == ONED
+    if t.distribution != ONED:
+        from bodo_tpu.analysis.plan_validator import PlanInvariantError
+        raise PlanInvariantError(
+            "shuffle_by_key over a replicated table: the shuffle "
+            "contract requires a row-sharded (1D) input — shard the "
+            "table first (physical._maybe_shard) or keep the whole op "
+            "on the replicated path", rule="shuffle-needs-1d")
+    # lockstep fingerprint only — no maybe_inject here: the `collective`
+    # fault point fires at the groupby/sort/join dispatchers above this
+    # call, and adding a second firing site would shift chaos tests'
+    # nth-call counting
+    if t.num_shards > 1:
+        from bodo_tpu.analysis import lockstep
+        lockstep.pre_collective("shuffle_by_key")
     from bodo_tpu.plan import adaptive
     adaptive.observe_shuffle(t, key_cols)
     m = mesh_mod.get_mesh()
@@ -2547,7 +2568,12 @@ def concat_tables(tables: Sequence[Table]) -> Table:
     string dictionaries are unified; numeric dtypes promote.
 
     TODO(next round): shard-wise append + rebalance instead of the
-    gather-to-host path (keeps large unions device-resident)."""
+    gather-to-host path (keeps large unions device-resident). The
+    current gather path's REP result is a DECLARED invariant
+    (analysis/plan_validator.RUNTIME_RESULT_DIST["union"], cross-checked
+    below): the shard-wise rewrite must update that declaration and
+    Union's OP_DIST propagation rule in the same change, or the check
+    at the bottom of this function fires."""
     assert tables
     names = tables[0].names
     parts = [t.gather() if t.distribution == ONED else t for t in tables]
@@ -2596,7 +2622,10 @@ def concat_tables(tables: Sequence[Table]) -> Table:
             valid = jnp.zeros((cap,), dtype=bool)
             valid = valid.at[:total].set(jnp.concatenate(valids))
         cols[n] = Column(data, valid, out_dtype, dictionary)
-    return Table(cols, total, REP, None)
+    out = Table(cols, total, REP, None)
+    from bodo_tpu.analysis.plan_validator import check_kernel_result
+    check_kernel_result("union", out.distribution)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -179,10 +179,17 @@ class HostBufferPool:
 
 
 _default: Optional[HostBufferPool] = None
+_default_lock = threading.Lock()
 
 
 def default_pool() -> HostBufferPool:
+    """Shared process-wide pool. Double-checked under its own lock:
+    spill/restore paths reach here from comptroller worker threads, and
+    two racing first calls would each build (and leak) a native pool +
+    spill directory."""
     global _default
     if _default is None:
-        _default = HostBufferPool()
+        with _default_lock:
+            if _default is None:
+                _default = HostBufferPool()
     return _default

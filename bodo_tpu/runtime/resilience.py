@@ -219,12 +219,17 @@ def _ensure_armed() -> List[_Fault]:
     if _armed is None:
         spec = _cfg("faults", "BODO_TPU_FAULTS", "", str)
         try:
-            _armed = parse_faults(spec)
+            faults = parse_faults(spec)
         except ValueError:
-            _armed = []
+            faults = []
             sys.stderr.write(
                 f"bodo_tpu.resilience: ignoring malformed "
                 f"BODO_TPU_FAULTS={spec!r}\n")
+        # publish under the lock: a concurrent arm()/disarm() must
+        # never lose its spec to this lazy env-arming racing it
+        with _lock:
+            if _armed is None:
+                _armed = faults
     return _armed
 
 
@@ -272,6 +277,17 @@ def maybe_inject(point: str) -> None:
 # transient-error taxonomy
 # ---------------------------------------------------------------------------
 
+# shardcheck analysis errors (by class name — this module must stay
+# stdlib-only and cannot import bodo_tpu.analysis): correctness bugs
+# whose messages mention collectives, so substring taxonomies below
+# would otherwise retry or degrade them away instead of surfacing them
+_ANALYSIS_ERRORS = ("LockstepError", "PlanInvariantError")
+
+
+def _is_analysis_error(exc: BaseException) -> bool:
+    return type(exc).__name__ in _ANALYSIS_ERRORS
+
+
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 _COORD_MARKERS = (
     "DEADLINE_EXCEEDED", "UNAVAILABLE", "failed to connect",
@@ -294,8 +310,10 @@ def is_resource_exhausted(exc: BaseException) -> bool:
 def classify_transient(exc: BaseException) -> Optional[str]:
     """Category name when `exc` looks transient (worth retrying), else
     None. Injected `FaultInjected` faults are NOT transient — to test
-    the retry path, inject a real transient class (e.g. OSError)."""
-    if isinstance(exc, FaultInjected):
+    the retry path, inject a real transient class (e.g. OSError).
+    Shardcheck analysis errors (LockstepError/PlanInvariantError) are
+    never transient: they report divergence bugs, not flake."""
+    if isinstance(exc, FaultInjected) or _is_analysis_error(exc):
         return None
     if is_resource_exhausted(exc):
         return "resource_exhausted"
@@ -324,7 +342,13 @@ def classify_transient_text(text: str) -> Optional[str]:
 def is_degradable(exc: BaseException) -> bool:
     """True when a stage failure should trigger replicated re-execution:
     an injected `collective` fault, or a non-OOM internal/collective
-    runtime error from a sharded computation."""
+    runtime error from a sharded computation. Shardcheck analysis
+    errors are excluded by class name BEFORE the marker matching: a
+    LockstepError's message names the diverging collective, and
+    degrading it to a replicated re-run would mask the divergence bug
+    it exists to surface."""
+    if _is_analysis_error(exc):
+        return False
     if isinstance(exc, FaultInjected):
         return exc.point == "collective"
     if is_resource_exhausted(exc):
@@ -480,7 +504,8 @@ def start_heartbeat(path: str, interval_s: Optional[float] = None
         interval_s = _cfg("spawn_hb_interval_s",
                           "BODO_TPU_SPAWN_HB_INTERVAL", 0.5, float)
     stop = threading.Event()
-    _hb_stop = stop
+    with _lock:
+        _hb_stop = stop
 
     def _beat():
         while not stop.is_set():

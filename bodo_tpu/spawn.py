@@ -213,6 +213,12 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                     "BODO_TPU_PROC_ID": str(i),
                     "BODO_TPU_RESIL_PATH": resil_path,
                     "BODO_TPU_HB_PATH": hb_path,
+                    # lockstep side-channel logs share the gang temp
+                    # dir (fresh per gang, so sequence numbers never
+                    # collide with a previous gang's logs); the mode
+                    # itself is armed via BODO_TPU_LOCKSTEP, inherited
+                    # from the parent environment
+                    "BODO_TPU_LOCKSTEP_DIR": d,
                     "JAX_PLATFORMS": "cpu",
                     "PYTHONPATH": pkg_root + os.pathsep +
                     env.get("PYTHONPATH", ""),

@@ -69,11 +69,14 @@ def dump(path: Optional[str] = None) -> str:
     per-operator granted/peak/spilled bytes, a `resilience` section with
     fault/retry/degradation counters, an `aqe` section with adaptive
     decision counters + q-error summary, an `io` section with prefetch
-    decode/stall/overlap and footer-cache counters, and `compile_cache`
-    hit/miss counts when the persistent jit cache is active."""
+    decode/stall/overlap and footer-cache counters, an `analysis`
+    section with the shardcheck plan-validator/lint/lockstep counters,
+    and `compile_cache` hit/miss counts when the persistent jit cache
+    is active."""
     out = {"traceEvents": list(_events), "displayTimeUnit": "ms",
            "memory": memory_stats(), "resilience": resilience_stats(),
-           "aqe": aqe_stats(), "io": io_stats()}
+           "aqe": aqe_stats(), "io": io_stats(),
+           "analysis": analysis_stats()}
     cc = compile_cache_stats()
     if cc["hits"] or cc["misses"]:
         out["compile_cache"] = cc
@@ -110,6 +113,15 @@ def io_stats() -> dict:
     return io_pool.io_stats()
 
 
+def analysis_stats() -> dict:
+    """Shardcheck snapshot: plan-validator plans/nodes/violations,
+    lint run counters, and lockstep dispatch/wait/divergence counters
+    (analysis/)."""
+    from bodo_tpu.analysis import lint, lockstep, plan_validator
+    return {"plan_validator": plan_validator.stats(),
+            "lint": lint.stats(), "lockstep": lockstep.stats()}
+
+
 # persistent-compile-cache observability: jax's monitoring module emits
 # /jax/compilation_cache/cache_hits|cache_misses events when
 # jax_compilation_cache_dir is set; we fold them into hit/miss counters
@@ -123,8 +135,12 @@ def install_compile_cache_listener() -> None:
     profile can report persistent jit-cache hits/misses. Safe to call on
     jax builds without the monitoring hooks (silently does nothing)."""
     global _cc_installed
-    if _cc_installed:
-        return
+    # check-and-set under the lock: two racing installers would
+    # register two listeners and double-count every cache event
+    with _cc_lock:
+        if _cc_installed:
+            return
+        _cc_installed = True
     try:
         from jax._src import monitoring
 
@@ -137,7 +153,6 @@ def install_compile_cache_listener() -> None:
                     _cc_counts["misses"] += 1
 
         monitoring.register_event_listener(_listen)
-        _cc_installed = True
     except Exception:
         pass
 
@@ -155,7 +170,10 @@ def profile() -> Dict[str, dict]:
     appear under `resil:<counter>` keys; the pipelined-I/O layer
     contributes `io:*` counter rows plus time-valued `io:decode`,
     `io:stall`, and `io:overlap` rows (overlap = decode hidden behind
-    consumer compute)."""
+    consumer compute); shardcheck contributes `lint:*` counters
+    (plans validated/violations, lint findings) and a time-valued
+    `lockstep:check` row (dispatches fingerprinted + peer-wait
+    seconds) plus `lockstep:mismatches`/`lockstep:timeouts`."""
     out = {k: dict(v) for k, v in _agg.items()}
     for name, m in memory_stats().get("operators", {}).items():
         out[f"mem:{name}"] = {
@@ -182,10 +200,6 @@ def profile() -> Dict[str, dict]:
                 "stalls", "footer_hits", "footer_misses",
                 "parallel_units", "parallel_reads", "decode_batches"):
         counters[f"io:{key}"] = ios.get(key, 0)
-    for key, n in counters.items():
-        if n:
-            out[key] = {"count": int(n), "total_s": 0.0, "max_s": 0.0,
-                        "rows": 0}
     # time-valued io rows: decode seconds (worker-side), consumer stall
     # seconds, and the decode time hidden behind compute
     if ios.get("decode_batches"):
@@ -199,6 +213,26 @@ def profile() -> Dict[str, dict]:
                              "total_s": ios["overlap_s"], "max_s": 0.0,
                              "rows": 0,
                              "ratio": round(ios["overlap_ratio"], 4)}
+    an = analysis_stats()
+    pv = an["plan_validator"]
+    if pv.get("plans"):
+        counters["lint:plan_validated"] = pv["plans"]
+        counters["lint:plan_violations"] = pv["violations"]
+    if an["lint"].get("findings"):
+        counters["lint:findings"] = an["lint"]["findings"]
+    ls = an["lockstep"]
+    for key in ("mismatches", "timeouts"):
+        if ls.get(key):
+            counters[f"lockstep:{key}"] = ls[key]
+    for key, n in counters.items():
+        if n:
+            out[key] = {"count": int(n), "total_s": 0.0, "max_s": 0.0,
+                        "rows": 0}
+    # time-valued lockstep row: dispatches checked + peer-wait seconds
+    if ls.get("collectives"):
+        out["lockstep:check"] = {"count": int(ls["collectives"]),
+                                 "total_s": ls["wait_s"],
+                                 "max_s": ls["max_wait_s"], "rows": 0}
     qe = aq.get("q_error", {})
     if qe.get("count"):
         out["aqe:q_error"] = {

@@ -21,8 +21,13 @@ hung module dumps every thread's stack to stderr BEFORE the parent's
 kill lands, and the kill is reported as TIMEOUT(module) instead of a
 bare non-zero rc.
 
+The full-suite run also gates on the shardcheck SPMD lint
+(`python -m bodo_tpu.analysis`): any finding that is neither suppressed
+inline nor in analysis/baseline.json fails the run.
+
 Usage:
-    python runtests.py              # whole suite, grouped subprocesses
+    python runtests.py              # whole suite + shardcheck lint
+    python runtests.py lint         # shardcheck lint only
     python runtests.py -k pattern   # forwarded to pytest
     python runtests.py tests/test_sql.py tests/test_groupby.py
 """
@@ -70,12 +75,32 @@ def _group_modules(modules: list[str]) -> list[list[str]]:
     return groups
 
 
+def _run_lint() -> int:
+    """Shardcheck SPMD lint over the package; exit 0 only when every
+    finding is suppressed inline or baselined (analysis/baseline.json)."""
+    print("[lint] python -m bodo_tpu.analysis ... ", end="", flush=True)
+    t1 = time.time()
+    r = subprocess.run([sys.executable, "-m", "bodo_tpu.analysis"],
+                       cwd=_REPO, capture_output=True, text=True,
+                       timeout=300)
+    tail = (r.stdout.strip().splitlines() or [""])[-1]
+    print(f"{tail}  ({time.time() - t1:.0f}s)")
+    if r.returncode != 0:
+        sys.stdout.write(r.stdout[-4000:] + r.stderr[-2000:] + "\n")
+    return r.returncode
+
+
 def main(argv: list[str]) -> int:
+    want_lint = "lint" in argv
+    argv = [a for a in argv if a != "lint"]
     # a non-flag arg is a test module only if it points at a file; other
     # bare words (e.g. the pattern value after -k) pass through to pytest
     modules = [a for a in argv
                if not a.startswith("-") and os.path.exists(a)]
     passthrough = [a for a in argv if a not in modules]
+    if want_lint and not modules and not passthrough:
+        return 1 if _run_lint() else 0
+    full_suite = not modules
     if not modules:
         modules = sorted(glob.glob(os.path.join(_REPO, "tests",
                                                 "test_*.py")))
@@ -83,6 +108,9 @@ def main(argv: list[str]) -> int:
     t0 = time.time()
     failed: list[str] = []
     total = 0
+    if full_suite or want_lint:
+        if _run_lint() != 0:
+            failed.append("lint")
     for i, group in enumerate(groups):
         names = " ".join(os.path.relpath(m, _REPO) for m in group)
         label = names if len(group) == 1 else \

@@ -1,0 +1,668 @@
+"""shardcheck SPMD safety analyzer: plan validator, codebase lint,
+and runtime lockstep checker (bodo_tpu/analysis/).
+
+Covers the three layers end to end: mis-typed plans raise structured
+PlanInvariantErrors BEFORE execution; the ast lint catches the four
+SPMD hazard classes on fixture files and runs clean over the package
+itself; the lockstep checker converts collective divergence between
+processes into a structured LockstepError in seconds instead of a
+gang hang. Plus regression tests for the race-lint true positives
+fixed in this change (pool.default_pool, adaptive.set_estimate_injector)
+and the resilience-layer exclusions for analysis errors.
+"""
+
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bodo_tpu.analysis import lint, lockstep, plan_validator
+from bodo_tpu.analysis.lockstep import LockstepError
+from bodo_tpu.analysis.plan_validator import (DIST, REP, PlanInvariantError,
+                                              check_kernel_result, dist_of,
+                                              validate_plan,
+                                              validate_rewrite)
+from bodo_tpu.config import config
+from bodo_tpu.plan import logical as L
+from bodo_tpu.plan.expr import BinOp, ColRef, Lit
+
+
+def _src(n=16):
+    return L.FromPandas(pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64) % 4,
+        "v": np.arange(n, dtype=np.float64),
+        "s": [f"s{i % 3}" for i in range(n)]}))
+
+
+# ---------------------------------------------------------------------------
+# layer 1: plan validator
+# ---------------------------------------------------------------------------
+
+class TestPlanValidator:
+    def test_valid_plan_returns_dist(self, mesh8):
+        agg = L.Aggregate(_src(), ["k"], [("v", "sum", "vs")])
+        assert validate_plan(agg) == DIST
+        assert validate_plan(L.Limit(agg, 3)) == REP
+        assert dist_of(L.Reduce(_src(), [("v", "sum", "t")])) == REP
+
+    def test_mutated_aggregate_keys(self, mesh8):
+        agg = L.Aggregate(_src(), ["k"], [("v", "sum", "vs")])
+        agg.keys = ["nope"]  # simulate a buggy planner rewrite
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(agg)
+        assert ei.value.rule == "unknown-column"
+        assert "nope" in str(ei.value)
+        assert "Aggregate" in ei.value.path
+
+    def test_mutated_projection_expr(self, mesh8):
+        proj = L.Projection(_src(), [("out", ColRef("v"))])
+        proj.exprs = [("out", ColRef("gone"))]
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(proj)
+        assert ei.value.rule == "unknown-column"
+
+    def test_filter_schema_drift(self, mesh8):
+        f = L.Filter(_src(), BinOp(">", ColRef("v"), Lit(1.0)))
+        f.schema = {"v": f.schema["v"]}  # filters must not project
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(f)
+        assert ei.value.rule == "schema-drift"
+
+    def test_empty_aggregate_keys(self, mesh8):
+        agg = L.Aggregate(_src(), ["k"], [("v", "sum", "vs")])
+        agg.keys = []
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(agg)
+        assert ei.value.rule == "empty-keys"
+
+    def test_sort_spec_mismatch(self, mesh8):
+        srt = L.Sort(_src(), ["k"], [True])
+        srt.ascending = [True, False]
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(srt)
+        assert ei.value.rule == "sort-spec"
+
+    def test_limit_negative(self, mesh8):
+        lim = L.Limit(_src(), 5)
+        lim.n = -1
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(lim)
+        assert ei.value.rule == "limit-n"
+
+    def test_join_key_dtype_mismatch(self, mesh8):
+        j = L.Join(_src(), _src(), ["k"], ["k"])
+        j.left_on, j.right_on = ["s"], ["k"]  # string vs int64
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(j)
+        assert ei.value.rule == "join-key-dtype"
+
+    def test_join_empty_keys(self, mesh8):
+        j = L.Join(_src(), _src(), ["k"], ["k"])
+        j.left_on = []
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(j)
+        assert ei.value.rule == "join-keys"
+
+    def test_union_schema_mismatch(self, mesh8):
+        a, b = _src(), _src()
+        u = L.Union([a, b])
+        b.schema = {"other": b.schema["k"]}
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(u)
+        assert ei.value.rule == "union-schema"
+
+    def test_cycle_detection(self, mesh8):
+        f = L.Filter(_src(), BinOp(">", ColRef("v"), Lit(1.0)))
+        f.children = [f]  # corrupt graph must not hang the walk
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(f)
+        assert ei.value.rule == "cycle"
+
+    def test_shared_subtree_validates_once(self, mesh8):
+        plan_validator.reset_stats()
+        src = _src()
+        j = L.Join(src, src, ["k"], ["k"])  # diamond DAG, not a cycle
+        assert validate_plan(j) == DIST
+        assert plan_validator.stats()["nodes"] == 2  # src memoized
+
+    def test_kernel_result_dist_check(self):
+        plan_validator.reset_stats()
+        check_kernel_result("union", "REP")        # declared REP: ok
+        check_kernel_result("undeclared_op", "1D")  # not declared: ok
+        with pytest.raises(PlanInvariantError) as ei:
+            check_kernel_result("union", "1D")
+        assert ei.value.rule == "kernel-result-dist"
+        assert "RUNTIME_RESULT_DIST" in str(ei.value)
+        assert plan_validator.stats()["kernel_checks"] == 3
+
+    def test_validate_rewrite_schema_and_dist(self, mesh8):
+        src = _src()
+        agg = L.Aggregate(src, ["k"], [("v", "sum", "vs")])
+        other = L.Aggregate(src, ["k"], [("v", "mean", "vm")])
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_rewrite(agg, other)
+        assert ei.value.rule == "rewrite-schema"
+        # widening a replicated subtree to a possibly-sharded one:
+        # Limit(src, n) is REP with src's schema; src itself is DIST
+        lim = L.Limit(src, 4)
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_rewrite(lim, src)
+        assert ei.value.rule == "rewrite-dist"
+        validate_rewrite(agg, agg)  # identity rewrite always passes
+
+    def test_execute_validates_by_default(self, mesh8):
+        from bodo_tpu.plan.physical import execute
+        assert config.plan_validate  # on by default
+        plan_validator.reset_stats()
+        out = execute(L.Aggregate(_src(), ["k"], [("v", "sum", "vs")]))
+        assert out.nrows == 4
+        assert plan_validator.stats()["plans"] >= 1
+
+    def test_execute_rejects_broken_plan_before_running(self, mesh8):
+        from bodo_tpu.plan.physical import execute
+        agg = L.Aggregate(_src(), ["k"], [("v", "sum", "vs")])
+        agg.keys = ["nope"]
+        with pytest.raises(PlanInvariantError):
+            execute(agg, optimize_first=False)
+
+    def test_execute_validation_togglable(self, mesh8, monkeypatch):
+        from bodo_tpu.plan.physical import execute
+        monkeypatch.setattr(config, "plan_validate", False)
+        plan_validator.reset_stats()
+        execute(L.Limit(_src(), 2))
+        assert plan_validator.stats()["plans"] == 0
+
+    def test_shuffle_rep_guard(self, mesh8):
+        from bodo_tpu import relational
+        from bodo_tpu.table.table import Table
+        t = Table.from_pandas(pd.DataFrame({"k": np.arange(8)}))
+        assert t.distribution == "REP"
+        with pytest.raises(PlanInvariantError) as ei:
+            relational.shuffle_by_key(t, ["k"])
+        assert ei.value.rule == "shuffle-needs-1d"
+
+
+class TestValidatorSweep:
+    def test_distribution_sweep_validates_clean(self, mesh8):
+        """Property: every plan produced by a representative
+        groupby+join+sort pipeline across ALL distribution modes
+        type-checks with zero violations (check_func runs each mode
+        through physical.execute, which validates by default)."""
+        from tests.utils import check_func
+        plan_validator.reset_stats()
+
+        left = pd.DataFrame({"k": [0, 1, 2, 3] * 6,
+                             "v": np.arange(24, dtype=np.float64)})
+        right = pd.DataFrame({"k": [0, 1, 2, 3],
+                              "w": [10.0, 20.0, 30.0, 40.0]})
+
+        def fn(a, b):
+            m = a.merge(b, on="k")
+            g = m.groupby("k", as_index=False).agg({"v": "sum",
+                                                    "w": "max"})
+            return g.sort_values("k")
+
+        check_func(fn, [left, right])
+        st = plan_validator.stats()
+        assert st["plans"] >= 3  # at least one plan per mode
+        assert st["violations"] == 0
+
+    def test_tpch_plans_validate(self, mesh8):
+        """Every supported TPC-H query's plan (raw and optimized)
+        passes validation — the validator never false-positives on
+        real planner output."""
+        from bodo_tpu.plan.optimizer import optimize
+        from bodo_tpu.sql import BodoSQLContext
+        from bodo_tpu.workloads.tpch import QUERIES, UNSUPPORTED, gen_tpch
+        ctx = BodoSQLContext(gen_tpch(n_orders=120, seed=7))
+        plan_validator.reset_stats()
+        checked = 0
+        for qnum in sorted(QUERIES):
+            if qnum in UNSUPPORTED:
+                continue
+            plan = ctx.sql(QUERIES[qnum])._plan
+            validate_plan(plan)
+            validate_plan(optimize(plan))
+            checked += 1
+        assert checked >= 15
+        assert plan_validator.stats()["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# layer 2: codebase lint
+# ---------------------------------------------------------------------------
+
+def _lint_src(tmp_path, source: str):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(source))
+    return lint.lint_file(str(p), root=str(tmp_path))
+
+
+class TestLint:
+    def test_rank_divergent_collective(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def f(x, rank):
+                if rank == 0:
+                    return psum(x, "d")
+                return x
+        """)
+        assert [f.rule for f in got] == ["rank-divergent-collective"]
+        assert got[0].func == "f"
+
+    def test_rank_divergent_via_process_index(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import jax
+            def f(x):
+                if jax.process_index() == 0:
+                    return all_gather_rows(x)
+                return x
+        """)
+        assert [f.rule for f in got] == ["rank-divergent-collective"]
+
+    def test_collective_outside_divergence_ok(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def f(x, n):
+                if n > 3:          # data-dependent, not rank-dependent
+                    return psum(x, "d")
+                return x
+        """)
+        assert got == []
+
+    def test_trace_time_side_effect(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def body(x):
+                print("tracing")
+                return psum(x, "ax")
+        """)
+        assert [f.rule for f in got] == ["trace-time-side-effect"]
+
+    def test_smap_body_side_effect(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def body(x):
+                open("/tmp/marker", "w")
+                return x
+            out = smap(body, None, None)
+        """)
+        assert [f.rule for f in got] == ["trace-time-side-effect"]
+
+    def test_trace_safe_time_ok(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import time
+            def body(x):
+                t = time.monotonic()   # pure read, trace-safe
+                return psum(x, "ax")
+        """)
+        assert got == []
+
+    def test_retry_non_idempotent(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def save(f, data):
+                retry_call(lambda: f.write(data), label="save")
+        """)
+        assert [f.rule for f in got] == ["retry-non-idempotent"]
+
+    def test_retry_idempotent_ok(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def load(path):
+                return retry_call(lambda: read_file(path), label="load")
+        """)
+        assert got == []
+
+    def test_unlocked_shared_state(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import threading
+            _lock = threading.Lock()
+            _cache = {}
+
+            def put(k, v):
+                _cache[k] = v
+
+            def put_locked(k, v):
+                with _lock:
+                    _cache[k] = v
+
+            def rebind():
+                global _cache
+                _cache = {}
+        """)
+        assert sorted((f.rule, f.func) for f in got) == [
+            ("unlocked-shared-state", "put"),
+            ("unlocked-shared-state", "rebind")]
+
+    def test_lockless_module_out_of_scope(self, tmp_path):
+        # no locks defined -> module is single-threaded by design
+        got = _lint_src(tmp_path, """
+            _cache = {}
+            def put(k, v):
+                _cache[k] = v
+        """)
+        assert got == []
+
+    def test_suppression_comment(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import threading
+            _lock = threading.Lock()
+            _cache = {}
+            def put(k, v):
+                # shardcheck: ignore[unlocked-shared-state]
+                _cache[k] = v
+        """)
+        assert got == []
+
+    def test_suppression_wrong_rule_does_not_apply(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import threading
+            _lock = threading.Lock()
+            _cache = {}
+            def put(k, v):
+                # shardcheck: ignore[retry-non-idempotent]
+                _cache[k] = v
+        """)
+        assert [f.rule for f in got] == ["unlocked-shared-state"]
+
+    def test_baseline_roundtrip(self, tmp_path, monkeypatch, capsys):
+        mod = tmp_path / "legacy.py"
+        mod.write_text(textwrap.dedent("""
+            def f(x, rank):
+                if rank == 1:
+                    return dist_sum(x)
+                return x
+        """))
+        monkeypatch.chdir(tmp_path)
+        base = str(tmp_path / "base.json")
+        # fresh finding -> exit 1
+        assert lint.main(["legacy.py", "--baseline", base]) == 1
+        # grandfather it, then the same finding is baselined -> exit 0
+        assert lint.main(["legacy.py", "--baseline", base,
+                          "--write-baseline"]) == 0
+        assert lint.main(["legacy.py", "--baseline", base]) == 0
+        # baseline matching is line-number-insensitive: shifting the
+        # finding down must not resurrect it
+        mod.write_text("# a new leading comment\n" + mod.read_text())
+        assert lint.main(["legacy.py", "--baseline", base]) == 0
+        # --no-baseline reports it again
+        assert lint.main(["legacy.py", "--baseline", base,
+                          "--no-baseline"]) == 1
+        capsys.readouterr()
+
+    def test_package_lints_clean(self, capsys):
+        """The CI gate: the bodo_tpu package itself has no findings
+        beyond inline suppressions + the checked-in baseline."""
+        assert lint.main([]) == 0
+        out = capsys.readouterr().out
+        assert "0 new" in out
+
+
+# ---------------------------------------------------------------------------
+# layer 3: runtime lockstep checker
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def lockstep_reset():
+    lockstep.reset()
+    yield
+    lockstep.reset()
+
+
+class TestLockstep:
+    def test_divergence_detected_fast(self, tmp_path, monkeypatch,
+                                      lockstep_reset):
+        """Two ranks issuing DIFFERENT collectives at the same sequence
+        number both raise a structured LockstepError naming ranks and
+        call sites — in well under 5 seconds."""
+        monkeypatch.setattr(config, "lockstep_timeout_s", 5.0)
+        c0 = lockstep.Checker(str(tmp_path), 0, 2)
+        c1 = lockstep.Checker(str(tmp_path), 1, 2)
+        errs = {}
+
+        def run(c, op, site):
+            try:
+                c.check(op, site)
+            except LockstepError as e:
+                errs[c.rank] = e
+
+        t0 = time.monotonic()
+        th = threading.Thread(
+            target=run, args=(c0, "groupby_agg", "query.py:10"))
+        th.start()
+        run(c1, "sort_table", "query.py:20")
+        th.join()
+        dt = time.monotonic() - t0
+        assert dt < 5.0, f"divergence detection took {dt:.1f}s"
+        assert sorted(errs) == [0, 1]  # both sides notice
+        e = errs[1]
+        assert e.seq == 1 and e.peer == 0
+        assert e.site == "sort_table@query.py:20"
+        assert e.peer_site == "groupby_agg@query.py:10"
+        msg = str(e)
+        assert "rank 1" in msg and "rank 0" in msg
+        assert "divergence" in msg
+        assert lockstep.stats()["mismatches"] >= 1
+        c0.close(), c1.close()
+
+    def test_lagging_rank_timeout(self, tmp_path, monkeypatch,
+                                  lockstep_reset):
+        """A peer that never reaches the dispatch is reported with its
+        last-seen dispatch after lockstep_timeout_s — not the 180s gang
+        timeout."""
+        monkeypatch.setattr(config, "lockstep_timeout_s", 0.6)
+        c0 = lockstep.Checker(str(tmp_path), 0, 2)
+        t0 = time.monotonic()
+        with pytest.raises(LockstepError) as ei:
+            c0.check("join_tables", "query.py:33")
+        dt = time.monotonic() - t0
+        assert dt < 5.0
+        e = ei.value
+        assert e.peer == 1 and e.seq == 1
+        assert "did not reach" in str(e)
+        assert "no collective dispatched yet" in str(e)
+        assert lockstep.stats()["timeouts"] == 1
+        c0.close()
+
+    def test_matching_streams_pass(self, tmp_path, monkeypatch,
+                                   lockstep_reset):
+        monkeypatch.setattr(config, "lockstep_timeout_s", 5.0)
+        c0 = lockstep.Checker(str(tmp_path), 0, 2)
+        c1 = lockstep.Checker(str(tmp_path), 1, 2)
+        for seq in range(3):
+            th = threading.Thread(
+                target=c0.check, args=("groupby_agg", "q.py:1"))
+            th.start()
+            c1.check("groupby_agg", "q.py:1")
+            th.join()
+        assert lockstep.stats()["mismatches"] == 0
+        assert lockstep.stats()["collectives"] == 6
+        c0.close(), c1.close()
+
+    def test_single_process_records_and_profiles(self, mesh8,
+                                                 monkeypatch,
+                                                 lockstep_reset):
+        """Single-process mode (what the bench overhead suite measures):
+        dispatches are fingerprinted and counted with no peers to poll,
+        through the REAL relational dispatch path, and surface as the
+        profile's lockstep:check row."""
+        from bodo_tpu import relational
+        from bodo_tpu.plan import physical
+        from bodo_tpu.table.table import Table
+        from bodo_tpu.utils import tracing
+        monkeypatch.setattr(config, "lockstep", True)
+        monkeypatch.setattr(config, "lockstep_dir", "")
+        monkeypatch.setattr(config, "shard_min_rows", 0)
+        monkeypatch.delenv("BODO_TPU_NPROCS", raising=False)
+        t = physical._maybe_shard(Table.from_pandas(pd.DataFrame({
+            "k": np.arange(64, dtype=np.int64) % 8,
+            "v": np.arange(64, dtype=np.float64)})))
+        assert t.distribution == "1D"
+        relational.shuffle_by_key(t, ["k"])
+        relational.sort_table(t, ["k"])
+        st = lockstep.stats()
+        assert st["collectives"] >= 2
+        assert st["mismatches"] == 0 and st["timeouts"] == 0
+        prof = tracing.profile()
+        assert prof["lockstep:check"]["count"] == st["collectives"]
+
+    def test_disabled_is_noop(self, lockstep_reset):
+        assert not config.lockstep  # off by default
+        lockstep.pre_collective("groupby_agg")
+        assert lockstep.stats()["collectives"] == 0
+
+
+@pytest.mark.slow_spawn
+def test_lockstep_divergence_across_real_processes(monkeypatch):
+    """Acceptance: a rank that takes a different control-flow path into
+    a collective dies with a structured LockstepError (named rank + call
+    site) and the gang is torn down — instead of both ranks wedging in
+    the collective until the 180s gang timeout."""
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    monkeypatch.setenv("BODO_TPU_LOCKSTEP", "1")
+    monkeypatch.setenv("BODO_TPU_LOCKSTEP_TIMEOUT", "8")
+
+    def worker(rank):
+        import numpy as np
+        import pandas as pd
+
+        import bodo_tpu
+        from bodo_tpu import relational
+        from bodo_tpu.config import set_config
+        from bodo_tpu.plan import physical
+        from bodo_tpu.table.table import Table
+        bodo_tpu.set_mesh(bodo_tpu.make_mesh())
+        set_config(shard_min_rows=0)
+        t = physical._maybe_shard(Table.from_pandas(pd.DataFrame({
+            "k": np.arange(64, dtype=np.int64) % 8,
+            "v": np.arange(64, dtype=np.float64)})))
+        if rank == 0:
+            # divergent path: rank 0 sorts while rank 1 shuffles — the
+            # lockstep check fires BEFORE either kernel dispatches, so
+            # neither rank ever enters a real collective
+            relational.sort_table(t, ["k"])
+        else:
+            relational.shuffle_by_key(t, ["k"])
+        return rank
+
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(worker, 2, timeout=120)
+    dt = time.monotonic() - t0
+    assert dt < 90.0, f"divergence surfaced after {dt:.1f}s"
+    e = ei.value
+    assert e.reason == "worker death"  # structured death, not a hang
+    s = str(e)
+    assert "LockstepError" in s
+    assert "divergence" in s
+    assert not e.transient  # a correctness bug is never gang-retried
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: race-lint fixes + resilience exclusions
+# ---------------------------------------------------------------------------
+
+class TestRaceFixes:
+    def test_threaded_runtime_modules_race_clean(self):
+        """The race-lint triage result for the worker-thread modules,
+        pinned: runtime/io_pool.py and runtime/stats_store.py keep all
+        module-global mutation under their locks, and runtime/pool.py
+        does after the default_pool fix. A new unlocked write in any of
+        them fails here (and the CI lint gate) with the rule name."""
+        import bodo_tpu.runtime as rt
+        root = rt.__path__[0]
+        import os
+        findings = lint.lint_paths(
+            [os.path.join(root, f) for f in
+             ("io_pool.py", "stats_store.py", "pool.py")],
+            root=os.path.dirname(os.path.dirname(root)))
+        races = [f for f in findings if f.rule == "unlocked-shared-state"]
+        assert races == [], "\n".join(f.render() for f in races)
+
+    def test_default_pool_single_instance_under_threads(self, monkeypatch):
+        """runtime/pool.default_pool: two racing first calls must not
+        each build (and leak) a native pool + spill dir — the
+        unlocked-shared-state true positive fixed by double-checked
+        locking."""
+        from bodo_tpu.runtime import pool
+        built = []
+
+        class _SlowDummyPool:
+            def __init__(self):
+                built.append(self)
+                time.sleep(0.05)  # widen the init race window
+
+        monkeypatch.setattr(pool, "HostBufferPool", _SlowDummyPool)
+        monkeypatch.setattr(pool, "_default", None)
+        barrier = threading.Barrier(8)
+        got = []
+
+        def grab():
+            barrier.wait()
+            got.append(pool.default_pool())
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(built) == 1, f"{len(built)} pools built under race"
+        assert len({id(p) for p in got}) == 1
+
+    def test_estimate_injector_locked_set(self):
+        """plan/adaptive.set_estimate_injector now follows the module's
+        lock discipline; concurrent install/uninstall against counter
+        traffic must neither deadlock nor corrupt the final state."""
+        from bodo_tpu.plan import adaptive
+        stop = threading.Event()
+
+        def hammer_counts():
+            while not stop.is_set():
+                adaptive.count("shardcheck_test")
+
+        def hammer_injector():
+            for _ in range(200):
+                adaptive.set_estimate_injector(lambda node: 7.0)
+                adaptive.set_estimate_injector(None)
+
+        counters = threading.Thread(target=hammer_counts)
+        counters.start()
+        try:
+            ths = [threading.Thread(target=hammer_injector)
+                   for _ in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=30)
+                assert not t.is_alive(), "set_estimate_injector deadlock"
+        finally:
+            stop.set()
+            counters.join()
+            adaptive.set_estimate_injector(None)
+        assert adaptive._injector is None
+
+
+class TestResilienceExclusions:
+    def test_lockstep_error_never_transient(self):
+        from bodo_tpu.runtime import resilience
+        e = LockstepError(
+            "SPMD lockstep divergence at dispatch #3: rank 1 did not "
+            "reach dispatch #3 within 1.0s; its last dispatch was "
+            "nothing (no collective dispatched yet)")
+        assert resilience.classify_transient(e) is None
+        assert not resilience.is_degradable(e)
+
+    def test_plan_invariant_error_never_transient(self):
+        from bodo_tpu.runtime import resilience
+        e = PlanInvariantError("collective typing violation",
+                               rule="kernel-result-dist")
+        assert resilience.classify_transient(e) is None
+        assert not resilience.is_degradable(e)
+
+    def test_exclusion_is_by_class_not_message(self):
+        """The same 'collective' wording in a plain RuntimeError STILL
+        degrades — proving the analysis errors are excluded by class
+        name, not by a message pattern that could drift."""
+        from bodo_tpu.runtime import resilience
+        assert resilience.is_degradable(
+            RuntimeError("INTERNAL: collective permute failed"))
+        assert not resilience.is_degradable(
+            LockstepError("INTERNAL: collective permute failed"))

@@ -275,7 +275,14 @@ def bench_tpch(args):
         else:
             print(f"resume state is from commit {state.get('commit')} "
                   f"(HEAD {head}) — discarding", file=sys.stderr)
+    from bodo_tpu.config import set_config
     from bodo_tpu.plan.physical import _result_cache
+    from bodo_tpu.utils import tracing
+    # trace the hot passes so the artifact shows, per query, the top-5
+    # operators by wall — one query span per Qn keeps them separable
+    set_config(tracing_level=1)
+    tracing.reset()
+    top_ops = {}
     for q in sorted(QUERIES):
         if q in UNSUPPORTED or q in times and times[q] is not None:
             continue
@@ -286,9 +293,11 @@ def bench_tpch(args):
             # hot = compiled kernels, fresh execution (not the result cache)
             _result_cache.clear()
             t0 = time.perf_counter()
-            ctx.sql(QUERIES[q]).to_pandas()
+            with tracing.query_span(f"tpch-q{q}"):
+                ctx.sql(QUERIES[q]).to_pandas()
             hot = time.perf_counter() - t0
             times[q] = hot
+            top_ops[q] = tracing.top_ops(f"tpch-q{q}", 5)
             print(f"Q{q:2d} cold {cold:6.2f}s hot {hot:6.2f}s",
                   file=sys.stderr)
         except Exception as e:  # pragma: no cover
@@ -300,12 +309,12 @@ def bench_tpch(args):
                 json.dump({"commit": head,
                            "times": {str(k): v
                                      for k, v in times.items()}}, f)
+    set_config(tracing_level=0)
     ok = [v for v in times.values() if v is not None]
     if args.resume and len(ok) == len(times) and os.path.exists(state_path):
         os.remove(state_path)  # a completed run must not seed the next
     failed = len(times) - len(ok)
     total_hot = sum(ok)
-    from bodo_tpu.utils import tracing
     mem = tracing.memory_stats()
     detail = {"orders": args.rows, "queries_ok": len(ok),
               "sqlite_cold_s": round(t_sqlite["cold"], 3),
@@ -316,6 +325,11 @@ def bench_tpch(args):
               "skipped": {str(k): v for k, v in UNSUPPORTED.items()},
               "per_query": {str(k): (None if v is None else round(v, 3))
                             for k, v in times.items()},
+              "per_query_top_ops": {
+                  str(k): [{"op": r["op"],
+                            "total_s": round(r["total_s"], 4),
+                            "count": r["count"]} for r in v]
+                  for k, v in top_ops.items()},
               "memory": {
                   "derived_budget_mb": mem["derived_budget_bytes"] >> 20,
                   "governor_enabled": mem["enabled"],
@@ -527,6 +541,147 @@ def bench_lockstep(args, n_rows: int):
     return 0
 
 
+def bench_trace(args, n_rows: int):
+    """--suite trace: overhead of query-span tracing (utils/tracing.py)
+    on the taxi hot path. Runs the identical pipeline untraced and
+    traced (ring-buffer events + per-query aggregates armed); the JSON
+    metric is the fractional slowdown — the acceptance bar for keeping
+    tracing affordable in production is < 0.03."""
+    import jax
+
+    import bodo_tpu
+    from bodo_tpu.config import set_config
+    from bodo_tpu.utils import tracing
+    from bodo_tpu.workloads.taxi import bodo_tpu_pipeline, gen_taxi_data
+
+    data_dir = os.path.join(_REPO, ".bench_data")
+    os.makedirs(data_dir, exist_ok=True)
+    pq = os.path.join(data_dir, f"trips_{n_rows}.parquet")
+    csv = os.path.join(data_dir, f"weather_{n_rows}.csv")
+    if not (os.path.exists(pq) and os.path.exists(csv)):
+        print(f"generating {n_rows} rows ...", file=sys.stderr)
+        gen_taxi_data(n_rows, pq, csv)
+    devs = jax.devices()[:args.mesh]
+    args.mesh = len(devs)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+    reps = 3 if args.quick else 5
+
+    def pipeline():
+        bodo_tpu_pipeline(pq, csv, shard=True).to_pandas()
+
+    def measure() -> float:
+        pipeline()  # warm the kernel cache
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            pipeline()
+        return (time.perf_counter() - t0) / reps
+
+    set_config(tracing_level=0)
+    base_s = measure()
+    set_config(tracing_level=1)
+    tracing.reset()
+    try:
+        traced_s = measure()
+        events = int(sum(a["count"]
+                         for a in tracing.query_agg().values()))
+        dropped = tracing.dropped_events()
+    finally:
+        set_config(tracing_level=0)
+    overhead = (traced_s - base_s) / base_s if base_s > 0 else 0.0
+    per_run = events / (reps + 1)
+    per_us = ((traced_s - base_s) / per_run * 1e6 if per_run else 0.0)
+    print(f"trace: base {base_s:.4f}s traced {traced_s:.4f}s "
+          f"({events} events)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "trace_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "vs_baseline": round(1.0 + overhead, 4),
+        "detail": {"rows": n_rows, "reps": reps,
+                   "base_s": round(base_s, 4),
+                   "traced_s": round(traced_s, 4),
+                   "events": events,
+                   "events_dropped": int(dropped),
+                   "per_event_us": round(max(per_us, 0.0), 2),
+                   "n_devices": args.mesh,
+                   "platform": devs[0].platform,
+                   "probe": getattr(args, "probe",
+                                    {"attempted": False})},
+    }))
+    return 0
+
+
+def _gang_taxi_worker(pq: str, csv: str):
+    """Worker fn for the --explain gang: each rank runs the plan-based
+    taxi pipeline on its LOCAL mesh (the CPU backend cannot execute
+    cross-process collectives; on a pod this would be the global mesh)
+    and leaves a trace shard for the spawner to merge."""
+    def work(rank):
+        import jax
+
+        import bodo_tpu
+        from bodo_tpu.utils import tracing
+        from bodo_tpu.workloads.taxi import frontend_pipeline
+        bodo_tpu.set_mesh(bodo_tpu.make_mesh(jax.local_devices()))
+        df = frontend_pipeline(pq, csv)
+        return {"rank": rank, "groups": len(df),
+                "query_id": tracing.current_query_id()}
+    return work
+
+
+def _taxi_explain(args, pq: str, csv: str) -> dict:
+    """--explain: EXPLAIN ANALYZE the plan-based taxi pipeline, then a
+    --procs gang whose ranks trace rank-local runs merged into ONE
+    multi-rank chrome-trace JSON (.bench_data/traces/), plus the
+    unified metrics snapshot. Returns the detail sub-dict."""
+    from bodo_tpu import spawn
+    from bodo_tpu.config import set_config
+    from bodo_tpu.plan import explain
+    from bodo_tpu.utils import metrics, tracing
+    from bodo_tpu.workloads.taxi import frontend_pipeline
+
+    out = {}
+    set_config(tracing_level=1)
+    try:
+        with tracing.query_span() as qid:
+            frontend_pipeline(pq, csv)
+        tree = explain.explain_analyze(qid)
+        print(tree, file=sys.stderr)
+        out["explain_analyze"] = {"query_id": qid, "tree": tree,
+                                  "nodes": explain.node_profiles(qid)}
+        trace_dir = os.path.join(_REPO, ".bench_data", "traces")
+        set_config(trace_dir=trace_dir)
+        try:
+            print(f"running {args.procs}-process gang for the merged "
+                  f"trace ...", file=sys.stderr)
+            with tracing.query_span() as gang_qid:
+                res = spawn.run_spmd(_gang_taxi_worker(pq, csv),
+                                     args.procs, timeout=600)
+            merged = spawn.last_gang_trace()
+            gang = {"query_id": gang_qid, "procs": args.procs,
+                    "workers": res}
+            if merged is not None:
+                gang.update({
+                    "ranks": merged["ranks"],
+                    "events": len(merged["traceEvents"]),
+                    "path": spawn.last_gang_trace_path()})
+                print(f"merged gang trace: {gang.get('path')} "
+                      f"({gang['events']} events, {gang['ranks']} "
+                      f"rank lanes)", file=sys.stderr)
+            out["gang_trace"] = gang
+        except Exception as e:  # noqa: BLE001 - gang is best-effort here
+            print(f"gang trace failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            out["gang_trace"] = {"error": f"{type(e).__name__}: "
+                                          f"{str(e)[:300]}"}
+        finally:
+            set_config(trace_dir="")
+        out["metrics"] = metrics.snapshot()
+    finally:
+        set_config(tracing_level=0)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=None,
@@ -543,8 +698,15 @@ def main():
                          "mesh only adds shuffle cost; use --cpu --mesh 8 "
                          "as a collectives correctness probe)")
     ap.add_argument("--suite",
-                    choices=["taxi", "tpch", "scan", "lockstep"],
+                    choices=["taxi", "tpch", "scan", "lockstep",
+                             "trace"],
                     default="taxi")
+    ap.add_argument("--explain", action="store_true",
+                    help="taxi: EXPLAIN ANALYZE the plan-based pipeline "
+                         "and run a --procs gang emitting one merged "
+                         "multi-rank chrome trace + metrics snapshot")
+    ap.add_argument("--procs", type=int, default=2,
+                    help="gang size for --explain (default 2)")
     ap.add_argument("--resume", action="store_true",
                     help="tpch: append per-query results to a state file "
                          "and skip already-completed queries (a tunnel "
@@ -558,6 +720,8 @@ def main():
             args.mesh = 8  # collectives must actually dispatch
         if args.rows is None and not args.quick:
             args.rows = 500_000  # checker cost, not scan cost
+    if args.suite == "trace" and args.rows is None and not args.quick:
+        args.rows = 500_000  # span cost, not scan cost
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -618,6 +782,8 @@ def main():
         return bench_scan(args, n_rows)
     if args.suite == "lockstep":
         return bench_lockstep(args, n_rows)
+    if args.suite == "trace":
+        return bench_trace(args, n_rows)
 
     import pandas as pd  # noqa: F401
 
@@ -682,8 +848,9 @@ def main():
     from bodo_tpu.runtime import io_pool
     io_pool.reset_io_stats()
     t0 = time.perf_counter()
-    out = bodo_tpu_pipeline(pq, csv, shard=True)
-    got = out.to_pandas()
+    with tracing.query_span(tracing.new_query_id("taxi-")) as taxi_qid:
+        out = bodo_tpu_pipeline(pq, csv, shard=True)
+        got = out.to_pandas()
     t_hot = time.perf_counter() - t0
     set_config(tracing_level=0)
     prof_all = tracing.profile()
@@ -721,6 +888,8 @@ def main():
                                 else round(scanned / t_hot / 1e6, 1)),
               "pipeline_mb_per_s": round(scanned / t_hot / 1e6, 1),
               "pallas_traced_into_pipeline": PK.trace_count,
+              "query_id": taxi_qid,
+              "top_ops": tracing.top_ops(taxi_qid, 5),
               "profile_hot": prof,
               "io": {k: (round(v, 4) if isinstance(v, float) else v)
                      for k, v in io_pool.io_stats().items()},
@@ -741,6 +910,8 @@ def main():
               "aqe": tracing.aqe_stats()}
     if pallas_proof is not None:
         detail["pallas_mxu"] = pallas_proof
+    if args.explain:
+        detail.update(_taxi_explain(args, pq, csv))
     value = round(speedup, 3)
     if platform == "tpu":
         _record(f"tpu_taxi_{n_rows}.json", {

@@ -138,6 +138,17 @@ class Config:
     tracing_level: int = field(
         default_factory=lambda: _env_int("BODO_TPU_TRACING_LEVEL", 0)
     )
+    # Ring-buffer capacity for trace events (drop-oldest beyond this;
+    # dropped events are counted — long-running sessions can't leak).
+    trace_events_max: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_TRACE_EVENTS_MAX",
+                                         100_000)
+    )
+    # When set, gang runs write the merged multi-rank chrome trace here
+    # (trace_gang_<ts>.json); also inherited by spawned workers.
+    trace_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_TRACE_DIR", "")
+    )
     # -- numerics ------------------------------------------------------------
     # Use bfloat16 accumulation for mean/var where tolerable (perf knob).
     low_precision_agg: bool = field(
@@ -364,6 +375,17 @@ def set_config(**kwargs) -> None:
                     os.environ["BODO_TPU_LOCKSTEP_DIR"] = v
                 else:
                     os.environ.pop("BODO_TPU_LOCKSTEP_DIR", None)
+        if k == "trace_events_max":
+            # rebuild the ring buffer at the new capacity (keeps the
+            # newest events)
+            from bodo_tpu.utils import tracing
+            tracing.resize_events_buffer()
+        if k == "trace_dir":
+            # export like faults/lockstep so spawned workers inherit it
+            if v:
+                os.environ["BODO_TPU_TRACE_DIR"] = v
+            else:
+                os.environ.pop("BODO_TPU_TRACE_DIR", None)
 
 
 def set_verbose_level(level: int) -> None:

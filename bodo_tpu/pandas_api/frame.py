@@ -441,6 +441,16 @@ class BodoDataFrame:
         from bodo_tpu.plan.physical import execute
         return execute(self._plan)
 
+    def explain_analyze(self) -> str:
+        """Execute this frame's plan under a query span and render the
+        EXPLAIN ANALYZE tree (observed rows/bytes/wall/AQE decisions per
+        node). Requires tracing (set_config(tracing_level=1))."""
+        from bodo_tpu.plan import explain
+        from bodo_tpu.utils import tracing
+        with tracing.query_span() as qid:
+            self._execute()
+        return explain.explain_analyze(qid)
+
     def to_pandas(self) -> pd.DataFrame:
         pdf = self._execute().to_pandas()
         if not self._index:

@@ -269,7 +269,20 @@ def groupby_sharded(arrays, counts, num_keys: int, specs: Tuple[str, ...],
     per-shard partial counts and sizes the shuffle buckets tightly
     (expected rows per (src,dest) pair × skew headroom), growing them on
     overflow up to the always-safe bound (= max partial count).
+
+    This is a HOST-level entry (device_get between stages), so it owns
+    a query-tagged tracing span; the inner shuffle_rows/shuffle_partials
+    run under jit tracing and must stay side-effect free.
     """
+    from bodo_tpu.utils import tracing
+    with tracing.event("groupby_sharded", specs=list(specs)):
+        return _groupby_sharded_impl(arrays, counts, num_keys, specs,
+                                     bucket_cap, final_cap, mesh)
+
+
+def _groupby_sharded_impl(arrays, counts, num_keys: int,
+                          specs: Tuple[str, ...], bucket_cap=None,
+                          final_cap=None, mesh=None):
     from bodo_tpu.table.table import round_capacity
     m = mesh or mesh_mod.get_mesh()
     S = m.shape[config.data_axis]

@@ -1,0 +1,245 @@
+"""EXPLAIN ANALYZE: the executed plan tree annotated with observations.
+
+The plan validator knows what a plan SHOULD do and the optimizer what it
+WILL do; this module records what it DID: per plan node, the observed
+row count vs the estimator's pre-execution guess, result device bytes,
+inclusive wall seconds, cache hits, and any adaptive-execution decisions
+that fired while the node ran (Postgres' EXPLAIN ANALYZE crossed with
+Spark AQE's final-plan annotations).
+
+`physical.execute` assigns every node a stable dotted path ("0", "0.1",
+"0.1.0" …) at the start of each query and `record()`s an observation per
+node as it completes; AQE-replanned join subtrees get paths re-anchored
+under the node they replaced, flagged `replanned`. Observations are
+keyed by query id (tracing.query_span) and kept for the last
+`_MAX_QUERIES` queries, so `explain_analyze()` after a run renders the
+tree of any recent query — `bench.py --explain` and
+`BodoDataFrame.explain_analyze()` are thin wrappers over it.
+
+Recording is active only while tracing is on (BODO_TPU_TRACING_LEVEL
+>= 1); with tracing off the executor's hot path skips this module
+entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from bodo_tpu.plan import logical as L
+
+_lock = threading.Lock()
+_MAX_QUERIES = 64
+# qid -> {"root": Node, "records": {path: record}}
+_queries: "OrderedDict[str, dict]" = OrderedDict()
+_last_qid: Optional[str] = None
+
+
+def _qid() -> str:
+    from bodo_tpu.utils import tracing
+    return tracing.current_query_id() or "-"
+
+
+def begin_query(root: L.Node, query_id: Optional[str] = None) -> None:
+    """Anchor a query: assign dotted paths over the (optimized) tree and
+    open its record store. Called by physical.execute when tracing is
+    on. Shared subplans (the optimizer memoizes by key) keep the first
+    path they get — later parents see them as cache hits anyway."""
+    global _last_qid
+    qid = query_id or _qid()
+    assign_paths(root, "0", force=True)
+    with _lock:
+        q = _queries.get(qid)
+        if q is None:
+            q = _queries[qid] = {"root": root, "records": {}}
+            while len(_queries) > _MAX_QUERIES:
+                _queries.popitem(last=False)
+        else:
+            q["root"] = root
+        _last_qid = qid
+
+
+def assign_paths(node: L.Node, base: str, force: bool = False,
+                 replanned: bool = False) -> None:
+    """Depth-first dotted-path assignment. `force` overwrites paths
+    left over from a previous query's tree walk (plan nodes are reused
+    across executions via the session result cache); `replanned` marks
+    an AQE-substituted subtree."""
+    seen = set()
+
+    def walk(n: L.Node, path: str) -> None:
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if force or getattr(n, "_explain_path", None) is None:
+            n._explain_path = path
+            n._explain_replanned = replanned
+        for i, c in enumerate(n.children):
+            walk(c, f"{path}.{i}")
+
+    walk(node, base)
+
+
+def record(node: L.Node, *, rows: int, wall_s: float,
+           est_rows: Optional[float] = None,
+           bytes: Optional[int] = None, cached: bool = False,
+           aqe: Optional[Dict[str, int]] = None,
+           mem_peak: Optional[int] = None) -> None:
+    """One node observation for the current query. Wall seconds are
+    INCLUSIVE of the node's children (the executor recurses inside the
+    node's span), matching Postgres' actual-time convention. A repeat
+    record for the same path keeps the first full execution and only
+    bumps its hit count (memoized subplan re-reached)."""
+    path = getattr(node, "_explain_path", None)
+    if path is None:
+        return
+    qid = _qid()
+    rec = {"path": path, "op": type(node).__name__, "rows": int(rows),
+           "wall_s": float(wall_s), "cached": bool(cached), "hits": 1}
+    if est_rows is not None:
+        rec["est_rows"] = int(est_rows)
+    if bytes is not None:
+        rec["bytes"] = int(bytes)
+    if mem_peak is not None:
+        rec["mem_peak"] = int(mem_peak)
+    if aqe:
+        rec["aqe"] = dict(aqe)
+    if getattr(node, "_explain_replanned", False):
+        rec["replanned"] = True
+    with _lock:
+        q = _queries.get(qid)
+        if q is None:
+            q = _queries[qid] = {"root": None, "records": {}}
+            while len(_queries) > _MAX_QUERIES:
+                _queries.popitem(last=False)
+        prev = q["records"].get(path)
+        if prev is not None and not prev["cached"]:
+            prev["hits"] += 1
+            return
+        if prev is not None:
+            rec["hits"] = prev["hits"] + 1
+        q["records"][path] = rec
+
+
+def node_profiles(query_id: Optional[str] = None) -> List[dict]:
+    """The recorded observations for one query (default: last), in
+    dotted-path order — the JSON-able form bench artifacts embed."""
+    with _lock:
+        qid = query_id or _last_qid
+        q = _queries.get(qid) if qid else None
+        if q is None:
+            return []
+        recs = [dict(r) for r in q["records"].values()]
+    recs.sort(key=lambda r: _pathkey(r["path"]))
+    return recs
+
+
+def last_query_id() -> Optional[str]:
+    with _lock:
+        return _last_qid
+
+
+def reset() -> None:
+    global _last_qid
+    with _lock:
+        _queries.clear()
+        _last_qid = None
+
+
+def _pathkey(path: str):
+    return tuple(int(p) for p in path.split("."))
+
+
+def _fmt_bytes(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if v < 1024 or unit == "GB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return f"{v:.1f}GB"  # pragma: no cover
+
+
+def _node_label(node: L.Node) -> str:
+    if isinstance(node, L.ReadParquet):
+        return f"ReadParquet({node.path})"
+    if isinstance(node, L.ReadCsv):
+        return f"ReadCsv({node.path})"
+    if isinstance(node, L.Join):
+        return f"Join({node.how}, on={list(node.left_on)})"
+    if isinstance(node, L.Aggregate):
+        return f"Aggregate(keys={list(node.keys)})"
+    if isinstance(node, L.Filter):
+        return "Filter"
+    if isinstance(node, L.Sort):
+        return f"Sort(by={list(node.by)})"
+    if isinstance(node, L.Limit):
+        return f"Limit({node.n})"
+    return type(node).__name__
+
+
+def _annotate(rec: Optional[dict]) -> str:
+    if rec is None:
+        return "(not executed)"
+    parts = [f"rows={rec['rows']}"]
+    if "est_rows" in rec:
+        parts.append(f"est={rec['est_rows']}")
+    if "bytes" in rec:
+        parts.append(f"bytes={_fmt_bytes(rec['bytes'])}")
+    if "mem_peak" in rec:
+        parts.append(f"mem_peak={_fmt_bytes(rec['mem_peak'])}")
+    parts.append(f"wall={rec['wall_s']:.3f}s")
+    if rec.get("aqe"):
+        decs = ",".join(f"{k}x{v}" if v > 1 else k
+                        for k, v in sorted(rec["aqe"].items()))
+        parts.append(f"aqe=[{decs}]")
+    if rec.get("replanned"):
+        parts.append("replanned")
+    if rec.get("cached"):
+        parts.append("cached")
+    if rec.get("hits", 1) > 1:
+        parts.append(f"hits={rec['hits']}")
+    return "  ".join(parts)
+
+
+def explain_analyze(query_id: Optional[str] = None) -> str:
+    """Render the executed plan tree of a query (default: the last one
+    executed) with per-node observations. Returns a diagnostic string
+    when the query is unknown or was run without tracing."""
+    from bodo_tpu.utils import tracing
+    with _lock:
+        qid = query_id or _last_qid
+        q = _queries.get(qid) if qid else None
+        root = q["root"] if q else None
+        records = dict(q["records"]) if q else {}
+    if qid is None or q is None:
+        return ("EXPLAIN ANALYZE: no recorded query "
+                "(run with tracing_level >= 1)")
+    lines = []
+    wall = tracing.query_wall_s(qid)
+    if wall is None and records:
+        wall = max(r["wall_s"] for r in records.values())
+    header = f"EXPLAIN ANALYZE  query={qid}"
+    if wall is not None:
+        header += f"  wall={wall:.3f}s"
+    lines.append(header)
+    if root is None:
+        for rec in sorted(records.values(),
+                          key=lambda r: _pathkey(r["path"])):
+            lines.append(f"[{rec['path']}] {rec['op']}  {_annotate(rec)}")
+        return "\n".join(lines)
+
+    def walk(n: L.Node, prefix: str, tail: bool, top: bool) -> None:
+        path = getattr(n, "_explain_path", None)
+        rec = records.get(path) if path else None
+        conn = "" if top else ("└─ " if tail else "├─ ")
+        lines.append(f"{prefix}{conn}{_node_label(n)} [{path}]  "
+                     f"{_annotate(rec)}")
+        child_prefix = prefix if top else \
+            prefix + ("   " if tail else "│  ")
+        kids = list(n.children)
+        for i, c in enumerate(kids):
+            walk(c, child_prefix, i == len(kids) - 1, False)
+
+    walk(root, "", True, True)
+    return "\n".join(lines)

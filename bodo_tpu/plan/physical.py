@@ -10,6 +10,7 @@ node (plan collapse) and in a session-level cache keyed by plan identity.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict
 
 import numpy as np
@@ -44,7 +45,20 @@ def execute(node: L.Node, optimize_first: bool = True) -> Table:
         # instead of wrong answers or a wedged gang
         from bodo_tpu.analysis.plan_validator import validate_plan
         validate_plan(node)
-    return _exec(node)
+    from bodo_tpu.utils import tracing
+    if not tracing.is_tracing():
+        return _exec(node)
+    # every traced execution belongs to a query: adopt the caller's
+    # span if one is active, otherwise open one for this plan so all
+    # events/records below carry a query id
+    from bodo_tpu.plan import explain
+    qid = tracing.current_query_id()
+    if qid is not None:
+        explain.begin_query(node, qid)
+        return _exec(node)
+    with tracing.query_span() as qid:
+        explain.begin_query(node, qid)
+        return _exec(node)
 
 
 def _maybe_shard(t: Table) -> Table:
@@ -61,18 +75,42 @@ def _maybe_shard(t: Table) -> Table:
 
 
 def _exec(node: L.Node) -> Table:
+    from bodo_tpu.utils import tracing
+    traced = tracing.is_tracing()
     if node._cached is not None:
+        if traced:
+            _record_node(node, node._cached, 0.0, cached=True)
         return node._cached
     key = node.key()
     hit = _result_cache.get(key)
     if hit is not None:
         node._cached = hit
+        if traced:
+            _record_node(node, hit, 0.0, cached=True)
         return hit
-    from bodo_tpu.utils import tracing
-    with tracing.event(type(node).__name__) as ev:
+    est_rows = aqe_before = None
+    if traced:
+        # pre-execution estimate + AQE decision snapshot, so the record
+        # can show est-vs-actual and which adaptive decisions this node
+        # triggered (EXPLAIN ANALYZE annotations)
+        try:
+            from bodo_tpu.plan import adaptive, stats
+            est_rows = stats.estimate(node)[0]
+            aqe_before = dict(adaptive.stats().get("decisions", {}))
+        except Exception:  # noqa: BLE001 - annotation is best-effort
+            pass
+    span_args = {}
+    path = getattr(node, "_explain_path", None)
+    if path is not None:
+        span_args["path"] = path
+    t0 = _time.perf_counter()
+    with tracing.event(type(node).__name__, **span_args) as ev:
         t = _exec_with_oom_retry(node)
         if ev is not None:
             ev["rows"] = t.nrows
+    if traced:
+        _record_node(node, t, _time.perf_counter() - t0,
+                     est_rows=est_rows, aqe_before=aqe_before)
     node._cached = t
     # stage-boundary statistics feedback; a stage that came back from a
     # degraded replicated re-run is tainted (execution artifact, not a
@@ -86,6 +124,36 @@ def _exec(node: L.Node) -> Table:
         _result_cache.pop(next(iter(_result_cache)))
     _result_cache[key] = t
     return t
+
+
+def _record_node(node: L.Node, t: Table, wall_s: float,
+                 cached: bool = False, est_rows=None,
+                 aqe_before=None) -> None:
+    """EXPLAIN ANALYZE observation for one executed (or cache-hit) node:
+    rows, result device bytes, inclusive wall, and the delta of AQE
+    decision counters across the node's execution. Best-effort — an
+    annotation failure never fails the query."""
+    try:
+        from bodo_tpu.plan import explain
+        aqe_delta = None
+        if aqe_before is not None:
+            from bodo_tpu.plan import adaptive
+            after = adaptive.stats().get("decisions", {})
+            aqe_delta = {k: v - aqe_before.get(k, 0)
+                         for k, v in after.items()
+                         if v - aqe_before.get(k, 0)}
+        nbytes = None
+        try:
+            from bodo_tpu.runtime.memory_governor import \
+                table_device_bytes
+            nbytes = int(table_device_bytes(t))
+        except Exception:  # noqa: BLE001
+            pass
+        explain.record(node, rows=t.nrows, wall_s=wall_s,
+                       est_rows=est_rows, bytes=nbytes, cached=cached,
+                       aqe=aqe_delta)
+    except Exception:  # noqa: BLE001 - observability must not break exec
+        pass
 
 
 _MAX_OOM_RETRIES = 3
@@ -236,6 +304,14 @@ def _exec_inner(node: L.Node) -> Table:
                 from bodo_tpu.analysis.plan_validator import \
                     validate_rewrite
                 validate_rewrite(node, repl)
+            from bodo_tpu.utils import tracing
+            if tracing.is_tracing():
+                # re-anchor the substituted subtree's EXPLAIN paths
+                # under the join it replaced, flagged as replanned
+                from bodo_tpu.plan import explain
+                explain.assign_paths(
+                    repl, getattr(node, "_explain_path", None) or "0",
+                    force=True, replanned=True)
             return _exec(repl)
         left = _exec(node.left)
         right = _exec(node.right)

@@ -2510,33 +2510,40 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
         from bodo_tpu.analysis import lockstep
         lockstep.pre_collective("shuffle_by_key")
     from bodo_tpu.plan import adaptive
+    from bodo_tpu.utils import tracing
     adaptive.observe_shuffle(t, key_cols)
-    m = mesh_mod.get_mesh()
-    S = mesh_mod.num_shards(m)
-    ax = config.data_axis
-    names = t.names
-    cap = t.shard_capacity
-    nk = len(key_cols)
-    korder = list(key_cols) + [n for n in names if n not in key_cols]
-    key = ("shuffle", _mesh_key(m), _sig(t.select(korder)), nk, cap)
-    fn = _jit_cache.get(key)
-    if fn is None:
-        def body(arrs, counts):
-            cnt = counts[0]
-            dest = dest_shard(hash_columns(arrs[:nk]), S)
-            flat, _ = _flatten_with_valids(arrs)
-            out, cnt2, _ = shuffle_rows(dest, flat, cnt, S, cap, ax)
-            return _rebuild_from_flat(out, tuple(slots2)), cnt2[None]
-        slots2 = [t.column(n).valid is not None for n in korder]
-        fn = jax.jit(C.smap(body, in_specs=(P(ax), P(ax)),
-                            out_specs=(P(ax), P(ax)), mesh=m))
-        _jit_cache[key] = fn
-    karrays = tuple((t.column(n).data, t.column(n).valid) for n in korder)
-    out, cnts = fn(karrays, t.counts_device())
-    counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
-    tree = {n: out[i] for i, n in enumerate(korder)}
-    res = t.with_device_data(tree, nrows=int(counts.sum()), counts=counts)
-    return _keep_vranges(shrink_to_fit(res.select(names)), t)
+    with tracing.event("shuffle_by_key", keys=list(key_cols)) as ev:
+        if ev is not None:
+            ev["rows"] = t.nrows
+        m = mesh_mod.get_mesh()
+        S = mesh_mod.num_shards(m)
+        ax = config.data_axis
+        names = t.names
+        cap = t.shard_capacity
+        nk = len(key_cols)
+        korder = list(key_cols) + [n for n in names if n not in key_cols]
+        key = ("shuffle", _mesh_key(m), _sig(t.select(korder)), nk, cap)
+        fn = _jit_cache.get(key)
+        if fn is None:
+            def body(arrs, counts):
+                cnt = counts[0]
+                dest = dest_shard(hash_columns(arrs[:nk]), S)
+                flat, _ = _flatten_with_valids(arrs)
+                out, cnt2, _ = shuffle_rows(dest, flat, cnt, S, cap, ax)
+                return _rebuild_from_flat(out, tuple(slots2)), cnt2[None]
+            slots2 = [t.column(n).valid is not None for n in korder]
+            fn = jax.jit(C.smap(body, in_specs=(P(ax), P(ax)),
+                                out_specs=(P(ax), P(ax)), mesh=m))
+            _jit_cache[key] = fn
+        karrays = tuple((t.column(n).data, t.column(n).valid)
+                        for n in korder)
+        out, cnts = fn(karrays, t.counts_device())
+        counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(
+            np.int64)
+        tree = {n: out[i] for i, n in enumerate(korder)}
+        res = t.with_device_data(tree, nrows=int(counts.sum()),
+                                 counts=counts)
+        return _keep_vranges(shrink_to_fit(res.select(names)), t)
 
 
 def shard_frames(t: Table) -> List:

@@ -93,10 +93,29 @@ def main():
         _init()
     with open(payload_path, "rb") as f:
         fn = cloudpickle.load(f)
-    result = fn(jax.process_index())
+    try:
+        result = fn(jax.process_index())
+    finally:
+        _dump_trace_shard()
     with open(out_path + ".tmp", "wb") as f:
         pickle.dump(result, f)
     os.replace(out_path + ".tmp", out_path)
+
+
+def _dump_trace_shard():
+    # if the worker fn traced anything (tracing module loaded + events
+    # recorded), leave a chrome-trace shard in the gang dir for the
+    # spawner to merge into one multi-rank timeline; best-effort — a
+    # shard failure never fails the worker
+    tr = sys.modules.get("bodo_tpu.utils.tracing")
+    d = os.environ.get("BODO_TPU_TRACE_SHARD_DIR")
+    if tr is None or not d:
+        return
+    try:
+        if tr.has_events():
+            tr.dump_shard(d)
+    except Exception:
+        pass
 
 
 main()
@@ -136,6 +155,56 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _tracing_level() -> int:
+    # workers inherit the parent's EFFECTIVE tracing level: set_config
+    # changes config without touching the environment
+    try:
+        from bodo_tpu.config import config
+        return int(config.tracing_level)
+    except Exception:  # pragma: no cover
+        return 0
+
+
+# last merged multi-rank trace (and where it was written, when
+# config.trace_dir is set): the programmatic handle for the gang
+# timeline, since the gang temp dir itself is deleted after the run
+_last_gang_trace: Optional[dict] = None
+_last_gang_trace_path: Optional[str] = None
+
+
+def last_gang_trace() -> Optional[dict]:
+    return _last_gang_trace
+
+
+def last_gang_trace_path() -> Optional[str]:
+    return _last_gang_trace_path
+
+
+def _merge_gang_trace(d: str) -> None:
+    """Merge any worker trace shards from the gang dir into one
+    multi-rank timeline BEFORE the TemporaryDirectory is cleaned up;
+    written to config.trace_dir when set, always stashed in
+    `last_gang_trace()`. Best-effort: runs on both the success and the
+    failure path (a partial timeline is exactly what you want when
+    diagnosing which rank died where)."""
+    global _last_gang_trace, _last_gang_trace_path
+    try:
+        from bodo_tpu.config import config
+        from bodo_tpu.utils import tracing
+        out_path = None
+        if config.trace_dir:
+            os.makedirs(config.trace_dir, exist_ok=True)
+            out_path = os.path.join(
+                config.trace_dir,
+                f"trace_gang_{os.getpid()}_{int(time.time() * 1e3)}.json")
+        merged = tracing.merge_trace_shards(d, out_path)
+        if merged is not None:
+            _last_gang_trace = merged
+            _last_gang_trace_path = out_path
+    except Exception:  # noqa: BLE001 - observability must not fail gangs
+        pass
 
 
 def _hb_age(path: str, fallback_age: float) -> float:
@@ -207,6 +276,16 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                 err_paths.append(err_path)
                 hb_paths.append(hb_path)
                 env = dict(os.environ)
+                # workers join the active query span: the id usually
+                # rides os.environ already (query_span exports it), but
+                # a contextvar-only span still propagates here
+                try:
+                    from bodo_tpu.utils import tracing
+                    qid = tracing.current_query_id()
+                    if qid:
+                        env["BODO_TPU_QUERY_ID"] = qid
+                except Exception:  # pragma: no cover
+                    pass
                 env.update({
                     "BODO_TPU_COORD": coord,
                     "BODO_TPU_NPROCS": str(n_processes),
@@ -219,6 +298,11 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                     # itself is armed via BODO_TPU_LOCKSTEP, inherited
                     # from the parent environment
                     "BODO_TPU_LOCKSTEP_DIR": d,
+                    # trace shards ride the same gang-scoped side
+                    # channel; the spawner merges them before the dir
+                    # is cleaned up
+                    "BODO_TPU_TRACE_SHARD_DIR": d,
+                    "BODO_TPU_TRACING_LEVEL": str(_tracing_level()),
                     "JAX_PLATFORMS": "cpu",
                     "PYTHONPATH": pkg_root + os.pathsep +
                     env.get("PYTHONPATH", ""),
@@ -244,6 +328,7 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                     for out_path in outs:
                         with open(out_path, "rb") as f:
                             results.append(pickle.load(f))
+                    _merge_gang_trace(d)
                     return results
             # fast-fail: tear down the rest of the gang NOW
             for p in procs:
@@ -282,6 +367,7 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                                 not diag["transient"]:
                             transient = False
                 ranks[i] = diag
+            _merge_gang_trace(d)
             raise SpawnError(reason, ranks, transient=transient)
         finally:
             for p in procs:

@@ -138,3 +138,15 @@ class BodoSQLContext:
                 walk(c, d + 1)
         walk(self.generate_plan(query), 0)
         return "\n".join(lines)
+
+    def explain_analyze(self, query: str) -> str:
+        """Plan, EXECUTE, and render the plan tree annotated with the
+        observed per-node rows/bytes/wall/AQE decisions (requires
+        tracing: set_config(tracing_level=1))."""
+        from bodo_tpu.plan import explain
+        from bodo_tpu.plan.physical import execute
+        from bodo_tpu.utils import tracing
+        plan = self.generate_plan(query)
+        with tracing.query_span() as qid:
+            execute(plan, optimize_first=False)
+        return explain.explain_analyze(qid)

@@ -12,6 +12,7 @@ executables are garbage-collected.
 from __future__ import annotations
 
 import functools
+import time
 from collections import OrderedDict
 
 
@@ -97,6 +98,14 @@ def bounded_jit(fun=None, *, static_argnames=(), maxsize=None):
         if fn is None:
             fn = jax.jit(fun, static_argnames=static_argnames)
             cache[key] = fn
+            # first invocation pays trace+lower+compile: record it as
+            # this program's compile cost (bodo_tpu_jit_compile_seconds)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            from bodo_tpu.utils import metrics
+            metrics.record_compile(fun.__name__,
+                                   time.perf_counter() - t0)
+            return out
         return fn(*args, **kwargs)
 
     wrapper.cache = cache

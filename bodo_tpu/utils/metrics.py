@@ -1,0 +1,584 @@
+"""Unified typed metrics registry: counter / gauge / histogram with labels.
+
+The substrate PRs (memory governor, resilience, AQE, I/O pool,
+shardcheck) each grew an ad-hoc ``stats()`` dict with its own shape;
+`tracing.profile()` then hand-translated five shapes into ``mem:`` /
+``resil:`` / ``aqe:`` / ``io:`` / ``lint:`` / ``lockstep:`` rows. This
+module is the one place those translations live now: a typed registry
+(the reference analogue is the per-operator metric types of
+bodo/libs/_query_profile_collector.h — TIMER/STAT/BLOB — crossed with a
+Prometheus-style exposition for the future serving layer,
+runtime/scheduler.py, which will scrape it per session/tenant).
+
+Three metric kinds, all label-aware and thread-safe:
+
+  * :class:`Counter` — monotonically increasing (``.inc(n)``)
+  * :class:`Gauge` — set-to-current-value (``.set(v)``)
+  * :class:`Histogram` — bucketed observations (``.observe(v)``)
+
+``sync_engine_metrics()`` pulls every subsystem's stats snapshot into
+canonically named metrics (``bodo_tpu_*``); ``expose_text()`` renders
+the whole registry in the Prometheus text exposition format;
+``snapshot()`` returns the same data as a JSON-safe dict (embedded in
+tracing dumps and bench artifacts). Query-scoped operator counters
+(labelled ``query=...``/``op=...``) are synthesized from the tracing
+layer's per-query aggregates, so per-query accounting needs no extra
+bookkeeping on the hot event path.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# default histogram buckets: latency-shaped (seconds), 1ms .. ~2min
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 120.0)
+
+
+class _Child:
+    """One labelled series of a metric (what ``.labels(...)`` returns)."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "_Metric", key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, n: float = 1.0) -> None:
+        self._metric._inc(self._key, n)
+
+    def set(self, v: float) -> None:
+        self._metric._set(self._key, v)
+
+    def observe(self, v: float) -> None:
+        self._metric._observe(self._key, v)
+
+    def get(self) -> float:
+        return self._metric.value(*self._key)
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name: {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name: {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._mu = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    # -- label resolution ----------------------------------------------------
+
+    def labels(self, *args, **kwargs) -> _Child:
+        if args and kwargs:
+            raise ValueError("pass labels positionally OR by name")
+        if kwargs:
+            try:
+                vals = tuple(str(kwargs[ln]) for ln in self.labelnames)
+            except KeyError as e:
+                raise ValueError(
+                    f"{self.name}: missing label {e.args[0]!r} "
+                    f"(expects {self.labelnames})") from None
+            if len(kwargs) != len(self.labelnames):
+                extra = set(kwargs) - set(self.labelnames)
+                raise ValueError(f"{self.name}: unknown labels {extra}")
+        else:
+            if len(args) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: expected {len(self.labelnames)} label "
+                    f"values {self.labelnames}, got {len(args)}")
+            vals = tuple(str(a) for a in args)
+        return _Child(self, vals)
+
+    def _unlabelled(self) -> Tuple[str, ...]:
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} has labels {self.labelnames}; "
+                f"use .labels(...)")
+        return ()
+
+    # -- value ops (overridden per kind) -------------------------------------
+
+    def _inc(self, key, n) -> None:
+        raise TypeError(f"{self.kind} does not support inc()")
+
+    def _set(self, key, v) -> None:
+        raise TypeError(f"{self.kind} does not support set()")
+
+    def _observe(self, key, v) -> None:
+        raise TypeError(f"{self.kind} does not support observe()")
+
+    def value(self, *labelvals) -> float:
+        with self._mu:
+            return self._values.get(tuple(str(v) for v in labelvals), 0.0)
+
+    def series(self) -> Dict[Tuple[str, ...], float]:
+        with self._mu:
+            return dict(self._values)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._values.clear()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1.0) -> None:
+        self._inc(self._unlabelled(), n)
+
+    def _inc(self, key, n) -> None:
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up ({n})")
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def get(self) -> float:
+        return self.value()
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float) -> None:
+        self._set(self._unlabelled(), v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._inc(self._unlabelled(), n)
+
+    def _set(self, key, v) -> None:
+        with self._mu:
+            self._values[key] = float(v)
+
+    def _inc(self, key, n) -> None:
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def get(self) -> float:
+        return self.value()
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = tuple(b) + (math.inf,)
+        # per-series state: [counts per bucket] + sum + count
+        self._hist: Dict[Tuple[str, ...], dict] = {}
+
+    def observe(self, v: float) -> None:
+        self._observe(self._unlabelled(), v)
+
+    def _observe(self, key, v) -> None:
+        v = float(v)
+        with self._mu:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = {
+                    "counts": [0] * len(self.buckets),
+                    "sum": 0.0, "count": 0}
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    h["counts"][i] += 1
+                    break
+            h["sum"] += v
+            h["count"] += 1
+            # keep _values in sync so snapshot() has a scalar view
+            self._values[key] = h["sum"]
+
+    def series_hist(self) -> Dict[Tuple[str, ...], dict]:
+        with self._mu:
+            return {k: {"counts": list(h["counts"]), "sum": h["sum"],
+                        "count": h["count"]}
+                    for k, h in self._hist.items()}
+
+    def clear(self) -> None:
+        with self._mu:
+            self._values.clear()
+            self._hist.clear()
+
+
+class Registry:
+    """Named metric store. ``counter``/``gauge``/``histogram`` are
+    get-or-create (re-registration with a different kind or labelset is
+    an error — two call sites silently disagreeing about a metric's
+    meaning is exactly the bug a registry exists to prevent)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kw) -> _Metric:
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}, not {cls.kind}")
+                if tuple(labelnames) != m.labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{m.labelnames}, not {tuple(labelnames)}")
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._mu:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._mu:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def unregister(self, name: str) -> None:
+        with self._mu:
+            self._metrics.pop(name, None)
+
+    def reset(self) -> None:
+        """Drop every metric (tests)."""
+        with self._mu:
+            self._metrics.clear()
+
+    # -- output --------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-safe dump: {name: {kind, help, values: {label-expr or
+        "": value}}} (histograms additionally carry sum/count/buckets)."""
+        out: Dict[str, dict] = {}
+        for m in self.metrics():
+            entry: dict = {"kind": m.kind, "values": {}}
+            if m.help:
+                entry["help"] = m.help
+            for key, v in sorted(m.series().items()):
+                entry["values"][_labelexpr(m.labelnames, key)] = (
+                    round(v, 6) if isinstance(v, float) else v)
+            if isinstance(m, Histogram):
+                entry["histogram"] = {
+                    _labelexpr(m.labelnames, key): {
+                        "count": h["count"], "sum": round(h["sum"], 6)}
+                    for key, h in sorted(m.series_hist().items())}
+            out[m.name] = entry
+        return out
+
+    def expose_text(self) -> str:
+        """Prometheus text exposition (the contract the future
+        runtime/scheduler.py serving layer scrapes)."""
+        lines: List[str] = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            if isinstance(m, Histogram):
+                for key, h in sorted(m.series_hist().items()):
+                    acc = 0
+                    for ub, c in zip(m.buckets, h["counts"]):
+                        acc += c
+                        le = "+Inf" if ub == math.inf else _fmt(ub)
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_promlabels(m.labelnames, key, le=le)}"
+                            f" {acc}")
+                    lines.append(f"{m.name}_sum"
+                                 f"{_promlabels(m.labelnames, key)}"
+                                 f" {_fmt(h['sum'])}")
+                    lines.append(f"{m.name}_count"
+                                 f"{_promlabels(m.labelnames, key)}"
+                                 f" {h['count']}")
+                continue
+            series = sorted(m.series().items())
+            if not series and not m.labelnames:
+                series = [((), 0.0)]
+            for key, v in series:
+                lines.append(f"{m.name}{_promlabels(m.labelnames, key)}"
+                             f" {_fmt(v)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _promlabels(names: Sequence[str], vals: Sequence[str],
+                le: Optional[str] = None) -> str:
+    parts = [f'{n}="{_esc(v)}"' for n, v in zip(names, vals)]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _labelexpr(names: Sequence[str], vals: Sequence[str]) -> str:
+    if not names:
+        return ""
+    return ",".join(f"{n}={v}" for n, v in zip(names, vals))
+
+
+# ---------------------------------------------------------------------------
+# process-global registry + module-level conveniences
+# ---------------------------------------------------------------------------
+
+_registry = Registry()
+
+
+def registry() -> Registry:
+    return _registry
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> Counter:
+    return _registry.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "",
+          labelnames: Sequence[str] = ()) -> Gauge:
+    return _registry.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "",
+              labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return _registry.histogram(name, help, labelnames, buckets=buckets)
+
+
+def snapshot() -> Dict[str, dict]:
+    sync_engine_metrics()
+    return _registry.snapshot()
+
+
+def expose_text() -> str:
+    sync_engine_metrics()
+    return _registry.expose_text()
+
+
+def reset() -> None:
+    _registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# engine metric sync: the one place the legacy stats() shapes map onto
+# canonical metric names
+# ---------------------------------------------------------------------------
+
+# compile-side series are fed LIVE (kernel_cache.record_compile), not
+# synced — declare them eagerly so an exposition before any compile
+# still shows the metric families ROADMAP item 1 is judged against
+JIT_COMPILE_SECONDS = "bodo_tpu_jit_compile_seconds"
+PALLAS_TRACED = "bodo_tpu_pallas_traced_into_pipeline"
+
+
+def record_compile(program: str, seconds: float) -> None:
+    """Per-program jit compile seconds (called by kernel_cache on every
+    cache-miss first invocation — trace+lower+compile wall time)."""
+    histogram(JIT_COMPILE_SECONDS,
+              "wall seconds of jit trace+compile per program",
+              ("program",)).labels(program=program).observe(seconds)
+
+
+def sync_engine_metrics() -> None:
+    """Pull every subsystem's stats snapshot into the registry. Cheap
+    (a few dict copies); called by snapshot()/expose_text() and by
+    tracing.profile()/dump() so readers always see current values."""
+    # -- memory governor -----------------------------------------------------
+    try:
+        from bodo_tpu.runtime.memory_governor import governor
+        mem = governor().stats()
+        gauge("bodo_tpu_mem_derived_budget_bytes",
+              "memory governor derived per-device budget").set(
+            mem.get("derived_budget_bytes", 0))
+        gauge("bodo_tpu_mem_oom_retries_total",
+              "stage re-runs after RESOURCE_EXHAUSTED").set(
+            mem.get("n_oom_retries", 0))
+        g = gauge("bodo_tpu_mem_operator_bytes",
+                  "per-operator granted/peak/spilled bytes",
+                  ("op", "kind"))
+        ge = gauge("bodo_tpu_mem_operator_events",
+                   "per-operator grant count / spill count",
+                   ("op", "kind"))
+        for name, m in mem.get("operators", {}).items():
+            g.labels(op=name, kind="granted").set(m.get("granted", 0))
+            g.labels(op=name, kind="peak").set(m.get("peak", 0))
+            g.labels(op=name, kind="spilled").set(
+                m.get("spilled_bytes", 0))
+            ge.labels(op=name, kind="count").set(m.get("count", 0))
+            ge.labels(op=name, kind="n_spills").set(m.get("n_spills", 0))
+    except Exception:  # pragma: no cover - governor unavailable pre-mesh
+        pass
+    # -- resilience ----------------------------------------------------------
+    try:
+        from bodo_tpu.runtime import resilience
+        rs = resilience.stats()
+        g = gauge("bodo_tpu_resil_faults_fired_total",
+                  "armed faults fired per injection point", ("point",))
+        for point, n in rs.get("faults_fired", {}).items():
+            g.labels(point=point).set(n)
+        g = gauge("bodo_tpu_resil_retries_total",
+                  "retry-envelope retries per label", ("label",))
+        for label, n in rs.get("retries", {}).items():
+            g.labels(label=label).set(n)
+        g = gauge("bodo_tpu_resil_degraded_stages_total",
+                  "stages re-executed replicated", ("stage",))
+        for stage, n in rs.get("degraded_stages", {}).items():
+            g.labels(stage=stage).set(n)
+        gauge("bodo_tpu_resil_gang_retries_total",
+              "whole-gang spawn retries").set(rs.get("gang_retries", 0))
+    except Exception:  # pragma: no cover
+        pass
+    # -- adaptive execution --------------------------------------------------
+    try:
+        from bodo_tpu.plan import adaptive
+        aq = adaptive.stats()
+        g = gauge("bodo_tpu_aqe_decisions_total",
+                  "adaptive-execution decisions", ("decision",))
+        for decision, n in aq.get("decisions", {}).items():
+            g.labels(decision=decision).set(n)
+        qe = aq.get("q_error", {})
+        if qe.get("count"):
+            gauge("bodo_tpu_aqe_q_error_count",
+                  "first-observation estimates scored").set(
+                qe.get("count", 0))
+            gauge("bodo_tpu_aqe_q_error_mean",
+                  "mean q-error of first-observation estimates").set(
+                qe.get("mean", 0.0))
+            gauge("bodo_tpu_aqe_q_error_p50",
+                  "median q-error of first-observation estimates").set(
+                qe.get("p50", 0.0))
+            gauge("bodo_tpu_aqe_q_error_p90",
+                  "p90 q-error of first-observation estimates").set(
+                qe.get("p90", 0.0))
+            gauge("bodo_tpu_aqe_q_error_max",
+                  "worst q-error of first-observation estimates").set(
+                qe.get("max", 0.0))
+    except Exception:  # pragma: no cover
+        pass
+    # -- pipelined I/O -------------------------------------------------------
+    try:
+        from bodo_tpu.runtime import io_pool
+        ios = io_pool.io_stats()
+        g = gauge("bodo_tpu_io_events_total", "io pipeline counters",
+                  ("event",))
+        for key in ("prefetch_hits", "prefetch_streams", "prefetch_depth",
+                    "stalls", "footer_hits", "footer_misses",
+                    "parallel_units", "parallel_reads", "decode_batches",
+                    "decode_bytes"):
+            g.labels(event=key).set(ios.get(key, 0))
+        g = gauge("bodo_tpu_io_seconds", "io pipeline time split",
+                  ("phase",))
+        for phase in ("decode_s", "stall_s", "overlap_s"):
+            g.labels(phase=phase[:-2]).set(ios.get(phase, 0.0))
+        gauge("bodo_tpu_io_overlap_ratio",
+              "decode time hidden behind consumer compute").set(
+            ios.get("overlap_ratio", 0.0))
+    except Exception:  # pragma: no cover
+        pass
+    # -- shardcheck (plan validator / lint / lockstep) -----------------------
+    try:
+        from bodo_tpu.analysis import lint, lockstep, plan_validator
+        pv = plan_validator.stats()
+        gauge("bodo_tpu_plans_validated_total",
+              "plans checked by the plan validator").set(
+            pv.get("plans", 0))
+        gauge("bodo_tpu_plan_violations_total",
+              "plan invariant violations raised").set(
+            pv.get("violations", 0))
+        gauge("bodo_tpu_lint_findings_total",
+              "shardcheck lint findings").set(
+            lint.stats().get("findings", 0))
+        ls = lockstep.stats()
+        gauge("bodo_tpu_lockstep_collectives_total",
+              "host-level collective dispatches fingerprinted").set(
+            ls.get("collectives", 0))
+        gauge("bodo_tpu_lockstep_mismatches_total",
+              "lockstep divergences detected").set(
+            ls.get("mismatches", 0))
+        gauge("bodo_tpu_lockstep_timeouts_total",
+              "lockstep peer-wait timeouts").set(ls.get("timeouts", 0))
+        gauge("bodo_tpu_lockstep_wait_seconds",
+              "cumulative peer-wait seconds").set(ls.get("wait_s", 0.0))
+        gauge("bodo_tpu_lockstep_max_wait_seconds",
+              "worst single peer-wait seconds").set(
+            ls.get("max_wait_s", 0.0))
+    except Exception:  # pragma: no cover
+        pass
+    # -- compile cache + pallas engagement -----------------------------------
+    try:
+        from bodo_tpu.utils import tracing
+        cc = tracing.compile_cache_stats()
+        g = gauge("bodo_tpu_compile_cache_total",
+                  "persistent jit-cache lookups", ("result",))
+        g.labels(result="hit").set(cc["hits"])
+        g.labels(result="miss").set(cc["misses"])
+    except Exception:  # pragma: no cover
+        pass
+    # pallas_kernels imports jax — only read the counter if the module
+    # is already loaded (never force a jax import from a metrics scrape)
+    pk = sys.modules.get("bodo_tpu.ops.pallas_kernels")
+    if pk is not None:
+        gauge(PALLAS_TRACED,
+              "pallas kernels traced into compiled pipelines").set(
+            getattr(pk, "trace_count", 0))
+    # -- tracing layer (events buffer + per-query operator counters) ---------
+    try:
+        from bodo_tpu.utils import tracing
+        gauge("bodo_tpu_trace_events_dropped_total",
+              "trace events dropped by the ring buffer").set(
+            tracing.dropped_events())
+        cs = counter("bodo_tpu_operator_seconds_total",
+                     "operator wall seconds per query", ("op", "query"))
+        cc2 = counter("bodo_tpu_operator_calls_total",
+                      "operator invocations per query", ("op", "query"))
+        cr = counter("bodo_tpu_operator_rows_total",
+                     "operator output rows per query", ("op", "query"))
+        # counters must be monotonic: set absolute values via the raw
+        # series (tracing's per-query agg IS the source of truth)
+        for (qid, op), a in tracing.query_agg().items():
+            key = (str(op), str(qid or "-"))
+            with cs._mu:
+                cs._values[key] = a["total_s"]
+            with cc2._mu:
+                cc2._values[key] = float(a["count"])
+            with cr._mu:
+                cr._values[key] = float(a["rows"])
+    except Exception:  # pragma: no cover
+        pass

@@ -45,8 +45,12 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # Modules that run alone: widest kernel sets / heaviest compile load —
 # and test_io_pipeline.py, whose chaos cases (mid-stream Prefetcher
 # close, armed io.read faults, thread-leak assertions) must not share a
-# process with modules that leave streams open.
-_ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py")
+# process with modules that leave streams open. test_query_profiler.py
+# arms global tracing / resizes the event ring buffer / spawns a traced
+# gang, so it must not interleave with modules asserting on the same
+# globals.
+_ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
+             "test_query_profiler.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,363 @@
+"""Distributed query profiler tests: per-query spans, ring buffer,
+cross-rank trace merge, metrics registry, EXPLAIN ANALYZE."""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _traced(level=1):
+    import bodo_tpu
+    from bodo_tpu.utils import tracing
+    bodo_tpu.set_config(tracing_level=level)
+    tracing.reset()
+    return tracing
+
+
+def _untraced():
+    import bodo_tpu
+    bodo_tpu.set_config(tracing_level=0)
+
+
+# ---------------------------------------------------------------- spans
+
+def test_query_span_tags_events(mesh8):
+    tracing = _traced()
+    try:
+        with tracing.query_span() as qid:
+            with tracing.event("op_a"):
+                pass
+        with tracing.event("op_untagged"):
+            pass
+        out = json.loads(tracing.dump())
+        by_name = {e["name"]: e for e in out["traceEvents"]}
+        assert by_name["op_a"]["args"]["query_id"] == qid
+        assert "query_id" not in by_name["op_untagged"].get("args", {})
+        assert qid in out["query_ids"]
+    finally:
+        _untraced()
+
+
+def test_nested_spans_shadow(mesh8):
+    tracing = _traced()
+    try:
+        with tracing.query_span("outer"):
+            assert tracing.current_query_id() == "outer"
+            with tracing.query_span("inner"):
+                assert tracing.current_query_id() == "inner"
+            assert tracing.current_query_id() == "outer"
+        assert tracing.current_query_id() is None
+    finally:
+        _untraced()
+
+
+def test_per_query_profile_filtering(mesh8):
+    """profile(qid)/top_ops(qid) see only that query's operators."""
+    import bodo_tpu.pandas_api as bd
+    tracing = _traced()
+    try:
+        df = pd.DataFrame({"a": np.arange(64) % 4, "b": np.arange(64.0)})
+        with tracing.query_span("qA"):
+            bd.from_pandas(df).groupby("a", as_index=False).agg(
+                s=("b", "sum")).to_pandas()
+        with tracing.query_span("qB"):
+            b = bd.from_pandas(df)
+            b[b["a"] > 1].to_pandas()
+        pa, pb = tracing.profile("qA"), tracing.profile("qB")
+        assert "Aggregate" in pa and "Aggregate" not in pb
+        assert "Filter" in pb and "Filter" not in pa
+        tops = tracing.top_ops("qA", n=3)
+        assert 0 < len(tops) <= 3
+        assert all(t["op"] in pa for t in tops)
+        # sorted by wall seconds, descending
+        walls = [t["total_s"] for t in tops]
+        assert walls == sorted(walls, reverse=True)
+    finally:
+        _untraced()
+
+
+# ---------------------------------------------------------- ring buffer
+
+def test_ring_buffer_drop_accounting(mesh8):
+    import bodo_tpu
+    tracing = _traced()
+    try:
+        bodo_tpu.set_config(trace_events_max=8)
+        for i in range(20):
+            with tracing.event(f"e{i}"):
+                pass
+        out = json.loads(tracing.dump())
+        names = [e["name"] for e in out["traceEvents"]]
+        assert len(names) == 8
+        assert names[-1] == "e19"          # drop-oldest keeps the newest
+        assert "e0" not in names
+        assert tracing.dropped_events() == 12
+        assert out["dropped_events"] == 12
+        # aggregates keep counting past the buffer cap
+        assert len(tracing.query_agg()) == 20
+    finally:
+        bodo_tpu.set_config(trace_events_max=100_000)
+        _untraced()
+
+
+def test_tid_stability_and_clock_coherence(mesh8):
+    """Thread ids are small stable lane numbers (not raw get_ident()
+    truncated modulo 1e5 — collision-prone) and ts shares one clock
+    anchor with dur: a child event must sit inside its caller's span."""
+    tracing = _traced()
+    try:
+        # the barrier keeps all workers alive at once: a thread that
+        # exits before the next starts can hand its get_ident() to the
+        # successor, legitimately sharing a lane
+        gate = threading.Barrier(3)
+
+        def work(sync=None):
+            if sync is not None:
+                sync.wait()
+            with tracing.event("outer_op"):
+                with tracing.event("inner_op"):
+                    pass
+        threads = [threading.Thread(target=work, args=(gate,))
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        work()  # main thread too
+        evs = json.loads(tracing.dump())["traceEvents"]
+        tids = {e["tid"] for e in evs}
+        assert len(tids) == 4              # one lane per thread, no merges
+        assert all(0 <= t < 1000 for t in tids)
+        by_tid = {}
+        for e in evs:
+            by_tid.setdefault(e["tid"], {})[e["name"]] = e
+        for lane in by_tid.values():
+            o, i = lane["outer_op"], lane["inner_op"]
+            assert o["ts"] <= i["ts"]
+            assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1  # 1µs slack
+    finally:
+        _untraced()
+
+
+# ---------------------------------------------------------- trace merge
+
+def test_merge_trace_shards_deterministic(mesh8, tmp_path):
+    tracing = _traced()
+    try:
+        d = str(tmp_path)
+        for rank in (1, 0):                # write out of order on purpose
+            tracing.reset()
+            with tracing.query_span(f"q-r{rank}"):
+                with tracing.event(f"op_rank{rank}"):
+                    pass
+            tracing.dump_shard(d, rank=rank)
+        m1 = tracing.merge_trace_shards(d)
+        m2 = tracing.merge_trace_shards(d)
+        assert json.dumps(m1, sort_keys=True) == json.dumps(m2,
+                                                            sort_keys=True)
+        assert m1["ranks"] == 2
+        xs = [e for e in m1["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in xs} == {0, 1}     # pid == rank lane
+        assert min(e["ts"] for e in xs) == 0.0      # normalized origin
+        meta = [e for e in m1["traceEvents"] if e.get("ph") == "M"]
+        lanes = sorted(e["args"]["name"] for e in meta
+                       if e["name"] == "process_name")
+        assert len(lanes) == 2
+        assert lanes[0].startswith("rank 0")
+        assert lanes[1].startswith("rank 1")
+        assert set(m1["query_ids"]) == {"q-r0", "q-r1"}
+        out = tmp_path / "merged.json"
+        tracing.merge_trace_shards(d, out_path=str(out))
+        assert json.loads(out.read_text())["ranks"] == 2
+    finally:
+        _untraced()
+
+
+def test_merge_empty_dir(mesh8, tmp_path):
+    from bodo_tpu.utils import tracing
+    assert tracing.merge_trace_shards(str(tmp_path)) is None
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_concurrent_increments():
+    from bodo_tpu.utils import metrics
+    c = metrics.counter("test_prof_concurrent_total", "t", ["worker"])
+    try:
+        n_threads, n_incs = 8, 500
+
+        def work(i):
+            h = c.labels(worker=str(i % 2))
+            for _ in range(n_incs):
+                h.inc()
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value("0") + c.value("1") == n_threads * n_incs
+    finally:
+        metrics.registry().unregister("test_prof_concurrent_total")
+
+
+def test_registry_kind_and_label_conflicts():
+    from bodo_tpu.utils import metrics
+    metrics.counter("test_prof_conflict_total", "t", ["a"])
+    try:
+        with pytest.raises(ValueError):
+            metrics.gauge("test_prof_conflict_total", "t", ["a"])
+        with pytest.raises(ValueError):
+            metrics.counter("test_prof_conflict_total", "t", ["b"])
+    finally:
+        metrics.registry().unregister("test_prof_conflict_total")
+
+
+def test_prometheus_exposition():
+    from bodo_tpu.utils import metrics
+    c = metrics.counter("test_prof_expo_total", "a counter", ["op"])
+    g = metrics.gauge("test_prof_expo_gauge", "a gauge")
+    h = metrics.histogram("test_prof_expo_seconds", "a histogram",
+                          buckets=(0.1, 1.0))
+    try:
+        c.labels(op="scan").inc(3)
+        g.set(2.5)
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = metrics.registry().expose_text()
+        assert "# HELP test_prof_expo_total a counter" in text
+        assert "# TYPE test_prof_expo_total counter" in text
+        assert 'test_prof_expo_total{op="scan"} 3' in text
+        assert "test_prof_expo_gauge 2.5" in text
+        # cumulative buckets + +Inf == _count
+        assert 'test_prof_expo_seconds_bucket{le="0.1"} 1' in text
+        assert 'test_prof_expo_seconds_bucket{le="1"} 2' in text
+        assert 'test_prof_expo_seconds_bucket{le="+Inf"} 3' in text
+        assert "test_prof_expo_seconds_count 3" in text
+    finally:
+        for n in ("test_prof_expo_total", "test_prof_expo_gauge",
+                  "test_prof_expo_seconds"):
+            metrics.registry().unregister(n)
+
+
+def test_engine_metrics_sync(mesh8):
+    """The unified registry carries the engine gauges the bench JSON
+    reads (compile seconds, pallas count) and per-query operator
+    counters synthesized from the tracing aggregates."""
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.utils import metrics
+    tracing = _traced()
+    try:
+        df = pd.DataFrame({"a": np.arange(32) % 4, "b": np.arange(32.0)})
+        with tracing.query_span("qsync"):
+            bd.from_pandas(df).groupby("a", as_index=False).agg(
+                s=("b", "sum")).to_pandas()
+        snap = metrics.snapshot()
+        assert "bodo_tpu_pallas_traced_into_pipeline" in snap
+        calls = snap["bodo_tpu_operator_calls_total"]["values"]
+        tagged = {k: v for k, v in calls.items() if "query=qsync" in k}
+        assert any("op=Aggregate" in k for k in tagged)
+        secs = snap["bodo_tpu_operator_seconds_total"]["values"]
+        assert any("query=qsync" in k for k in secs)
+    finally:
+        _untraced()
+
+
+# ------------------------------------------------------ EXPLAIN ANALYZE
+
+MASK = re.compile(r"\b(wall|rows|est|bytes|mem_peak|hits)=[^\s\]]+")
+
+Q6_GOLDEN = """\
+EXPLAIN ANALYZE  query=#  wall=#
+Projection [0]  rows=#  est=#  bytes=#  wall=#
+└─ Reduce [0.0]  rows=#  est=#  bytes=#  wall=#
+   └─ Projection [0.0.0]  rows=#  est=#  bytes=#  wall=#
+      └─ Projection [0.0.0.0]  rows=#  est=#  bytes=#  wall=#
+         └─ Filter [0.0.0.0.0]  rows=#  est=#  bytes=#  wall=#
+            └─ FromPandas [0.0.0.0.0.0]  rows=#  est=#  bytes=#  wall=#"""
+
+
+def _mask(txt: str) -> str:
+    txt = MASK.sub(lambda m: f"{m.group(1)}=#", txt)
+    return re.sub(r"query=\S+", "query=#", txt)
+
+
+def test_explain_analyze_golden_tpch_q6(mesh8):
+    from bodo_tpu.sql import BodoSQLContext
+    from bodo_tpu.workloads.tpch import QUERIES, gen_tpch
+    tracing = _traced()
+    try:
+        ctx = BodoSQLContext(gen_tpch(n_orders=300, seed=0))
+        txt = ctx.explain_analyze(QUERIES[6])
+        assert _mask(txt) == Q6_GOLDEN
+        # observed cardinalities are real numbers, not placeholders
+        assert re.search(r"Filter \[0\.0\.0\.0\.0\]  rows=\d+", txt)
+        assert re.search(r"wall=\d+\.\d+s", txt)
+    finally:
+        _untraced()
+
+
+def test_explain_analyze_frame_api(mesh8):
+    import bodo_tpu.pandas_api as bd
+    tracing = _traced()
+    try:
+        df = pd.DataFrame({"a": np.arange(64) % 4, "b": np.arange(64.0)})
+        b = bd.from_pandas(df)
+        txt = b[b["a"] > 0].groupby("a", as_index=False).agg(
+            s=("b", "sum")).explain_analyze()
+        assert "EXPLAIN ANALYZE" in txt
+        assert "Aggregate" in txt and "Filter" in txt
+        m = re.search(r"Filter \[[\d.]+\]  rows=(\d+)", txt)
+        assert m and int(m.group(1)) == 48
+    finally:
+        _untraced()
+
+
+def test_explain_analyze_requires_recorded_query(mesh8):
+    from bodo_tpu.plan import explain
+    explain.reset()
+    assert "no recorded query" in explain.explain_analyze()
+
+
+# ------------------------------------------------------------- the gang
+
+@pytest.mark.slow
+def test_gang_query_id_propagation(mesh8, tmp_path):
+    """Workers inherit the spawner's query id via the env channel, and
+    the spawner leaves one merged multi-rank trace behind."""
+    import bodo_tpu
+    from bodo_tpu import spawn
+    tracing = _traced()
+    try:
+        bodo_tpu.set_config(trace_dir=str(tmp_path))
+
+        def work(rank):
+            from bodo_tpu.utils import tracing as wt
+            with wt.event("gang_op"):
+                pass
+            return {"rank": rank, "qid": wt.current_query_id(),
+                    "tracing": wt.is_tracing()}
+
+        with tracing.query_span("gangq") as qid:
+            res = spawn.run_spmd(work, 2, timeout=300)
+        assert [r["qid"] for r in res] == [qid, qid] == ["gangq", "gangq"]
+        assert all(r["tracing"] for r in res)
+        merged = spawn.last_gang_trace()
+        assert merged is not None and merged["ranks"] == 2
+        assert "gangq" in merged["query_ids"]
+        xs = [e for e in merged["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "gang_op"]
+        assert {e["pid"] for e in xs} == {0, 1}
+        assert all(e["args"]["query_id"] == "gangq" for e in xs)
+        path = spawn.last_gang_trace_path()
+        assert path and path.startswith(str(tmp_path))
+        assert json.loads(open(path).read())["ranks"] == 2
+    finally:
+        bodo_tpu.set_config(trace_dir="")
+        _untraced()

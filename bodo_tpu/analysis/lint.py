@@ -32,6 +32,15 @@ Rules:
       worker-thread model). Modules with no locks are single-threaded
       by design and out of scope.
 
+  fusion-host-call
+      A host-sync call (`jax.device_get`, `.to_pandas()`,
+      `device_put`, `.block_until_ready()`) inside a function marked
+      `@fusion_stage` (plan/fusion.py). Fusion stages run INSIDE one
+      compiled whole-stage program; a host round-trip there either
+      fails to trace or silently splits the fused program at an
+      unsharded boundary — the exact materialization fusion exists to
+      eliminate.
+
 Suppressions: `# shardcheck: ignore[rule]` (or bare
 `# shardcheck: ignore` for all rules) on the finding's line or the
 line directly above. Grandfathered findings live in
@@ -64,6 +73,8 @@ RULES = {
         "non-idempotent operation inside the retry envelope",
     "unlocked-shared-state":
         "module-global state written without holding a lock",
+    "fusion-host-call":
+        "host sync inside a @fusion_stage-decorated traced body",
 }
 
 # names that identify process/shard identity in a branch condition
@@ -95,6 +106,11 @@ _SIDE_EFFECT_OK = {"time.monotonic", "time.perf_counter", "time.time",
 
 _NONIDEMPOTENT = {"write", "writelines", "write_table", "send",
                   "sendall", "appendleft", "append_row"}
+
+# host-sync calls illegal inside a @fusion_stage body (whole-stage
+# fusion: the body runs inside ONE compiled program)
+_HOST_SYNC_NAMES = {"device_get", "to_pandas", "device_put",
+                    "block_until_ready"}
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
                    "BoundedSemaphore"}
@@ -236,6 +252,7 @@ class _Checker(ast.NodeVisitor):
         self._div_depth = 0              # rank-divergent control flow
         self._locks_held = 0             # `with <lock>:` nesting
         self._traced_depth = 0           # inside a jax-traced function
+        self._fusion_depth = 0           # inside a @fusion_stage body
         self._local_defs: List[Dict[str, ast.AST]] = [{}]
 
     # -- helpers ----------------------------------------------------------
@@ -258,14 +275,20 @@ class _Checker(ast.NodeVisitor):
         self._local_defs[-1][node.name] = node
         traced = (node.name in self.info.smap_fn_names or
                   _contains_lax_collective(node))
+        fused = any(_terminal(d) == "fusion_stage"
+                    for d in node.decorator_list)
         self._func.append(node.name)
         self._local_defs.append({})
         if traced:
             self._traced_depth += 1
+        if fused:
+            self._fusion_depth += 1
         # a lock held at the call site does not cover the function body
         saved_locks, self._locks_held = self._locks_held, 0
         self.generic_visit(node)
         self._locks_held = saved_locks
+        if fused:
+            self._fusion_depth -= 1
         if traced:
             self._traced_depth -= 1
         self._local_defs.pop()
@@ -325,6 +348,12 @@ class _Checker(ast.NodeVisitor):
                     f"{dotted or t!r} inside a jax-traced body fires "
                     f"at TRACE time only (compiled kernels are cached "
                     f"and replay without it)")
+        if self._fusion_depth and t in _HOST_SYNC_NAMES:
+            self._add(
+                "fusion-host-call", node,
+                f"{t!r} inside a @fusion_stage body: fusion stages "
+                f"trace into ONE compiled program — a host sync here "
+                f"splits the fused pipeline (or fails to trace)")
         if t == "retry_call" and node.args:
             self._check_retry_target(node)
         # dict.setdefault-style mutations via call are handled in the

@@ -65,7 +65,16 @@ class LockstepError(RuntimeError):
 _lock = threading.Lock()
 _checker = None       # Checker | False (disabled after warning) | None
 _stats = {"collectives": 0, "wait_s": 0.0, "max_wait_s": 0.0,
-          "mismatches": 0, "timeouts": 0}
+          "mismatches": 0, "timeouts": 0, "fused_dispatches": 0}
+
+# Whole-stage fusion moves member collectives INSIDE one compiled
+# program, where per-op pre_collective hooks can no longer fire at
+# dispatch (they would fire at trace time only). Instead plan/fusion.py
+# registers a per-group manifest at compile time (the member op
+# fingerprints + collective count the program subsumes) and the group
+# dispatch is sequence-numbered as ONE composite collective via
+# pre_fused() — peers must dispatch the same group at the same seq.
+_manifests: Dict[str, dict] = {}
 
 
 def stats() -> dict:
@@ -137,6 +146,44 @@ def pre_collective(op: str) -> None:
     c = _get_checker()
     if c is not None:
         c.check(op, _call_site())
+
+
+def register_fusion_manifest(group_fp: str, ops, collectives: int) -> None:
+    """Register the collective manifest of one compiled fusion group:
+    the member-op fingerprints the fused program subsumes and how many
+    in-program collectives a dispatch implies. Called at group compile
+    time (once per distinct group signature); cheap enough to call
+    unconditionally so manifests exist when lockstep is enabled later."""
+    with _lock:
+        _manifests[group_fp] = {"ops": tuple(ops),
+                                "collectives": int(collectives)}
+
+
+def fusion_manifest(group_fp: str) -> Optional[dict]:
+    with _lock:
+        m = _manifests.get(group_fp)
+        return dict(m) if m is not None else None
+
+
+def fusion_manifests() -> Dict[str, dict]:
+    with _lock:
+        return {k: dict(v) for k, v in _manifests.items()}
+
+
+def pre_fused(group_fp: str) -> None:
+    """Sequence-number one fused-group dispatch as a composite
+    collective. The fingerprint is the group fp alone (derived from the
+    group's structural signature, so identical across ranks even when a
+    rank registered its manifest in a different order); the manifest
+    resolves the fp back to member ops for diagnostics/profiling."""
+    if not config.lockstep:
+        return
+    c = _get_checker()
+    if c is None:
+        return
+    with _lock:
+        _stats["fused_dispatches"] += 1
+    c.check(f"fused[{group_fp}]", _call_site())
 
 
 def _get_checker() -> Optional["Checker"]:

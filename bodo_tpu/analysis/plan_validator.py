@@ -315,6 +315,31 @@ def dist_of(node) -> str:
     return rule(node, [dist_of(c) for c in node.children])
 
 
+def check_fusion_boundary(input_node, input_dist: str,
+                          force_rep: bool = False) -> None:
+    """Shardcheck at a whole-stage-fusion group edge: the runtime
+    distribution of the group's input table must be consistent with the
+    lattice's abstract prediction for the input subtree. A fused program
+    is compiled with explicit shardings derived from that prediction, so
+    an abstractly-REP input arriving sharded would dispatch a
+    replicated-spec program over 1D data — exactly the silent-wrong-
+    answer class the lattice exists to catch. Called by
+    plan/fusion.execute_group right before group dispatch (skipped when
+    a degraded re-run forced the input replicated: gathering is then the
+    POINT, not a violation)."""
+    if force_rep:
+        return
+    abstract = dist_of(input_node)
+    runtime = "DIST" if input_dist == "1D" else "REP"
+    if abstract == REP and runtime == DIST:
+        _stats["violations"] += 1
+        raise PlanInvariantError(
+            f"fusion group input {type(input_node).__name__} is "
+            f"abstractly REP but arrived sharded (1D) at dispatch — "
+            f"the fused program's shardings would be wrong",
+            node=input_node, rule="fusion-input-dist")
+
+
 def validate_rewrite(orig, repl) -> None:
     """AQE re-plans (plan/adaptive.py join re-ordering) must preserve
     the original subtree's schema (names+dtypes, in order) and abstract

@@ -47,6 +47,16 @@ class Config:
     stream_exec: bool = field(
         default_factory=lambda: _env_bool("BODO_TPU_STREAM_EXEC", False)
     )
+    # Whole-stage fusion (plan/fusion.py): compile maximal chains of
+    # adjacent pipeline-compatible plan nodes (filter/project, with an
+    # optional dense-aggregate root) into ONE jitted/shard_map program,
+    # so intermediate tables never materialize and per-node host syncs
+    # (filter count reads, rebuckets) collapse into a single group-exit
+    # sync. Off → every node dispatches its own kernel (pre-fusion
+    # behavior, also the fallback for non-fusable expressions).
+    fusion: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_FUSION", True)
+    )
     # Pad table capacities up to a multiple of this (TPU lane friendliness).
     capacity_round: int = field(
         default_factory=lambda: _env_int("BODO_TPU_CAPACITY_ROUND", 128)

@@ -38,9 +38,12 @@ FORCE_INTERPRET = False
 _runtime_disabled = False
 
 # number of times the pallas MXU path was TRACED into a jitted groupby
-# (trace-time, not per-execution: dense_accumulate is only called from
-# inside jit-compiled bodies, so this counts compiled-in engagements;
-# bench.py's hardware proof is the separate timed _pallas_proof run)
+# or fused pipeline stage (trace-time, not per-execution:
+# dense_accumulate is only called from inside jit-compiled bodies, so
+# this counts compiled-in engagements — interpret-mode traces included,
+# since FORCE_INTERPRET runs the same kernel through the pallas
+# interpreter; bench.py's hardware proof is the separate timed
+# _pallas_proof run). Exported as pallas_traced_into_pipeline.
 trace_count = 0
 
 
@@ -146,9 +149,8 @@ def dense_accumulate(codes, cols: Sequence, ok_masks: Sequence,
     list of f32/f64 [n_slots] arrays aligned with `cols`."""
     interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
     if (use_pallas() or interp) and n_slots <= MAX_MATMUL_SLOTS:
-        if not interp:
-            global trace_count
-            trace_count += 1
+        global trace_count
+        trace_count += 1
         vals = jnp.stack(
             [jnp.where(ok, c, 0).astype(jnp.float32)
              for c, ok in zip(cols, ok_masks)], axis=1)

@@ -31,12 +31,17 @@ from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, HASH_OPS,
 from bodo_tpu.ops.hashing import dest_shard, hash_columns
 from bodo_tpu.parallel import collectives as C
 from bodo_tpu.parallel import mesh as mesh_mod
+from bodo_tpu.plan.fusion import fusion_stage
 
 
 # ---------------------------------------------------------------------------
 # bucket pack / unpack (runs per shard, inside shard_map)
 # ---------------------------------------------------------------------------
+# These bodies trace into compiled sharded programs (and may be inlined
+# into fused whole-stage pipelines): @fusion_stage puts them under the
+# shardcheck fusion-host-call lint — no host sync is legal inside.
 
+@fusion_stage
 def bucket_rows(dest, arrays: Sequence, count, num_shards: int,
                 bucket_cap: int):
     """Pack rows into per-destination buckets of capacity `bucket_cap`.
@@ -71,6 +76,7 @@ def bucket_rows(dest, arrays: Sequence, count, num_shards: int,
     return packed, send_counts, overflow
 
 
+@fusion_stage
 def exchange_and_compact(packed: Sequence, send_counts, num_shards: int,
                          bucket_cap: int, axis: Optional[str] = None):
     """all_to_all the packed buckets + counts, then compact received rows.
@@ -86,6 +92,7 @@ def exchange_and_compact(packed: Sequence, send_counts, num_shards: int,
     return list(out), cnt
 
 
+@fusion_stage
 def shuffle_rows(dest, arrays: Sequence, count, num_shards: int,
                  bucket_cap: int, axis: Optional[str] = None):
     """Full shuffle: bucket → all_to_all → compact. The `shuffle_table`

@@ -85,12 +85,16 @@ def record(node: L.Node, *, rows: int, wall_s: float,
            est_rows: Optional[float] = None,
            bytes: Optional[int] = None, cached: bool = False,
            aqe: Optional[Dict[str, int]] = None,
-           mem_peak: Optional[int] = None) -> None:
+           mem_peak: Optional[int] = None,
+           fusion: Optional[dict] = None) -> None:
     """One node observation for the current query. Wall seconds are
     INCLUSIVE of the node's children (the executor recurses inside the
     node's span), matching Postgres' actual-time convention. A repeat
     record for the same path keeps the first full execution and only
-    bumps its hit count (memoized subplan re-reached)."""
+    bumps its hit count (memoized subplan re-reached). `fusion` carries
+    the whole-stage-fusion boundary annotation: for a group root, the
+    member ops / compile seconds / cache hit / rows in+out; for an
+    interior member, the root path it fused into."""
     path = getattr(node, "_explain_path", None)
     if path is None:
         return
@@ -105,6 +109,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
         rec["mem_peak"] = int(mem_peak)
     if aqe:
         rec["aqe"] = dict(aqe)
+    if fusion:
+        rec["fusion"] = dict(fusion)
     if getattr(node, "_explain_replanned", False):
         rec["replanned"] = True
     with _lock:
@@ -116,6 +122,11 @@ def record(node: L.Node, *, rows: int, wall_s: float,
         prev = q["records"].get(path)
         if prev is not None and not prev["cached"]:
             prev["hits"] += 1
+            # a later record may carry boundary info the first lacked
+            # (physical._record_node re-records a fused root with the
+            # group annotation attached to the node)
+            if fusion and "fusion" not in prev:
+                prev["fusion"] = dict(fusion)
             return
         if prev is not None:
             rec["hits"] = prev["hits"] + 1
@@ -193,6 +204,18 @@ def _annotate(rec: Optional[dict]) -> str:
         decs = ",".join(f"{k}x{v}" if v > 1 else k
                         for k, v in sorted(rec["aqe"].items()))
         parts.append(f"aqe=[{decs}]")
+    f = rec.get("fusion")
+    if f:
+        if "fused_into" in f:
+            parts.append(f"fused->{f['fused_into']}")
+        else:
+            bits = [f"{len(f.get('members', ()))} ops",
+                    "cache_hit" if f.get("cache_hit") else "compiled"]
+            if f.get("compile_s"):
+                bits.append(f"compile={f['compile_s']:.3f}s")
+            if "rows_in" in f:
+                bits.append(f"rows_in={f['rows_in']}")
+            parts.append(f"fused[{', '.join(bits)}]")
     if rec.get("replanned"):
         parts.append("replanned")
     if rec.get("cached"):

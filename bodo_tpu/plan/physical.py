@@ -45,6 +45,15 @@ def execute(node: L.Node, optimize_first: bool = True) -> Table:
         # instead of wrong answers or a wedged gang
         from bodo_tpu.analysis.plan_validator import validate_plan
         validate_plan(node)
+    # whole-stage fusion planning: annotate maximal pipeline-compatible
+    # regions (filter/project chains + dense-agg roots) so _exec_inner
+    # dispatches each as ONE compiled program. Planning is best-effort —
+    # a failure here must cost per-node execution, never the query.
+    try:
+        from bodo_tpu.plan.fusion import plan_fusion_groups
+        plan_fusion_groups(node)
+    except Exception as e:  # noqa: BLE001 - fusion is an optimization
+        log(1, f"fusion planning failed, executing unfused: {e}")
     from bodo_tpu.utils import tracing
     if not tracing.is_tracing():
         return _exec(node)
@@ -151,7 +160,8 @@ def _record_node(node: L.Node, t: Table, wall_s: float,
             pass
         explain.record(node, rows=t.nrows, wall_s=wall_s,
                        est_rows=est_rows, bytes=nbytes, cached=cached,
-                       aqe=aqe_delta)
+                       aqe=aqe_delta,
+                       fusion=getattr(node, "_fusion_info", None))
     except Exception:  # noqa: BLE001 - observability must not break exec
         pass
 
@@ -258,6 +268,16 @@ def _exec_inner(node: L.Node) -> Table:
                                                 L.Sort)):
         from bodo_tpu.plan import streaming
         out = streaming.try_stream_execute(node)
+        if out is not None:
+            return out
+    # whole-stage fusion: a group root dispatches its whole region as
+    # one compiled program. Streaming wins for memory-bounded aggregates
+    # (above) — its per-batch chains fuse internally via stream_chain.
+    # A None return (unfusable at runtime) falls through to per-node.
+    group = getattr(node, "_fusion_group", None)
+    if group is not None:
+        from bodo_tpu.plan import fusion
+        out = fusion.execute_group(group, _exec)
         if out is not None:
             return out
     if isinstance(node, L.ReadParquet):

@@ -739,6 +739,22 @@ def _build_stream(node: L.Node) -> Optional[Iterator[Table]]:
         if node.table.distribution != REP:
             return None
         return table_batches(node.table, batch_rows)
+    if isinstance(node, (L.Filter, L.Projection)):
+        # whole-stage fusion: compile a maximal filter/project chain
+        # into ONE jitted per-batch program (single compaction at chain
+        # exit) instead of one dispatch per stage per batch
+        from bodo_tpu.plan import fusion
+        chain = fusion.stream_chain(node)
+        if chain is not None:
+            steps, src = chain
+            inner = _build_stream(src)
+            if inner is None:
+                return None
+            out = fusion.fused_batches(steps, inner)
+            if any(isinstance(s, L.Filter) for s in steps):
+                from bodo_tpu.plan import adaptive
+                out = adaptive.coalesce_batches(out, sharded=False)
+            return out
     if isinstance(node, L.Filter):
         inner = _build_stream(node.child)
         if inner is None:

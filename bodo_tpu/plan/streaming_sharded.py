@@ -523,6 +523,21 @@ def build_stream_sharded(node, mesh=None) -> Optional[Iterator[Table]]:
             t = t.shard()
         return table_batches_sharded(t, max(batch_rows //
                                             mesh_mod.num_shards(m), 128), m)
+    if isinstance(node, (L.Filter, L.Projection)):
+        # whole-stage fusion over 1D batches: one shard_map program per
+        # chain with a single count sync, instead of per-stage dispatch
+        from bodo_tpu.plan import fusion
+        chain = fusion.stream_chain(node)
+        if chain is not None:
+            steps, src = chain
+            inner = build_stream_sharded(src, m)
+            if inner is None:
+                return None
+            out = fusion.fused_batches(steps, inner, sharded=True)
+            if any(isinstance(s, L.Filter) for s in steps):
+                from bodo_tpu.plan import adaptive
+                out = adaptive.coalesce_batches(out, sharded=True)
+            return out
     if isinstance(node, L.Filter):
         inner = build_stream_sharded(node.child, m)
         if inner is None:

@@ -34,6 +34,7 @@ from bodo_tpu.parallel import mesh as mesh_mod
 from bodo_tpu.parallel.shuffle import (_mesh_key, _MESHES, groupby_sharded,
                                        shuffle_rows)
 from bodo_tpu.plan.expr import Expr, eval_expr, infer_dtype
+from bodo_tpu.plan.fusion import fusion_stage
 from bodo_tpu.table import dtypes as dt
 from bodo_tpu.table.dict_utils import unify_dictionaries
 from bodo_tpu.table.table import Column, ONED, REP, Table, round_capacity
@@ -926,6 +927,95 @@ def _dense_slots(key_arrays, los, sizes, mask, strict_range: bool = False):
     return slot, mask
 
 
+@fusion_stage
+def dense_agg_tail(tree, live, kn, vn, specs, sizes, los, n_slots: int,
+                   use_mxu: bool):
+    """Traced dense-groupby tail: scatter `live` rows into mixed-radix
+    dense slots and reduce every aggregation in one segment (or MXU
+    one-hot matmul) pass, then decode slot indices back into key
+    columns and compact the present slots ascending.
+
+    Shared between `_groupby_agg_dense` (live = row_mask(count)) and
+    the whole-stage fusion agg stage (plan/fusion.py — live = the fused
+    filter mask, so filtered rows never materialize before aggregation).
+    Runs INSIDE a jitted program: no host sync is legal here (the
+    shardcheck `fusion-host-call` lint enforces it via @fusion_stage).
+    Returns (out_keys, out_vals_flat_pairs, n_groups)."""
+    from bodo_tpu.ops import pallas_kernels as PK_
+    from bodo_tpu.ops.groupby import _segment_agg
+    cap = tree[kn[0]][0].shape[0]
+    slot, padmask = _dense_slots([tree[n] for n in kn], los, sizes, live)
+    if use_mxu:
+        # one fused one-hot matmul: [present | per-spec columns]
+        mcols, moks = [padmask.astype(jnp.float32)], [padmask]
+        plan = []
+        for c, op in zip(vn, specs):
+            d, v = tree[c]
+            ok = K.value_ok(d, v, padmask)
+            if op == "size":
+                plan.append(("size", 0, None))  # == present column
+                continue
+            cnt_idx = len(mcols)
+            mcols.append(jnp.ones((cap,), jnp.float32))
+            moks.append(ok)
+            if op == "count":
+                plan.append(("count", cnt_idx, None))
+            elif op in ("sum", "mean"):
+                s_idx = len(mcols)
+                mcols.append(d.astype(jnp.float32))
+                moks.append(ok)
+                plan.append((op, cnt_idx, s_idx))
+        sums = PK_.dense_accumulate(slot, mcols, moks, n_slots)
+        present = sums[0] > 0
+        outs = []
+        for op, cnt_idx, s_idx in plan:
+            if op == "size":
+                outs.append((sums[0].astype(jnp.int64), None))
+            elif op == "count":
+                outs.append((sums[cnt_idx].astype(jnp.int64), None))
+            elif op == "sum":
+                outs.append((sums[s_idx], None))
+            else:  # mean
+                cnt = sums[cnt_idx]
+                m = sums[s_idx] / jnp.maximum(cnt, 1.0)
+                outs.append((jnp.where(cnt > 0, m, jnp.nan), None))
+    else:
+        present = jax.ops.segment_sum(
+            padmask.astype(jnp.int32), slot,
+            num_segments=n_slots) > 0
+        outs = [_segment_agg(op, tree[c][0], tree[c][1], slot,
+                             padmask, n_slots)
+                for c, op in zip(vn, specs)]
+    # reconstruct keys from the slot index (mixed-radix decode)
+    rem = jnp.arange(n_slots, dtype=jnp.int32)
+    key_cols = [None] * len(kn)
+    for i in range(len(kn) - 1, -1, -1):
+        code = rem % np.int32(sizes[i])
+        rem = rem // np.int32(sizes[i])
+        key_cols[i] = code.astype(jnp.int64) + np.int64(los[i])
+    vflat, slots_v = _flatten_with_valids(outs)
+    packed, n_groups = K.compact(present,
+                                 tuple(key_cols) + tuple(vflat))
+    out_keys = packed[:len(kn)]
+    out_vals = _rebuild_from_flat(packed[len(kn):], slots_v)
+    return tuple(out_keys), tuple(out_vals), n_groups
+
+
+def dense_mxu_ok(capacity: int, val_dtypes, specs) -> bool:
+    """Gate for the MXU one-hot-matmul accumulate, shared with the
+    fusion planner: f32 accumulation limits — sums/means only over
+    float32-or-narrower float columns (int sums must stay exact in
+    int64), counts only while the row capacity stays within f32's
+    exact-integer range (2^24; `present` is also a count)."""
+    def _ok(d, op):
+        if op in ("count", "size"):
+            return capacity <= (1 << 24)
+        return jnp.issubdtype(d, jnp.floating) and np.dtype(d).itemsize <= 4
+    return (capacity <= (1 << 24)
+            and all(op in ("sum", "count", "size", "mean") for op in specs)
+            and all(_ok(d, op) for d, op in zip(val_dtypes, specs)))
+
+
 def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
     """Sort-free dense groupby for small key spaces.
 
@@ -937,8 +1027,6 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
     reference's one-pass hash groupby specialized to a perfect hash
     (reference: bodo/libs/groupby/_groupby.cpp hash-table path; SURVEY §7
     'dense segment_sum when packed key space is small')."""
-    from bodo_tpu.ops.groupby import _segment_agg
-
     sizes = tuple(int(hi) - int(lo) + 1 for lo, hi in ranges)
     los = tuple(int(lo) for lo, _ in ranges)
     n_slots = 1
@@ -951,21 +1039,11 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
     # MXU one-hot matmul accumulate (TPU): sums/counts/means into a small
     # slot space go through the systolic array instead of scatter-adds
     from bodo_tpu.ops import pallas_kernels as PK
-    # f32 accumulation limits: sums/means only over float32-or-narrower
-    # float columns (int sums must stay exact in int64), counts only while
-    # the row capacity stays within f32's exact-integer range (2^24)
-    def _mxu_ok(c, op):
-        d = t.column(c).data.dtype
-        if op in ("count", "size"):
-            return t.capacity <= (1 << 24)
-        return jnp.issubdtype(d, jnp.floating) and d.itemsize <= 4
     use_mxu = ((PK.use_pallas() or PK.FORCE_INTERPRET)
                and n_slots <= PK.MAX_MATMUL_SLOTS
-               and t.capacity <= (1 << 24)  # `present` is also a count
-               and all(op in ("sum", "count", "size", "mean")
-                       for op in specs)
-               and all(_mxu_ok(c, op)
-                       for c, op in zip(val_names, specs)))
+               and dense_mxu_ok(t.capacity,
+                                [t.column(c).data.dtype for c in val_names],
+                                specs))
     key = ("gbdense", _sig(tsel), tuple(keys), tuple(zip(val_names, specs)),
            sizes, los, use_mxu)
     fn = _jit_cache.get(key)
@@ -974,63 +1052,8 @@ def _groupby_agg_dense(t: Table, keys, aggs, ranges) -> Table:
 
         def body(tree, count):
             cap = tree[kn[0]][0].shape[0]
-            slot, padmask = _dense_slots([tree[n] for n in kn], los, sizes,
-                                         K.row_mask(count, cap))
-            if use_mxu:
-                # one fused one-hot matmul: [present | per-spec columns]
-                mcols, moks = [padmask.astype(jnp.float32)], [padmask]
-                plan = []
-                for c, op in zip(vn, specs):
-                    d, v = tree[c]
-                    ok = K.value_ok(d, v, padmask)
-                    if op == "size":
-                        plan.append(("size", 0, None))  # == present column
-                        continue
-                    cnt_idx = len(mcols)
-                    mcols.append(jnp.ones((cap,), jnp.float32))
-                    moks.append(ok)
-                    if op == "count":
-                        plan.append(("count", cnt_idx, None))
-                    elif op in ("sum", "mean"):
-                        s_idx = len(mcols)
-                        mcols.append(d.astype(jnp.float32))
-                        moks.append(ok)
-                        plan.append((op, cnt_idx, s_idx))
-                from bodo_tpu.ops import pallas_kernels as PK_
-                sums = PK_.dense_accumulate(slot, mcols, moks, n_slots)
-                present = sums[0] > 0
-                outs = []
-                for op, cnt_idx, s_idx in plan:
-                    if op == "size":
-                        outs.append((sums[0].astype(jnp.int64), None))
-                    elif op == "count":
-                        outs.append((sums[cnt_idx].astype(jnp.int64), None))
-                    elif op == "sum":
-                        outs.append((sums[s_idx], None))
-                    else:  # mean
-                        cnt = sums[cnt_idx]
-                        m = sums[s_idx] / jnp.maximum(cnt, 1.0)
-                        outs.append((jnp.where(cnt > 0, m, jnp.nan), None))
-            else:
-                present = jax.ops.segment_sum(
-                    padmask.astype(jnp.int32), slot,
-                    num_segments=n_slots) > 0
-                outs = [_segment_agg(op, tree[c][0], tree[c][1], slot,
-                                     padmask, n_slots)
-                        for c, op in zip(vn, specs)]
-            # reconstruct keys from the slot index (mixed-radix decode)
-            rem = jnp.arange(n_slots, dtype=jnp.int32)
-            key_cols = [None] * len(kn)
-            for i in range(len(kn) - 1, -1, -1):
-                code = rem % np.int32(sizes[i])
-                rem = rem // np.int32(sizes[i])
-                key_cols[i] = code.astype(jnp.int64) + np.int64(los[i])
-            vflat, slots_v = _flatten_with_valids(outs)
-            packed, n_groups = K.compact(present,
-                                         tuple(key_cols) + tuple(vflat))
-            out_keys = packed[:len(kn)]
-            out_vals = _rebuild_from_flat(packed[len(kn):], slots_v)
-            return tuple(out_keys), tuple(out_vals), n_groups
+            return dense_agg_tail(tree, K.row_mask(count, cap), kn, vn,
+                                  specs, sizes, los, n_slots, use_mxu)
 
         fn = jax.jit(body)
         _jit_cache[key] = fn

@@ -53,6 +53,49 @@ class KernelCache:
         self._d.clear()
 
 
+class FusionProgramCache(KernelCache):
+    """LRU of compiled whole-stage fusion programs (plan/fusion.py),
+    keyed by the fusion-group signature (op sequence + input schema/dict
+    fingerprints + distribution + agg spec). Same eviction behavior as
+    any kernel cache, plus the hit/miss/compile accounting that
+    EXPLAIN ANALYZE, tracing.profile() and the metrics registry report
+    per fusion boundary."""
+
+    def __init__(self, maxsize: int = 256):
+        super().__init__(maxsize=maxsize)
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        self.compile_s = 0.0
+
+    def lookup(self, key):
+        """`get` with hit/miss accounting (use for dispatch lookups;
+        plain `get` stays silent for introspection)."""
+        fn = self.get(key)
+        if fn is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return fn
+
+    def record_compile(self, program: str, seconds: float) -> None:
+        """Account one program compilation (feeds the shared
+        bodo_tpu_jit_compile_seconds histogram)."""
+        self.compiles += 1
+        self.compile_s += float(seconds)
+        from bodo_tpu.utils import metrics
+        metrics.record_compile(program, seconds)
+
+    def stats(self) -> dict:
+        return {"size": len(self), "hits": self.hits,
+                "misses": self.misses, "compiles": self.compiles,
+                "compile_s": self.compile_s, "evictions": self.evictions}
+
+    def reset_stats(self) -> None:
+        self.hits = self.misses = self.compiles = 0
+        self.compile_s = 0.0
+
+
 def _leaf_key(x):
     shape = getattr(x, "shape", None)
     if shape is not None and hasattr(x, "dtype"):

@@ -558,6 +558,26 @@ def sync_engine_metrics() -> None:
         gauge(PALLAS_TRACED,
               "pallas kernels traced into compiled pipelines").set(
             getattr(pk, "trace_count", 0))
+    # -- whole-stage fusion (same lazy-module rule: fusion imports jax) ------
+    fz = sys.modules.get("bodo_tpu.plan.fusion")
+    if fz is not None:
+        try:
+            fs = fz.stats()
+            g = gauge("bodo_tpu_fusion_events_total",
+                      "whole-stage fusion events", ("kind",))
+            for k in ("groups_planned", "groups_executed",
+                      "stream_chains", "partial_agg", "fallbacks",
+                      "donated", "hits", "misses", "compiles",
+                      "evictions"):
+                g.labels(kind=k).set(fs.get(k, 0))
+            gauge("bodo_tpu_fusion_compile_seconds",
+                  "cumulative fused-program compile wall seconds").set(
+                fs.get("compile_s", 0.0))
+            gauge("bodo_tpu_fusion_programs_cached",
+                  "compiled fusion programs resident in the LRU").set(
+                fs.get("size", 0))
+        except Exception:  # pragma: no cover
+            pass
     # -- tracing layer (events buffer + per-query operator counters) ---------
     try:
         from bodo_tpu.utils import tracing

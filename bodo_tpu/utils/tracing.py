@@ -466,8 +466,10 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
     compute); shardcheck contributes `lint:*` counters (plans
     validated/violations, lint findings) and a time-valued
     `lockstep:check` row (dispatches fingerprinted + peer-wait seconds)
-    plus `lockstep:mismatches`/`lockstep:timeouts`. All counter rows
-    are sourced from the unified metrics registry."""
+    plus `lockstep:mismatches`/`lockstep:timeouts`; whole-stage fusion
+    contributes `fusion:*` counter rows plus `fusion:cache`
+    (hit/miss) and a time-valued `fusion:compile` row. All counter
+    rows are sourced from the unified metrics registry."""
     from bodo_tpu.utils import metrics
     out: Dict[str, dict] = {}
     with _lock:
@@ -550,6 +552,27 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
         if n:
             out[key] = {"count": int(n), "total_s": 0.0, "max_s": 0.0,
                         "rows": 0}
+    # whole-stage fusion: per-kind counters plus a time-valued
+    # fusion:compile row (fused programs built + compile wall seconds)
+    fus = series("bodo_tpu_fusion_events_total")
+    if any(fus.values()):
+        for key in ("groups_planned", "groups_executed", "stream_chains",
+                    "partial_agg", "fallbacks", "donated"):
+            n = fus.get((key,), 0)
+            if n:
+                out[f"fusion:{key}"] = {"count": int(n), "total_s": 0.0,
+                                        "max_s": 0.0, "rows": 0}
+        out["fusion:cache"] = {
+            "count": int(fus.get(("hits",), 0)
+                         + fus.get(("misses",), 0)),
+            "total_s": 0.0, "max_s": 0.0, "rows": 0,
+            "hits": int(fus.get(("hits",), 0)),
+            "misses": int(fus.get(("misses",), 0))}
+        out["fusion:compile"] = {
+            "count": int(fus.get(("compiles",), 0)),
+            "total_s": series("bodo_tpu_fusion_compile_seconds").get(
+                (), 0.0),
+            "max_s": 0.0, "rows": 0}
     # time-valued lockstep row: dispatches checked + peer-wait seconds
     lc = series("bodo_tpu_lockstep_collectives_total").get((), 0)
     if lc:

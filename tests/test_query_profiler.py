@@ -273,25 +273,41 @@ def test_engine_metrics_sync(mesh8):
 
 MASK = re.compile(r"\b(wall|rows|est|bytes|mem_peak|hits)=[^\s\]]+")
 
+# The filter->project->project prefix fuses into one program rooted at
+# the innermost surviving Projection: the root line carries fused[...]
+# (op count, cache state, input cardinality) and absorbed members point
+# at it with fused-> instead of per-node est/bytes.
 Q6_GOLDEN = """\
 EXPLAIN ANALYZE  query=#  wall=#
 Projection [0]  rows=#  est=#  bytes=#  wall=#
 └─ Reduce [0.0]  rows=#  est=#  bytes=#  wall=#
-   └─ Projection [0.0.0]  rows=#  est=#  bytes=#  wall=#
-      └─ Projection [0.0.0.0]  rows=#  est=#  bytes=#  wall=#
-         └─ Filter [0.0.0.0.0]  rows=#  est=#  bytes=#  wall=#
+   └─ Projection [0.0.0]  rows=#  est=#  bytes=#  wall=#  fused[#]
+      └─ Projection [0.0.0.0]  rows=#  wall=#  fused->0.0.0
+         └─ Filter [0.0.0.0.0]  rows=#  wall=#  fused->0.0.0
             └─ FromPandas [0.0.0.0.0.0]  rows=#  est=#  bytes=#  wall=#"""
 
 
 def _mask(txt: str) -> str:
     txt = MASK.sub(lambda m: f"{m.group(1)}=#", txt)
+    # fused[...] content varies per run (compile vs cache_hit, wall)
+    txt = re.sub(r"fused\[[^\]]*\]", "fused[#]", txt)
     return re.sub(r"query=\S+", "query=#", txt)
+
+
+def _fresh_fusion_state():
+    """Golden tests depend on fusion engaging: return the process-wide
+    compile budget (spent mid-suite by earlier modules) with the
+    program cache so the group compiles deterministically."""
+    from bodo_tpu.plan import fusion, physical
+    physical._result_cache.clear()
+    fusion.clear_programs()
 
 
 def test_explain_analyze_golden_tpch_q6(mesh8):
     from bodo_tpu.sql import BodoSQLContext
     from bodo_tpu.workloads.tpch import QUERIES, gen_tpch
     tracing = _traced()
+    _fresh_fusion_state()
     try:
         ctx = BodoSQLContext(gen_tpch(n_orders=300, seed=0))
         txt = ctx.explain_analyze(QUERIES[6])
@@ -299,20 +315,38 @@ def test_explain_analyze_golden_tpch_q6(mesh8):
         # observed cardinalities are real numbers, not placeholders
         assert re.search(r"Filter \[0\.0\.0\.0\.0\]  rows=\d+", txt)
         assert re.search(r"wall=\d+\.\d+s", txt)
+        assert re.search(r"fused\[3 ops.*rows_in=\d+\]", txt)
     finally:
         _untraced()
 
 
 def test_explain_analyze_frame_api(mesh8):
     import bodo_tpu.pandas_api as bd
+    from bodo_tpu.config import set_config
     tracing = _traced()
+    _fresh_fusion_state()
     try:
         df = pd.DataFrame({"a": np.arange(64) % 4, "b": np.arange(64.0)})
         b = bd.from_pandas(df)
-        txt = b[b["a"] > 0].groupby("a", as_index=False).agg(
-            s=("b", "sum")).explain_analyze()
+        out = b[b["a"] > 0].groupby("a", as_index=False).agg(
+            s=("b", "sum"))
+        txt = out.explain_analyze()
         assert "EXPLAIN ANALYZE" in txt
         assert "Aggregate" in txt and "Filter" in txt
+        # the chain fused into the Aggregate root: the Filter points at
+        # it and the root shows the pre-filter input cardinality
+        assert re.search(r"Filter \[[\d.]+\].*fused->", txt)
+        assert re.search(r"Aggregate.*fused\[2 ops.*rows_in=64\]", txt)
+        # per-node cardinality observation is still exact when the
+        # group runs unfused
+        set_config(fusion=False)
+        try:
+            _fresh_fusion_state()
+            b2 = bd.from_pandas(df)
+            txt = b2[b2["a"] > 0].groupby("a", as_index=False).agg(
+                s=("b", "sum")).explain_analyze()
+        finally:
+            set_config(fusion=True)
         m = re.search(r"Filter \[[\d.]+\]  rows=(\d+)", txt)
         assert m and int(m.group(1)) == 48
     finally:

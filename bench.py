@@ -611,6 +611,89 @@ def bench_trace(args, n_rows: int):
     return 0
 
 
+def bench_telemetry(args, n_rows: int):
+    """--suite telemetry: overhead of the always-on telemetry layer
+    (runtime/telemetry.py) on the taxi hot path. The ON configuration
+    is deliberately hostile: the sampler runs at a 0.25s period (4x the
+    production default) AND the /metrics + /healthz endpoint is scraped
+    once per rep while the query runs. ON/OFF reps are interleaved so
+    clock drift and cache-warming bias cancel instead of landing on one
+    side. The JSON metric is the fractional slowdown — the acceptance
+    bar for keeping telemetry always-on in production is < 0.01."""
+    import urllib.request
+
+    import jax
+
+    import bodo_tpu
+    from bodo_tpu.config import set_config
+    from bodo_tpu.runtime import telemetry
+    from bodo_tpu.workloads.taxi import bodo_tpu_pipeline, gen_taxi_data
+
+    data_dir = os.path.join(_REPO, ".bench_data")
+    os.makedirs(data_dir, exist_ok=True)
+    pq = os.path.join(data_dir, f"trips_{n_rows}.parquet")
+    csv = os.path.join(data_dir, f"weather_{n_rows}.csv")
+    if not (os.path.exists(pq) and os.path.exists(csv)):
+        print(f"generating {n_rows} rows ...", file=sys.stderr)
+        gen_taxi_data(n_rows, pq, csv)
+    devs = jax.devices()[:args.mesh]
+    args.mesh = len(devs)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+    reps = 3 if args.quick else 5
+
+    def pipeline():
+        bodo_tpu_pipeline(pq, csv, shard=True).to_pandas()
+
+    pipeline()  # warm the kernel cache
+    set_config(telemetry=True, telemetry_interval_s=0.25)
+    addr = telemetry.serve(0)
+    telemetry.stop_sampler()  # each ON rep re-arms explicitly
+    samples0 = telemetry.samples_total()
+    base_t = on_t = 0.0
+    scrapes = 0
+    try:
+        for _ in range(reps):
+            telemetry.stop_sampler()
+            t0 = time.perf_counter()
+            pipeline()
+            base_t += time.perf_counter() - t0
+            telemetry.ensure_sampler()
+            t0 = time.perf_counter()
+            pipeline()
+            for ep in ("/metrics", "/healthz"):
+                with urllib.request.urlopen(
+                        f"http://{addr}{ep}", timeout=30) as r:
+                    r.read()
+                scrapes += 1
+            on_t += time.perf_counter() - t0
+    finally:
+        telemetry.stop_sampler()
+        telemetry.shutdown_server()
+        set_config(telemetry_interval_s=1.0)
+    base_s, on_s = base_t / reps, on_t / reps
+    samples = telemetry.samples_total() - samples0
+    overhead = (on_s - base_s) / base_s if base_s > 0 else 0.0
+    print(f"telemetry: base {base_s:.4f}s on {on_s:.4f}s "
+          f"({samples} samples, {scrapes} scrapes)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "telemetry_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "vs_baseline": round(1.0 + overhead, 4),
+        "detail": {"rows": n_rows, "reps": reps,
+                   "base_s": round(base_s, 4),
+                   "telemetry_s": round(on_s, 4),
+                   "sampler_interval_s": 0.25,
+                   "samples": int(samples),
+                   "endpoint_scrapes": int(scrapes),
+                   "n_devices": args.mesh,
+                   "platform": devs[0].platform,
+                   "probe": getattr(args, "probe",
+                                    {"attempted": False})},
+    }))
+    return 0
+
+
 def _fusion_pallas_probe(quick: bool) -> dict:
     """Interpret-mode probe proving the Pallas dense-accumulate kernel
     sits INSIDE a fused program: runs a small filter->assign->groupby-sum
@@ -891,7 +974,7 @@ def main():
                          "as a collectives correctness probe)")
     ap.add_argument("--suite",
                     choices=["taxi", "tpch", "scan", "lockstep",
-                             "trace", "fusion"],
+                             "trace", "fusion", "telemetry"],
                     default="taxi")
     ap.add_argument("--explain", action="store_true",
                     help="taxi: EXPLAIN ANALYZE the plan-based pipeline "
@@ -916,6 +999,8 @@ def main():
         args.rows = 500_000  # span cost, not scan cost
     if args.suite == "fusion" and args.rows is None and not args.quick:
         args.rows = 500_000  # fusion win shows per-stage, not per-scan
+    if args.suite == "telemetry" and args.rows is None and not args.quick:
+        args.rows = 500_000  # sampler cost, not scan cost
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -980,6 +1065,8 @@ def main():
         return bench_trace(args, n_rows)
     if args.suite == "fusion":
         return bench_fusion(args, n_rows)
+    if args.suite == "telemetry":
+        return bench_telemetry(args, n_rows)
 
     import pandas as pd  # noqa: F401
 

@@ -82,6 +82,35 @@ def stats() -> dict:
         return dict(_stats)
 
 
+def sequence_head() -> int:
+    """Sequence number of this process's last fingerprinted collective
+    dispatch (0 before any dispatch / when the checker is off). The
+    telemetry sampler records it so a wedged gang's bundle shows how
+    far each rank got."""
+    c = _checker
+    if not c:  # None (unbound) or False (disabled)
+        return 0
+    with c._mu:
+        return c.seq
+
+
+def _flight_record(err: "LockstepError") -> None:
+    """Best-effort flight-recorder bundle at the moment of divergence
+    (the raise may be swallowed by user code; the bundle survives).
+    Lazy: never pulls the telemetry module in just for this."""
+    tl = sys.modules.get("bodo_tpu.runtime.telemetry")
+    if tl is None:
+        try:
+            from bodo_tpu.runtime import telemetry as tl
+        except Exception:
+            return
+    try:
+        tl.dump_bundle(f"lockstep_seq{err.seq}_rank{err.rank}",
+                       gang_dir=config.lockstep_dir or None)
+    except Exception:
+        pass
+
+
 def reset() -> None:
     """Drop the active checker and zero counters (tests; also called by
     set_config when any lockstep knob changes so the next dispatch
@@ -311,7 +340,7 @@ class Checker:
                     if got != fingerprint:
                         with _lock:
                             _stats["mismatches"] += 1
-                        raise LockstepError(
+                        err = LockstepError(
                             f"SPMD lockstep divergence at dispatch "
                             f"#{seq}: rank {self.rank} issued "
                             f"{fingerprint} but rank {peer} issued "
@@ -320,11 +349,13 @@ class Checker:
                             f"op (this would have wedged the gang)",
                             seq=seq, rank=self.rank, peer=peer,
                             site=fingerprint, peer_site=got)
+                        _flight_record(err)
+                        raise err
                     break
                 if time.monotonic() >= deadline:
                     with _lock:
                         _stats["timeouts"] += 1
-                    raise LockstepError(
+                    err = LockstepError(
                         f"SPMD lockstep divergence at dispatch #{seq} "
                         f"({fingerprint}): rank {peer} did not reach "
                         f"dispatch #{seq} within "
@@ -333,6 +364,8 @@ class Checker:
                         f"{peer} skipped the op or is wedged",
                         seq=seq, rank=self.rank, peer=peer,
                         site=fingerprint)
+                    _flight_record(err)
+                    raise err
                 time.sleep(_POLL_S)
         wait = time.monotonic() - t0
         with _lock:

@@ -159,6 +159,44 @@ class Config:
     trace_dir: str = field(
         default_factory=lambda: _env_str("BODO_TPU_TRACE_DIR", "")
     )
+    # -- telemetry / flight recorder (runtime/telemetry.py) ------------------
+    # Background sampler: one daemon thread snapshotting subsystem stats
+    # (governor occupancy, io queue depth, fusion cache, lockstep head,
+    # heartbeat age, RSS) into a bounded ring every interval. The knob
+    # gates whether ensure_sampler() actually starts the thread; it is
+    # called from init_runtime(), spawned workers, and serve().
+    telemetry: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_TELEMETRY", True)
+    )
+    telemetry_interval_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_TELEMETRY_INTERVAL",
+                                           1.0)
+    )
+    # Ring capacity (samples kept in memory; 600 x 1s = 10 min window).
+    telemetry_ring: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_TELEMETRY_RING", 600)
+    )
+    # HTTP endpoint port for /metrics + /healthz + /debug/flightrecorder
+    # (-1 = no server; 0 = bind an ephemeral port). The server is
+    # started by telemetry.serve() / init_runtime(), never at import.
+    telemetry_port: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_TELEMETRY_PORT", -1)
+    )
+    # Flight recorder: dump a self-contained diagnostic bundle on gang
+    # failure, LockstepError, or SIGUSR1.
+    flight_recorder: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_FLIGHT_RECORDER",
+                                          True)
+    )
+    # Bundle destination; empty -> <tempdir>/bodo_tpu_flightrec.
+    flight_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_FLIGHT_DIR", "")
+    )
+    # Slowest-N EXPLAIN ANALYZE records embedded per bundle.
+    flight_slow_queries: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_FLIGHT_SLOW_QUERIES",
+                                         5)
+    )
     # -- numerics ------------------------------------------------------------
     # Use bfloat16 accumulation for mean/var where tolerable (perf knob).
     low_precision_agg: bool = field(
@@ -396,6 +434,29 @@ def set_config(**kwargs) -> None:
                 os.environ["BODO_TPU_TRACE_DIR"] = v
             else:
                 os.environ.pop("BODO_TPU_TRACE_DIR", None)
+        if k in ("telemetry", "telemetry_interval_s", "flight_recorder",
+                 "flight_dir"):
+            # export like faults/lockstep/trace_dir so spawned workers
+            # inherit the telemetry + flight-recorder posture
+            env_name = {
+                "telemetry": "BODO_TPU_TELEMETRY",
+                "telemetry_interval_s": "BODO_TPU_TELEMETRY_INTERVAL",
+                "flight_recorder": "BODO_TPU_FLIGHT_RECORDER",
+                "flight_dir": "BODO_TPU_FLIGHT_DIR",
+            }[k]
+            if isinstance(v, bool):
+                os.environ[env_name] = "1" if v else "0"
+            elif v in ("", None):
+                os.environ.pop(env_name, None)
+            else:
+                os.environ[env_name] = str(v)
+            if k in ("telemetry", "telemetry_interval_s"):
+                # rebind a live sampler to the new gate/period (lazy:
+                # never imports the module just to reconfigure it)
+                import sys as _sys
+                tl = _sys.modules.get("bodo_tpu.runtime.telemetry")
+                if tl is not None:
+                    tl.reconfigure()
 
 
 def set_verbose_level(level: int) -> None:

@@ -1,0 +1,304 @@
+"""Flight-recorder bundle triage: ``python -m bodo_tpu.doctor <bundle>``.
+
+A bundle (runtime/telemetry.py ``dump_bundle``) is a self-contained
+directory; this module answers the three questions a gang post-mortem
+starts with, without the operator opening a single JSON file:
+
+  1. WHERE did the gang stop — the stuck collective fingerprint
+     (op@file:line) and the lagging/divergent rank, reconstructed from
+     the per-rank lockstep side-channel logs;
+  2. WAS it memory — the RSS / governor-spill timeline from the
+     telemetry ring, rendered as a sparkline around the failure;
+  3. WHAT was it running — the slowest recorded queries with their
+     EXPLAIN ANALYZE trees.
+
+``triage(bundle)`` returns the machine-readable verdict; ``render``
+prints the human one. With no bundle argument the CLI picks the newest
+bundle in the flight-recorder directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+_LOCKSTEP_RE = re.compile(r"^lockstep_(\d+)\.log$")
+_SHARD_RE = re.compile(r"^trace_shard_(\d+)\.json$")
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _read_json(path: str):
+    try:
+        with open(path, "r") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _parse_lockstep_logs(bundle: str) -> Dict[int, Dict[int, str]]:
+    """{rank: {seq: fingerprint}} from the copied side-channel logs."""
+    logs: Dict[int, Dict[int, str]] = {}
+    try:
+        names = os.listdir(bundle)
+    except OSError:
+        return logs
+    for name in names:
+        m = _LOCKSTEP_RE.match(name)
+        if not m:
+            continue
+        entries: Dict[int, str] = {}
+        try:
+            with open(os.path.join(bundle, name), "r") as f:
+                for line in f:
+                    if "\t" not in line:
+                        continue
+                    s, fp = line.rstrip("\n").split("\t", 1)
+                    try:
+                        entries[int(s)] = fp
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        logs[int(m.group(1))] = entries
+    return logs
+
+
+def _triage_lockstep(logs: Dict[int, Dict[int, str]]) -> Optional[dict]:
+    """Name the stuck collective and the lagging/divergent rank from
+    the per-rank dispatch streams.
+
+    * divergence: the first sequence number at which two ranks logged
+      DIFFERENT fingerprints — mismatched control flow;
+    * wedge: the rank(s) whose stream stops earliest; the "stuck
+      collective" is what the leading ranks dispatched at the first
+      sequence number the laggard never reached (every live peer is
+      blocked inside it waiting for the laggard).
+    """
+    if not logs:
+        return None
+    heads = {r: (max(e) if e else 0) for r, e in logs.items()}
+    head = max(heads.values())
+    out: dict = {"heads": {str(r): h for r, h in sorted(heads.items())},
+                 "head": head}
+    for seq in range(1, head + 1):
+        fps = {r: e[seq] for r, e in logs.items() if seq in e}
+        if len(set(fps.values())) > 1:
+            out["divergence"] = {
+                "seq": seq,
+                "fingerprints": {str(r): fp
+                                 for r, fp in sorted(fps.items())}}
+            break
+    lag = min(heads.values())
+    if lag < head:
+        lagging = sorted(r for r, h in heads.items() if h == lag)
+        out["lagging_ranks"] = lagging
+        out["lagging_rank"] = lagging[0]
+        out["lagging_last"] = logs[lagging[0]].get(lag)
+        stuck = sorted({e[lag + 1] for e in logs.values()
+                        if lag + 1 in e})
+        if stuck:
+            out["stuck_seq"] = lag + 1
+            out["stuck_collective"] = stuck[0]
+    return out
+
+
+def _triage_memory(telemetry: Optional[dict]) -> Optional[dict]:
+    samples = (telemetry or {}).get("samples") or []
+    if not samples:
+        return None
+    rss = [int(s.get("rss_bytes", 0)) for s in samples]
+    out: dict = {
+        "samples": len(samples),
+        "rss_last_bytes": rss[-1],
+        "rss_peak_bytes": max(rss),
+        "rss_series": rss[-60:],
+    }
+    mems = [s.get("mem") for s in samples if s.get("mem")]
+    if mems:
+        last = mems[-1]
+        out["budget_bytes"] = last.get("budget_bytes", 0)
+        out["spilled_bytes"] = last.get("spilled_bytes", 0)
+        out["n_spills"] = last.get("n_spills", 0)
+        out["oom_retries"] = last.get("oom_retries", 0)
+        peak = max(m.get("peak_bytes", 0) for m in mems)
+        out["operator_peak_bytes"] = peak
+        if out["budget_bytes"]:
+            out["peak_occupancy_frac"] = round(
+                peak / out["budget_bytes"], 4)
+    return out
+
+
+def triage(bundle: str) -> dict:
+    """Machine-readable triage of one flight-recorder bundle."""
+    if not os.path.isdir(bundle):
+        raise FileNotFoundError(f"not a bundle directory: {bundle}")
+    manifest = _read_json(os.path.join(bundle, "manifest.json")) or {}
+    out: dict = {
+        "bundle": os.path.abspath(bundle),
+        "reason": manifest.get("reason", "unknown"),
+        "time": manifest.get("iso_time"),
+        "faults_armed": manifest.get("faults_armed", []),
+    }
+    ranks = manifest.get("ranks") or {}
+    if ranks:
+        out["ranks"] = ranks
+        out["dead_ranks"] = sorted(
+            int(r) for r, d in ranks.items()
+            if d.get("state") in ("dead",))
+        out["hung_ranks"] = sorted(
+            int(r) for r, d in ranks.items()
+            if d.get("state") in ("hung", "timeout"))
+    out["lockstep"] = _triage_lockstep(_parse_lockstep_logs(bundle))
+    out["memory"] = _triage_memory(
+        _read_json(os.path.join(bundle, "telemetry.json")))
+    slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
+    out["slow_queries"] = [{"query_id": q.get("query_id"),
+                            "wall_s": q.get("wall_s")} for q in slow]
+    try:
+        names = sorted(os.listdir(bundle))
+    except OSError:
+        names = []
+    out["trace_shards"] = sorted(
+        int(m.group(1)) for m in (_SHARD_RE.match(n) for n in names)
+        if m)
+    out["has_merged_trace"] = "trace_merged.json" in names
+    out["stack_dumps"] = [n for n in names
+                          if n == "stacks.txt"
+                          or n.startswith("stacks_")]
+    return out
+
+
+def _spark(vals: List[int]) -> str:
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    rng = (hi - lo) or 1
+    return "".join(_SPARK[int((v - lo) / rng * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+def _fmt_bytes(n) -> str:
+    v = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB"):
+        if v < 1024 or unit == "GB":
+            return f"{int(v)}B" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024
+    return f"{v:.1f}GB"  # pragma: no cover
+
+
+def render(t: dict) -> str:
+    """Human-readable triage report."""
+    lines = [f"FLIGHT RECORDER TRIAGE  {t['bundle']}",
+             f"reason: {t['reason']}"
+             + (f"  at {t['time']}" if t.get("time") else "")]
+    if t.get("faults_armed"):
+        lines.append(f"faults armed: {', '.join(t['faults_armed'])}")
+    for r, d in sorted(t.get("ranks", {}).items(), key=lambda kv:
+                       int(kv[0])):
+        line = f"  rank {r}: {d.get('state')}"
+        if d.get("returncode") is not None:
+            line += f" rc={d['returncode']}"
+        lines.append(line)
+    ls = t.get("lockstep")
+    if ls:
+        lines.append("lockstep:")
+        heads = ", ".join(f"rank {r} @ #{h}"
+                          for r, h in ls["heads"].items())
+        lines.append(f"  dispatch heads: {heads}")
+        div = ls.get("divergence")
+        if div:
+            fps = "; ".join(f"rank {r}: {fp}"
+                            for r, fp in div["fingerprints"].items())
+            lines.append(f"  DIVERGENCE at dispatch #{div['seq']}: "
+                         f"{fps}")
+        if "lagging_rank" in ls:
+            last = ls.get("lagging_last") or "nothing"
+            lines.append(
+                f"  lagging rank: {ls['lagging_rank']} stopped at "
+                f"#{ls['heads'][str(ls['lagging_rank'])]} ({last})")
+            if "stuck_collective" in ls:
+                lines.append(
+                    f"  stuck collective: {ls['stuck_collective']} "
+                    f"(dispatch #{ls['stuck_seq']} — peers are inside "
+                    f"it waiting for rank {ls['lagging_rank']})")
+    elif ls is None and t.get("reason", "").startswith("spawn"):
+        lines.append("lockstep: no side-channel logs in bundle "
+                     "(run with BODO_TPU_LOCKSTEP=1 to fingerprint "
+                     "collective dispatches)")
+    mem = t.get("memory")
+    if mem:
+        lines.append("memory:")
+        lines.append(f"  rss timeline: {_spark(mem['rss_series'])} "
+                     f"(peak {_fmt_bytes(mem['rss_peak_bytes'])}, "
+                     f"last {_fmt_bytes(mem['rss_last_bytes'])})")
+        if mem.get("budget_bytes"):
+            occ = mem.get("peak_occupancy_frac", 0.0)
+            lines.append(
+                f"  governor: budget "
+                f"{_fmt_bytes(mem['budget_bytes'])}, operator peak "
+                f"{_fmt_bytes(mem.get('operator_peak_bytes', 0))} "
+                f"({occ:.0%}), spilled "
+                f"{_fmt_bytes(mem.get('spilled_bytes', 0))} in "
+                f"{mem.get('n_spills', 0)} spills, "
+                f"{mem.get('oom_retries', 0)} OOM retries")
+    if t.get("slow_queries"):
+        lines.append("slow queries:")
+        for q in t["slow_queries"]:
+            lines.append(f"  {q['query_id']}  "
+                         f"wall={float(q['wall_s'] or 0.0):.3f}s")
+    shards = t.get("trace_shards", [])
+    bits = [f"trace shards from ranks {shards}" if shards
+            else "no trace shards"]
+    if t.get("has_merged_trace"):
+        bits.append("merged multi-rank timeline present")
+    if t.get("stack_dumps"):
+        bits.append(f"stacks: {', '.join(t['stack_dumps'])}")
+    lines.append("artifacts: " + "; ".join(bits))
+    return "\n".join(lines)
+
+
+def _latest_bundle() -> Optional[str]:
+    from bodo_tpu.runtime import telemetry
+    base = telemetry.flight_dir()
+    try:
+        cands = [os.path.join(base, n) for n in os.listdir(base)
+                 if n.startswith("bundle_")]
+    except OSError:
+        return None
+    cands = [c for c in cands if os.path.isdir(c)]
+    return max(cands, key=os.path.getmtime) if cands else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bodo_tpu.doctor",
+        description="Triage a flight-recorder bundle.")
+    ap.add_argument("bundle", nargs="?", default=None,
+                    help="bundle directory (default: newest bundle in "
+                         "the flight-recorder dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable triage dict")
+    args = ap.parse_args(argv)
+    bundle = args.bundle or _latest_bundle()
+    if bundle is None:
+        print("doctor: no bundle given and no bundles found",
+              file=sys.stderr)
+        return 2
+    try:
+        t = triage(bundle)
+    except FileNotFoundError as e:
+        print(f"doctor: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(t, indent=2, sort_keys=True, default=str))
+    else:
+        print(render(t))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

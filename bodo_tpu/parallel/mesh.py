@@ -40,6 +40,16 @@ def init_runtime() -> None:
             num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
             process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
         )
+    # always-on telemetry for the production entry point: start the
+    # config-gated sampler and (when telemetry_port >= 0) the
+    # /metrics + /healthz endpoint. Best-effort — observability must
+    # never fail runtime init.
+    try:
+        from bodo_tpu.runtime import telemetry
+        telemetry.ensure_sampler()
+        telemetry.serve()
+    except Exception:
+        pass
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:

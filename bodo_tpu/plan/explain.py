@@ -151,6 +151,30 @@ def last_query_id() -> Optional[str]:
         return _last_qid
 
 
+def slow_queries(n: int = 5) -> List[dict]:
+    """The slowest-N recorded queries, each with its wall seconds and
+    rendered EXPLAIN ANALYZE tree — the flight recorder embeds these so
+    a post-mortem shows what the engine was busy with before it died.
+    Wall time prefers the query span; a query recorded without a span
+    falls back to its slowest (inclusive) node observation."""
+    from bodo_tpu.utils import tracing
+    with _lock:
+        qids = list(_queries.keys())
+    scored = []
+    for qid in qids:
+        wall = tracing.query_wall_s(qid)
+        if wall is None:
+            with _lock:
+                q = _queries.get(qid)
+                recs = list(q["records"].values()) if q else []
+            wall = max((r["wall_s"] for r in recs), default=0.0)
+        scored.append((float(wall), qid))
+    scored.sort(key=lambda t: -t[0])
+    return [{"query_id": qid, "wall_s": round(wall, 6),
+             "explain": explain_analyze(qid)}
+            for wall, qid in scored[:max(0, int(n))]]
+
+
 def reset() -> None:
     global _last_qid
     with _lock:

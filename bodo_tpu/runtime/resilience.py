@@ -264,6 +264,10 @@ def maybe_inject(point: str) -> None:
                 f"bodo_tpu.resilience: injected kill at {point} "
                 f"(call {n}, rank {rank})\n")
             sys.stderr.flush()
+            # the dying rank is the one whose timeline the post-mortem
+            # needs most: leave its trace shard in the gang side channel
+            # before os._exit skips every atexit/finally path
+            _dump_trace_shard_best_effort()
             os._exit(137)
         # kind == "raise"
         import builtins
@@ -271,6 +275,22 @@ def maybe_inject(point: str) -> None:
         if isinstance(cls, type) and issubclass(cls, BaseException):
             raise cls(f"injected fault at {point} (call {n})")
         raise FaultInjected(point, str(f.arg), n)
+
+
+def _dump_trace_shard_best_effort() -> None:
+    """Write this process's trace shard into the gang's shared dir (the
+    spawner merges shards into the flight-recorder bundle). Uses
+    sys.modules.get so the stdlib-only import rule holds: a pre-import
+    worker (no tracing module loaded) simply has nothing to dump."""
+    tr = sys.modules.get("bodo_tpu.utils.tracing")
+    d = os.environ.get("BODO_TPU_TRACE_SHARD_DIR")
+    if tr is None or not d:
+        return
+    try:
+        if tr.has_events():
+            tr.dump_shard(d)
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -490,6 +510,18 @@ def reset_stats() -> None:
 
 
 _hb_stop: Optional[threading.Event] = None
+_hb_last: Optional[float] = None
+
+
+def last_heartbeat_age() -> Optional[float]:
+    """Seconds since this process's own heartbeat thread last beat, or
+    None when no heartbeat ever ran (telemetry sampler input: a large
+    age in a live process means the beat thread is starved/stopped)."""
+    with _lock:
+        t = _hb_last
+    if t is None:
+        return None
+    return max(0.0, time.time() - t)
 
 
 def start_heartbeat(path: str, interval_s: Optional[float] = None
@@ -508,10 +540,13 @@ def start_heartbeat(path: str, interval_s: Optional[float] = None
         _hb_stop = stop
 
     def _beat():
+        global _hb_last
         while not stop.is_set():
             try:
                 with open(path, "w") as f:
                     f.write(str(time.time()))
+                with _lock:
+                    _hb_last = time.time()
             except OSError:
                 pass
             stop.wait(interval_s)

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import signal
 import socket
 import subprocess
 import sys
@@ -72,6 +73,14 @@ def main():
     import cloudpickle
     import jax
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # sampler + SIGUSR1 side-channel dump (trace shard, thread
+        # stacks) so the spawner's teardown grace can collect this
+        # rank's lane even when the rank is about to be hard-killed
+        from bodo_tpu.runtime import telemetry
+        telemetry.worker_init()
+    except Exception:
+        pass
 
     def _init():
         jax.distributed.initialize(
@@ -123,6 +132,10 @@ main()
 
 _POLL_S = 0.05
 _STDERR_TAIL = 800
+# teardown grace: how long the spawner waits for SIGUSR1'd ranks to
+# leave their trace shard + stacks (usr1_done_<rank> marker) before the
+# uncatchable SIGKILL lands
+_DUMP_GRACE_S = 2.0
 
 
 class SpawnError(RuntimeError):
@@ -204,6 +217,58 @@ def _merge_gang_trace(d: str) -> None:
             _last_gang_trace = merged
             _last_gang_trace_path = out_path
     except Exception:  # noqa: BLE001 - observability must not fail gangs
+        pass
+
+
+def _register_gang_health(d: str, procs, hb_paths, start: float) -> None:
+    """Expose this gang's per-rank liveness to /healthz while it runs:
+    the telemetry endpoint's server thread polls the provider closure
+    (proc returncodes, heartbeat file ages, lockstep log tails)
+    concurrently with the supervision loop. Best-effort — telemetry
+    must never fail a gang."""
+    try:
+        from bodo_tpu.runtime import telemetry
+    except Exception:  # pragma: no cover
+        return
+
+    def provider() -> Dict[int, dict]:
+        now = time.monotonic()
+        out: Dict[int, dict] = {}
+        for i, p in enumerate(procs):
+            rc = p.poll()
+            out[i] = {
+                "alive": rc is None,
+                "returncode": rc,
+                "hb_age_s": round(_hb_age(hb_paths[i], now - start), 3),
+                "last_collective": telemetry.lockstep_log_tail(d, i),
+            }
+        return out
+
+    try:
+        telemetry.set_gang_health_provider(provider)
+    except Exception:  # pragma: no cover
+        pass
+
+
+def _clear_gang_health() -> None:
+    tl = sys.modules.get("bodo_tpu.runtime.telemetry")
+    if tl is not None:
+        try:
+            tl.set_gang_health_provider(None)
+        except Exception:  # pragma: no cover
+            pass
+
+
+def _dump_flight_bundle(reason: str, ranks: Dict[int, dict],
+                        gang_dir: str) -> None:
+    """Flight-recorder bundle at the moment of gang failure, while the
+    gang temp dir (trace shards, lockstep logs, worker stderr, SIGUSR1
+    stack dumps) still exists."""
+    try:
+        from bodo_tpu.runtime import telemetry
+        telemetry.dump_bundle("spawn_" + reason.replace(" ", "_"),
+                              gang_dir=gang_dir, ranks=ranks)
+    except Exception:  # noqa: BLE001 - diagnostics never fail the gang
         pass
 
 
@@ -316,6 +381,7 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                 procs.append(subprocess.Popen(
                     [sys.executable, worker_py, payload, out_path],
                     env=env, stdout=of, stderr=ef))
+            _register_gang_health(d, procs, hb_paths, start)
             reason, failing = _supervise(procs, hb_paths, start, timeout,
                                          hb_timeout)
             if reason is None:
@@ -330,7 +396,29 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                             results.append(pickle.load(f))
                     _merge_gang_trace(d)
                     return results
-            # fast-fail: tear down the rest of the gang NOW
+            # fast-fail: tear down the rest of the gang NOW — but give
+            # live ranks one SIGUSR1 grace window first. The telemetry
+            # handler in each worker dumps its trace shard + thread
+            # stacks into the gang dir and drops a usr1_done_<rank>
+            # marker; straight SIGKILL (uncatchable) would lose exactly
+            # the lanes a post-mortem needs. A rank wedged inside
+            # native code never runs the handler — the deadline bounds
+            # the wait either way.
+            live = [i for i, p in enumerate(procs)
+                    if p.poll() is None]
+            for i in live:
+                try:
+                    procs[i].send_signal(signal.SIGUSR1)
+                except OSError:  # pragma: no cover - exited just now
+                    pass
+            grace = time.monotonic() + _DUMP_GRACE_S
+            while live and time.monotonic() < grace:
+                live = [i for i in live
+                        if procs[i].poll() is None
+                        and not os.path.exists(
+                            os.path.join(d, f"usr1_done_{i}"))]
+                if live:
+                    time.sleep(_POLL_S)
             for p in procs:
                 if p.poll() is None:
                     p.kill()
@@ -368,8 +456,10 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                             transient = False
                 ranks[i] = diag
             _merge_gang_trace(d)
+            _dump_flight_bundle(reason, ranks, d)
             raise SpawnError(reason, ranks, transient=transient)
         finally:
+            _clear_gang_health()
             for p in procs:
                 if p.poll() is None:  # pragma: no cover - safety net
                     p.kill()

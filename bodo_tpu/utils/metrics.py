@@ -303,7 +303,7 @@ class Registry:
         lines: List[str] = []
         for m in self.metrics():
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_esc_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             if isinstance(m, Histogram):
                 for key, h in sorted(m.series_hist().items()):
@@ -332,13 +332,25 @@ class Registry:
 
 
 def _fmt(v: float) -> str:
-    if isinstance(v, float) and v.is_integer():
-        return str(int(v))
+    # the exposition format spells non-finite values +Inf/-Inf/NaN —
+    # repr() would emit python's inf/nan, which scrapers reject
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+        if v.is_integer():
+            return str(int(v))
     return repr(v)
 
 
 def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _esc_help(v: str) -> str:
+    # HELP text escapes backslash and newline only (quotes stay raw)
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _promlabels(names: Sequence[str], vals: Sequence[str],
@@ -353,6 +365,174 @@ def _labelexpr(names: Sequence[str], vals: Sequence[str]) -> str:
     if not names:
         return ""
     return ",".join(f"{n}={v}" for n, v in zip(names, vals))
+
+
+# ---------------------------------------------------------------------------
+# exposition-format checker (the /metrics compliance gate)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>[^ ]+)"
+    r"( (?P<ts>-?\d+))?$")
+_LABEL_PAIR_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\["\\n])*)"$')
+_VALUE_RE = re.compile(r"^(\+Inf|-Inf|NaN|[-+]?(\d+\.?\d*|\.\d+)"
+                       r"([eE][-+]?\d+)?)$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+
+
+def _split_labels(body: str) -> Optional[List[str]]:
+    """Split the inside of a {...} label block on unescaped/unquoted
+    commas. Returns None when the quoting is broken."""
+    parts: List[str] = []
+    cur: List[str] = []
+    in_str = False
+    esc = False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+            continue
+        if ch == "\\" and in_str:
+            cur.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_str = not in_str
+            cur.append(ch)
+            continue
+        if ch == "," and not in_str:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        cur.append(ch)
+    if in_str or esc:
+        return None
+    if cur or parts:
+        parts.append("".join(cur))
+    return [p for p in parts if p]
+
+
+def _base_family(name: str) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def check_exposition(text: str) -> List[str]:
+    """Validate a Prometheus text-exposition payload line by line;
+    returns a list of problems (empty = compliant). Checks: sample-line
+    grammar, numeric values (incl. +Inf/-Inf/NaN spellings), label
+    name/escaping rules, HELP/TYPE well-formedness and uniqueness,
+    TYPE-before-samples ordering, histogram families carrying _bucket
+    (with le), _sum and _count with count == the +Inf bucket."""
+    problems: List[str] = []
+    typed: Dict[str, str] = {}
+    helped: set = set()
+    sampled: set = set()
+    # histogram family -> {"inf": value, "count": value, "sum": seen}
+    hist: Dict[str, dict] = {}
+    for i, line in enumerate(text.split("\n"), 1):
+        if line == "":
+            continue
+        if line != line.strip():
+            problems.append(f"line {i}: leading/trailing whitespace")
+            continue
+        if line.startswith("#"):
+            mh = _HELP_RE.match(line)
+            mt = _TYPE_RE.match(line)
+            if mh:
+                name = mh.group(1)
+                if name in helped:
+                    problems.append(f"line {i}: duplicate HELP {name}")
+                helped.add(name)
+                body = mh.group(2)
+                if re.search(r"(?<!\\)\\(?![\\n])", body):
+                    problems.append(
+                        f"line {i}: HELP {name}: stray backslash "
+                        f"escape in help text")
+            elif mt:
+                name = mt.group(1)
+                if name in typed:
+                    problems.append(f"line {i}: duplicate TYPE {name}")
+                if name in sampled:
+                    problems.append(
+                        f"line {i}: TYPE {name} after its samples")
+                typed[name] = mt.group(2)
+            elif line.startswith(("# HELP", "# TYPE")):
+                problems.append(f"line {i}: malformed comment: "
+                                f"{line[:80]!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"line {i}: unparseable sample: "
+                            f"{line[:80]!r}")
+            continue
+        name = m.group("name")
+        sampled.add(_base_family(name))
+        sampled.add(name)
+        if not _VALUE_RE.match(m.group("value")):
+            problems.append(f"line {i}: {name}: bad value "
+                            f"{m.group('value')!r}")
+        labels: Dict[str, str] = {}
+        if m.group("labels"):
+            body = m.group("labels")[1:-1]
+            pairs = _split_labels(body)
+            if pairs is None:
+                problems.append(
+                    f"line {i}: {name}: broken label quoting")
+                pairs = []
+            for pair in pairs:
+                ml = _LABEL_PAIR_RE.match(pair)
+                if not ml:
+                    problems.append(
+                        f"line {i}: {name}: bad label pair "
+                        f"{pair[:60]!r}")
+                    continue
+                if ml.group("name") in labels:
+                    problems.append(
+                        f"line {i}: {name}: duplicate label "
+                        f"{ml.group('name')}")
+                labels[ml.group("name")] = ml.group("value")
+        fam = _base_family(name)
+        if typed.get(fam) == "histogram":
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            h = hist.setdefault(fam, {}).setdefault(
+                key, {"inf": None, "count": None, "sum": False,
+                      "buckets": False, "line": i})
+            if name.endswith("_bucket"):
+                h["buckets"] = True
+                if "le" not in labels:
+                    problems.append(
+                        f"line {i}: {name}: _bucket without le label")
+                elif labels["le"] == "+Inf":
+                    h["inf"] = m.group("value")
+            elif name.endswith("_sum"):
+                h["sum"] = True
+            elif name.endswith("_count"):
+                h["count"] = m.group("value")
+    for fam, series in hist.items():
+        for key, h in series.items():
+            where = f"histogram {fam}{dict(key) if key else ''}"
+            if not h["buckets"]:
+                problems.append(f"{where}: no _bucket series")
+            elif h["inf"] is None:
+                problems.append(f"{where}: no le=\"+Inf\" bucket")
+            if not h["sum"]:
+                problems.append(f"{where}: missing _sum")
+            if h["count"] is None:
+                problems.append(f"{where}: missing _count")
+            elif h["inf"] is not None and h["count"] != h["inf"]:
+                problems.append(
+                    f"{where}: _count {h['count']} != +Inf bucket "
+                    f"{h['inf']}")
+    return problems
 
 
 # ---------------------------------------------------------------------------
@@ -576,6 +756,13 @@ def sync_engine_metrics() -> None:
             gauge("bodo_tpu_fusion_programs_cached",
                   "compiled fusion programs resident in the LRU").set(
                 fs.get("size", 0))
+        except Exception:  # pragma: no cover
+            pass
+    # -- telemetry sampler (same lazy-module rule) ---------------------------
+    tl = sys.modules.get("bodo_tpu.runtime.telemetry")
+    if tl is not None:
+        try:
+            tl.sync_gauges()
         except Exception:  # pragma: no cover
             pass
     # -- tracing layer (events buffer + per-query operator counters) ---------

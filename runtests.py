@@ -50,7 +50,8 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # gang, so it must not interleave with modules asserting on the same
 # globals.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
-             "test_query_profiler.py", "test_fusion.py")
+             "test_query_profiler.py", "test_fusion.py",
+             "test_telemetry.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,615 @@
+"""Live telemetry service & flight recorder tests: sampler + ring,
+/metrics + /healthz endpoints (scraped during a running query),
+exposition-format compliance, flight-recorder bundles, the SIGUSR1
+side channel, chaos gang kills/wedges, and `bodo_tpu.doctor` triage.
+
+NOTE: the tier-1 runner executes every module in ONE process, so every
+test restores global telemetry state (sampler thread, HTTP server,
+gang-health provider, registry entries) in finally/fixture teardown.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bodo_tpu.config import config
+from bodo_tpu.runtime import telemetry
+from bodo_tpu.utils import metrics
+
+
+def _get(addr, path, timeout=10):
+    with urllib.request.urlopen(f"http://{addr}{path}",
+                                timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+@pytest.fixture
+def clean_telemetry():
+    """Fresh sampler/ring/server state, restored afterwards."""
+    telemetry.reset()
+    telemetry.shutdown_server()
+    telemetry.set_gang_health_provider(None)
+    yield telemetry
+    telemetry.stop_sampler()
+    telemetry.shutdown_server()
+    telemetry.set_gang_health_provider(None)
+    telemetry.reset()
+
+
+# ------------------------------------------------------------ sampler
+
+def test_sample_shape(mesh8):
+    s = telemetry.sample()
+    assert s["rss_bytes"] > 0
+    assert s["ts"] > 0
+    # subsystems already imported by earlier tests in this process are
+    # all JSON-safe; never assert presence (import-order dependent)
+    json.dumps(s)
+
+
+def test_ring_bounded_and_counted(monkeypatch, clean_telemetry):
+    monkeypatch.setattr(config, "telemetry_ring", 5)
+    for _ in range(12):
+        telemetry.record_sample()
+    snap = telemetry.ring_snapshot()
+    assert len(snap) == 5
+    assert telemetry.samples_total() == 12
+    assert snap[-1]["rss_bytes"] > 0
+
+
+def test_sampler_thread_lifecycle(monkeypatch, clean_telemetry):
+    monkeypatch.setattr(config, "telemetry", True)
+    monkeypatch.setattr(config, "telemetry_interval_s", 0.02)
+    assert telemetry.ensure_sampler()
+    assert telemetry.sampler_running()
+    deadline = time.monotonic() + 5.0
+    while not telemetry.ring_snapshot():
+        assert time.monotonic() < deadline, "sampler never ticked"
+        time.sleep(0.01)
+    # idempotent: a second call attaches to the live thread
+    assert telemetry.ensure_sampler()
+    assert sum(1 for t in threading.enumerate()
+               if t.name == "bodo-tpu-telemetry") == 1
+    telemetry.stop_sampler()
+    assert not telemetry.sampler_running()
+
+
+def test_sampler_gated_off(monkeypatch, clean_telemetry):
+    monkeypatch.setattr(config, "telemetry", False)
+    assert not telemetry.ensure_sampler()
+    assert not telemetry.sampler_running()
+
+
+def test_reconfigure_stops_disabled_sampler(monkeypatch, clean_telemetry):
+    monkeypatch.setattr(config, "telemetry", True)
+    monkeypatch.setattr(config, "telemetry_interval_s", 0.02)
+    assert telemetry.ensure_sampler()
+    monkeypatch.setattr(config, "telemetry", False)
+    telemetry.reconfigure()
+    assert not telemetry.sampler_running()
+
+
+def test_gauges_ride_exposition(clean_telemetry):
+    """expose_text() -> sync_engine_metrics() -> telemetry.sync_gauges:
+    a /metrics scrape sees a current RSS even between sampler ticks."""
+    text = metrics.expose_text()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("bodo_tpu_process_rss_bytes ")]
+    assert line, "rss gauge missing from exposition"
+    assert float(line[0].split()[1]) > 0
+    assert metrics.check_exposition(text) == []
+
+
+# ------------------------------------------------------- http endpoint
+
+def test_endpoints_scrape_during_running_query(mesh8, clean_telemetry,
+                                               monkeypatch):
+    """Acceptance: /metrics and /healthz answer while a query is
+    executing on this process — the scrape path shares no lock with the
+    execution path."""
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.config import set_config
+    from bodo_tpu.utils import tracing
+    monkeypatch.setattr(config, "telemetry_interval_s", 0.05)
+    set_config(tracing_level=1)
+    addr = telemetry.serve(0)
+    assert addr and addr == telemetry.endpoint_address()
+    stop = threading.Event()
+    errors = []
+
+    def run_queries():
+        df = pd.DataFrame({"a": np.arange(512) % 8,
+                           "b": np.arange(512.0)})
+        try:
+            while not stop.is_set():
+                with tracing.query_span():
+                    b = bd.from_pandas(df)
+                    b.groupby("a", as_index=False).agg(
+                        s=("b", "sum")).to_pandas()
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    worker = threading.Thread(target=run_queries, daemon=True)
+    worker.start()
+    try:
+        for _ in range(3):
+            code, headers, body = _get(addr, "/metrics")
+            assert code == 200
+            assert headers["Content-Type"] == \
+                "text/plain; version=0.0.4; charset=utf-8"
+            assert metrics.check_exposition(body) == [], \
+                metrics.check_exposition(body)[:5]
+            assert "bodo_tpu_process_rss_bytes" in body
+            code, _, body = _get(addr, "/healthz")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["status"] == "ok"
+            assert doc["pid"] == os.getpid()
+            assert "telemetry" in doc
+    finally:
+        stop.set()
+        worker.join(timeout=30)
+        set_config(tracing_level=0)
+        tracing.reset()
+    assert not errors, errors
+    # unknown path: structured 404, not a stack trace
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(addr, "/nope")
+    assert ei.value.code == 404
+
+
+def test_flightrecorder_endpoint_dumps_bundle(tmp_path, monkeypatch,
+                                              clean_telemetry):
+    monkeypatch.setattr(config, "flight_dir", str(tmp_path))
+    addr = telemetry.serve(0)
+    code, _, body = _get(addr, "/debug/flightrecorder")
+    assert code == 200
+    bundle = json.loads(body)["bundle"]
+    assert bundle and os.path.isdir(bundle)
+    assert os.path.exists(os.path.join(bundle, "manifest.json"))
+    assert telemetry.last_bundle_path() == bundle
+
+
+# ------------------------------------------- exposition compliance gate
+
+class TestExpositionCompliance:
+    def test_nasty_label_values_roundtrip(self):
+        c = metrics.counter("bodo_tpu_test_nasty_total",
+                            "label escaping probe", ("path",))
+        try:
+            c.labels(path='a"b\\c\nd').inc(3)
+            text = metrics.expose_text()
+            assert metrics.check_exposition(text) == []
+            assert '\\"' in text and "\\\\" in text and "\\n" in text
+        finally:
+            metrics.registry().unregister("bodo_tpu_test_nasty_total")
+
+    def test_inf_nan_spellings(self):
+        g = metrics.gauge("bodo_tpu_test_inf_gauge", "inf probe")
+        try:
+            g.set(float("inf"))
+            text = metrics.expose_text()
+            assert "bodo_tpu_test_inf_gauge +Inf" in text
+            assert metrics.check_exposition(text) == []
+            g.set(float("nan"))
+            text = metrics.expose_text()
+            assert "bodo_tpu_test_inf_gauge NaN" in text
+            assert metrics.check_exposition(text) == []
+        finally:
+            metrics.registry().unregister("bodo_tpu_test_inf_gauge")
+
+    def test_help_escaping(self):
+        g = metrics.gauge("bodo_tpu_test_help_gauge",
+                          "first line\nsecond \\ line")
+        try:
+            g.set(1)
+            text = metrics.expose_text()
+            assert metrics.check_exposition(text) == []
+            help_line = [ln for ln in text.splitlines()
+                         if ln.startswith(
+                             "# HELP bodo_tpu_test_help_gauge")][0]
+            assert "\\n" in help_line
+        finally:
+            metrics.registry().unregister("bodo_tpu_test_help_gauge")
+
+    def test_histogram_sum_count_present(self):
+        h = metrics.histogram("bodo_tpu_test_hist_seconds",
+                              "histogram probe", ("op",),
+                              buckets=(0.1, 1.0))
+        try:
+            h.labels(op="scan").observe(0.05)
+            h.labels(op="scan").observe(5.0)
+            text = metrics.expose_text()
+            assert metrics.check_exposition(text) == []
+            assert 'bodo_tpu_test_hist_seconds_bucket{op="scan",' \
+                'le="+Inf"} 2' in text
+            assert 'bodo_tpu_test_hist_seconds_sum{op="scan"}' in text
+            assert 'bodo_tpu_test_hist_seconds_count{op="scan"} 2' \
+                in text
+        finally:
+            metrics.registry().unregister("bodo_tpu_test_hist_seconds")
+
+    @pytest.mark.parametrize("bad,needle", [
+        ("x 1 2 3", "unparseable"),
+        ("x{le=1} 2", "bad label pair"),
+        ('x{a="1",a="2"} 2', "duplicate label"),
+        ('x{a="unterminated} 2', "broken label quoting"),
+        ("x notanumber", "bad value"),
+        ("# TYPE x counter\n# TYPE x counter\nx 1", "duplicate TYPE"),
+        ("x 1\n# TYPE x counter", "after its samples"),
+        ("# HELP x bad \\q escape\nx 1", "stray backslash"),
+        (" x 1", "whitespace"),
+    ])
+    def test_malformed_lines_flagged(self, bad, needle):
+        errs = metrics.check_exposition(bad)
+        assert errs and any(needle in e for e in errs), (bad, errs)
+
+    def test_histogram_family_contract_enforced(self):
+        base = ('# TYPE h histogram\n'
+                'h_bucket{le="1.0"} 1\n')
+        # missing +Inf bucket
+        errs = metrics.check_exposition(
+            base + "h_sum 1.0\nh_count 1\n")
+        assert any("+Inf" in e for e in errs)
+        # _count disagreeing with the +Inf bucket
+        errs = metrics.check_exposition(
+            base + 'h_bucket{le="+Inf"} 3\nh_sum 1.0\nh_count 2\n')
+        assert any("!= +Inf bucket" in e for e in errs)
+        # missing _sum
+        errs = metrics.check_exposition(
+            base + 'h_bucket{le="+Inf"} 1\nh_count 1\n')
+        assert any("missing _sum" in e for e in errs)
+
+
+# -------------------------------------------------- gang health (unit)
+
+def test_gang_health_provider(monkeypatch, clean_telemetry):
+    monkeypatch.setattr(config, "spawn_hb_timeout_s", 15.0)
+    telemetry.set_gang_health_provider(lambda: {
+        0: {"alive": True, "returncode": None, "hb_age_s": 0.2,
+            "last_collective": "#3 psum@q.py:7"},
+        1: {"alive": False, "returncode": 137, "hb_age_s": 9.0,
+            "last_collective": "#2 psum@q.py:7"},
+    })
+    doc = telemetry.health()
+    assert doc["status"] == "degraded"
+    assert doc["unhealthy_ranks"] == [1]
+    assert doc["gang"]["0"]["last_collective"] == "#3 psum@q.py:7"
+    telemetry.set_gang_health_provider(None)
+    doc = telemetry.health()
+    assert "gang" not in doc and doc["status"] == "ok"
+
+
+def test_lockstep_log_tail(tmp_path):
+    with open(tmp_path / "lockstep_0.log", "w") as f:
+        f.write("1\tpsum@q.py:7\n2\tall_gather@q.py:9\n")
+    assert telemetry.lockstep_log_tail(str(tmp_path), 0) == \
+        "#2 all_gather@q.py:9"
+    assert telemetry.lockstep_log_tail(str(tmp_path), 1) is None
+
+
+# ------------------------------------------------------ flight recorder
+
+def _run_one_query():
+    import bodo_tpu.pandas_api as bd
+    df = pd.DataFrame({"a": np.arange(128) % 4, "b": np.arange(128.0)})
+    return bd.from_pandas(df).groupby("a", as_index=False).agg(
+        s=("b", "sum")).to_pandas()
+
+
+def test_dump_bundle_contents(tmp_path, monkeypatch, mesh8,
+                              clean_telemetry):
+    from bodo_tpu.config import set_config
+    from bodo_tpu.plan import explain
+    from bodo_tpu.utils import tracing
+    monkeypatch.setattr(config, "flight_dir", str(tmp_path))
+    set_config(tracing_level=1)
+    try:
+        explain.reset()
+        tracing.reset()
+        with tracing.query_span():
+            _run_one_query()
+        for _ in range(3):
+            telemetry.record_sample()
+        p = telemetry.dump_bundle("unit_test")
+        assert p and os.path.isdir(p)
+        names = set(os.listdir(p))
+        assert {"manifest.json", "telemetry.json", "metrics.prom",
+                "slow_queries.json", "stacks.txt"} <= names
+        man = json.load(open(os.path.join(p, "manifest.json")))
+        assert man["reason"] == "unit_test"
+        assert man["config"]["telemetry_ring"] == config.telemetry_ring
+        assert all(k.startswith(("BODO_TPU_", "JAX_", "XLA_"))
+                   for k in man["env"])
+        tel = json.load(open(os.path.join(p, "telemetry.json")))
+        assert len(tel["samples"]) >= 4  # ring + the failure moment
+        prom = open(os.path.join(p, "metrics.prom")).read()
+        assert metrics.check_exposition(prom) == []
+        slow = json.load(open(os.path.join(p, "slow_queries.json")))
+        assert slow and "EXPLAIN ANALYZE" in slow[0]["explain"]
+        assert slow[0]["wall_s"] >= 0
+        # the trigger counter rode the registry
+        assert "bodo_tpu_flight_bundles_total" in prom
+    finally:
+        set_config(tracing_level=0)
+        tracing.reset()
+        explain.reset()
+
+
+def test_dump_bundle_disabled(monkeypatch, clean_telemetry):
+    monkeypatch.setattr(config, "flight_recorder", False)
+    assert telemetry.dump_bundle("gated") is None
+
+
+def test_sigusr1_dumps_bundle_and_side_channel(tmp_path, monkeypatch,
+                                               clean_telemetry):
+    """The SIGUSR1 lane a spawner teardown relies on: bundle in the
+    flight dir, plus trace shard + stacks + done-marker in the gang's
+    shared dir (a chaos-killed gang still collects this rank's lane)."""
+    from bodo_tpu.config import set_config
+    from bodo_tpu.utils import tracing
+    gang = tmp_path / "gang"
+    gang.mkdir()
+    monkeypatch.setattr(config, "flight_dir", str(tmp_path))
+    monkeypatch.setenv("BODO_TPU_TRACE_SHARD_DIR", str(gang))
+    monkeypatch.setenv("BODO_TPU_PROC_ID", "3")
+    set_config(tracing_level=1)
+    try:
+        tracing.reset()
+        with tracing.event("usr1_probe"):
+            pass
+        assert telemetry.install_signal_trigger()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 10.0
+        while telemetry.last_bundle_path() is None:
+            assert time.monotonic() < deadline, "no bundle after USR1"
+            time.sleep(0.02)
+        assert "sigusr1" in os.path.basename(
+            telemetry.last_bundle_path())
+        names = set(os.listdir(gang))
+        assert "usr1_done_3" in names
+        assert "stacks_3.txt" in names
+        assert "trace_shard_3.json" in names
+    finally:
+        set_config(tracing_level=0)
+        tracing.reset()
+
+
+def test_slow_queries_ranked(mesh8):
+    from bodo_tpu.config import set_config
+    from bodo_tpu.plan import explain
+    from bodo_tpu.utils import tracing
+    set_config(tracing_level=1)
+    try:
+        explain.reset()
+        tracing.reset()
+        for _ in range(3):
+            with tracing.query_span():
+                _run_one_query()
+        slow = explain.slow_queries(2)
+        assert len(slow) == 2
+        assert slow[0]["wall_s"] >= slow[1]["wall_s"]
+        for q in slow:
+            assert q["query_id"]
+            assert "EXPLAIN ANALYZE" in q["explain"]
+    finally:
+        set_config(tracing_level=0)
+        tracing.reset()
+        explain.reset()
+
+
+# ------------------------------------------------------- doctor (unit)
+
+def _write_bundle(d, heads, *, diverge_at=None, ranks=None):
+    """Hand-craft a minimal bundle: per-rank lockstep logs with the
+    given head sequence numbers, a manifest, a telemetry ring."""
+    os.makedirs(d, exist_ok=True)
+    ops = ["psum@q.py:7", "all_gather@q.py:9", "ppermute@q.py:11"]
+    for rank, head in heads.items():
+        with open(os.path.join(d, f"lockstep_{rank}.log"), "w") as f:
+            for seq in range(1, head + 1):
+                fp = ops[(seq - 1) % len(ops)]
+                if diverge_at == seq:
+                    fp = f"rank{rank}_{fp}"
+                f.write(f"{seq}\t{fp}\n")
+    man = {"reason": "spawn_worker_death", "ts": 1.0,
+           "iso_time": "2026-08-05T00:00:00",
+           "faults_armed": ["collective@1=kill"]}
+    if ranks is not None:
+        man["ranks"] = ranks
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man, f)
+    with open(os.path.join(d, "telemetry.json"), "w") as f:
+        json.dump({"interval_s": 1.0, "samples": [
+            {"ts": t, "rss_bytes": 1000 + 100 * t,
+             "mem": {"budget_bytes": 10000, "peak_bytes": 50 * t,
+                     "spilled_bytes": 0, "n_spills": 0,
+                     "oom_retries": 0}}
+            for t in range(5)]}, f)
+
+
+class TestDoctor:
+    def test_lagging_rank_and_stuck_collective(self, tmp_path):
+        from bodo_tpu import doctor
+        d = str(tmp_path / "bundle_x")
+        _write_bundle(d, {0: 2, 1: 1}, ranks={
+            "0": {"state": "killed", "returncode": -9},
+            "1": {"state": "dead", "returncode": 137}})
+        open(os.path.join(d, "trace_shard_1.json"), "w").write("{}")
+        t = doctor.triage(d)
+        assert t["dead_ranks"] == [1]
+        ls = t["lockstep"]
+        assert ls["heads"] == {"0": 2, "1": 1}
+        assert ls["lagging_rank"] == 1
+        assert ls["stuck_seq"] == 2
+        assert ls["stuck_collective"] == "all_gather@q.py:9"
+        assert t["trace_shards"] == [1]
+        rep = doctor.render(t)
+        assert "stuck collective: all_gather@q.py:9" in rep
+        assert "waiting for rank 1" in rep
+        assert "rss timeline:" in rep
+
+    def test_divergence_named(self, tmp_path):
+        from bodo_tpu import doctor
+        d = str(tmp_path / "bundle_div")
+        _write_bundle(d, {0: 2, 1: 2}, diverge_at=2)
+        t = doctor.triage(d)
+        div = t["lockstep"]["divergence"]
+        assert div["seq"] == 2
+        assert div["fingerprints"]["0"] != div["fingerprints"]["1"]
+        assert "DIVERGENCE at dispatch #2" in doctor.render(t)
+
+    def test_cli_json_and_missing(self, tmp_path, capsys):
+        from bodo_tpu import doctor
+        d = str(tmp_path / "bundle_cli")
+        _write_bundle(d, {0: 1, 1: 1})
+        assert doctor.main([d, "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["reason"] == "spawn_worker_death"
+        assert doctor.main([str(tmp_path / "nope")]) == 2
+
+    def test_cli_picks_latest_bundle(self, tmp_path, monkeypatch,
+                                     capsys):
+        from bodo_tpu import doctor
+        monkeypatch.setattr(config, "flight_dir", str(tmp_path))
+        old = str(tmp_path / "bundle_old")
+        new = str(tmp_path / "bundle_new")
+        _write_bundle(old, {0: 1})
+        _write_bundle(new, {0: 3})
+        past = time.time() - 60
+        os.utime(old, (past, past))
+        assert doctor.main([]) == 0
+        assert "bundle_new" in capsys.readouterr().out
+
+
+# ----------------------------------------------- chaos (spawned gangs)
+
+def _chaos_env(monkeypatch, tmp_path):
+    monkeypatch.setattr(config, "flight_dir", str(tmp_path))
+    monkeypatch.setenv("BODO_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("BODO_TPU_LOCKSTEP", "1")
+    monkeypatch.setattr(config, "tracing_level", 1)
+
+
+def _parent_bundle(tmp_path):
+    """The spawner's gang-failure bundle (reason spawn_*), as opposed
+    to worker-side lockstep/sigusr1 bundles landing in the same dir."""
+    cands = [p for p in tmp_path.iterdir()
+             if p.name.startswith("bundle_") and "_spawn_" in p.name]
+    assert len(cands) == 1, [p.name for p in tmp_path.iterdir()]
+    return str(cands[0])
+
+
+@pytest.mark.slow_spawn
+def test_chaos_kill_produces_bundle_doctor_names_rank(monkeypatch,
+                                                      tmp_path):
+    """Acceptance: `collective@1=kill` mid-gang auto-produces a bundle
+    that contains the DEAD rank's trace shard (dumped on the kill path
+    before os._exit) and doctor triage names the collective the
+    survivors are stuck in and the missing rank."""
+    from bodo_tpu import doctor
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    _chaos_env(monkeypatch, tmp_path)
+    monkeypatch.setenv("BODO_TPU_FAULTS", "collective@1=kill")
+    monkeypatch.setenv("BODO_TPU_LOCKSTEP_TIMEOUT", "30")
+
+    def worker(rank):
+        import time as _time
+        from bodo_tpu.analysis import lockstep
+        from bodo_tpu.runtime import resilience
+        from bodo_tpu.utils import tracing
+        with tracing.event("chaos_step"):
+            pass
+        lockstep.pre_collective("psum")
+        if rank == 1:
+            _time.sleep(0.5)  # let rank 0 reach dispatch #2 first
+        resilience.maybe_inject("collective")  # rank 1 dies here
+        lockstep.pre_collective("all_gather")  # rank 0 waits for peer
+        _time.sleep(60)
+        return rank
+
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(worker, 2, timeout=120)
+    dt = time.monotonic() - t0
+    assert dt < 90.0, f"bundle path took {dt:.1f}s"
+    assert ei.value.reason == "worker death"
+    assert ei.value.ranks[1]["returncode"] == 137
+    b = _parent_bundle(tmp_path)
+    names = set(os.listdir(b))
+    # the dead rank's lane survived the os._exit(137)
+    assert "trace_shard_1.json" in names
+    assert "lockstep_0.log" in names and "lockstep_1.log" in names
+    # the survivor's SIGUSR1 grace lane: stacks + shard
+    assert "stacks_0.txt" in names
+    t = doctor.triage(b)
+    assert t["dead_ranks"] == [1]
+    ls = t["lockstep"]
+    assert ls["lagging_rank"] == 1
+    assert ls["stuck_seq"] == 2
+    assert ls["stuck_collective"].startswith("all_gather@")
+    rep = doctor.render(t)
+    assert "stuck collective: all_gather@" in rep
+    assert "waiting for rank 1" in rep
+
+
+@pytest.mark.slow_spawn
+def test_chaos_wedge_produces_bundle_doctor_names_rank(monkeypatch,
+                                                       tmp_path):
+    """Acceptance: a rank that wedges mid-collective (stops heartbeating
+    and never reaches the next dispatch) trips the survivor's lockstep
+    watchdog; a bundle appears within the deadline, carries the wedged
+    rank's SIGUSR1 stack dump, and doctor names the divergence site."""
+    from bodo_tpu import doctor
+    from bodo_tpu.spawn import SpawnError, run_spmd
+    _chaos_env(monkeypatch, tmp_path)
+    monkeypatch.setenv("BODO_TPU_LOCKSTEP_TIMEOUT", "3")
+
+    def worker(rank):
+        import sys as _sys
+        import time as _time
+        from bodo_tpu.analysis import lockstep
+        from bodo_tpu.utils import tracing
+        with tracing.event("chaos_step"):
+            pass
+        lockstep.pre_collective("psum")
+        if rank == 1:
+            boot = _sys.modules.get("bodo_tpu_resilience_boot")
+            if boot is not None:
+                boot.stop_heartbeat()
+            _time.sleep(120)  # wedged: never reaches dispatch #2
+        lockstep.pre_collective("all_gather")
+        _time.sleep(120)
+        return rank
+
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError) as ei:
+        run_spmd(worker, 2, timeout=120)
+    dt = time.monotonic() - t0
+    assert dt < 90.0, f"bundle path took {dt:.1f}s"
+    # rank 0 dies with the LockstepError but can then wedge in the
+    # jax.distributed atexit barrier (its heartbeat daemon still
+    # beating) — so the parent's verdict is either rank 0's death or
+    # rank 1's stale heartbeat, whichever the supervisor sees first
+    assert ei.value.reason in ("worker death", "hung worker")
+    assert "LockstepError" in str(ei.value)
+    b = _parent_bundle(tmp_path)
+    names = set(os.listdir(b))
+    # the wedged rank's SIGUSR1 grace lane (it was stuck in Python-level
+    # sleep, so the handler ran before the SIGKILL)
+    assert "stacks_1.txt" in names
+    assert "trace_shard_1.json" in names
+    t = doctor.triage(b)
+    ls = t["lockstep"]
+    assert ls["lagging_rank"] == 1
+    assert ls["stuck_collective"].startswith("all_gather@")
+    assert "waiting for rank 1" in doctor.render(t)
+    # the dying rank ALSO dumped a bundle at the LockstepError itself
+    assert any("lockstep_seq2" in p.name for p in tmp_path.iterdir())

@@ -110,18 +110,36 @@ def _probe_accelerator(timeout_s: int = 75, attempts: int = 6,
 
     The probe itself is cheap (device enumeration + a 128x128 matmul);
     the timeout only bounds a hung backend init. Overridable via
-    BODO_TPU_BENCH_PROBE_TIMEOUT / _ATTEMPTS / _BACKOFF.
+    BODO_TPU_BENCH_PROBE_TIMEOUT / _ATTEMPTS / _BACKOFF; the retry
+    envelope as a whole is capped by BODO_TPU_BENCH_PROBE_BUDGET
+    (config.bench_probe_budget_s) so a dead tunnel costs a bounded
+    slice of the round, not attempts x (timeout + backoff).
+
+    When JAX_PLATFORMS pins every requested backend to cpu the probe
+    cannot possibly succeed (the subprocess inherits the pin and
+    jax.devices() can only return cpu), so it is skipped outright —
+    previously each such run burned the full retry storm before
+    settling on the CPU-degraded path.
 
     Returns (result, probe_info): result is {"platform": ...,
     "device_kind": ..., "n": ...} on success else None; probe_info
     always records attempts / total probe seconds / outcome so a
     degraded artifact is self-describing."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip()
+    if platforms and all(
+            p.strip().lower() == "cpu"
+            for p in platforms.split(",") if p.strip()):
+        return None, {"attempted": False, "ok": False, "attempts": 0,
+                      "total_s": 0.0,
+                      "skipped": f"JAX_PLATFORMS={platforms}"}
     timeout_s = int(os.environ.get("BODO_TPU_BENCH_PROBE_TIMEOUT",
                                    timeout_s))
     attempts = int(os.environ.get("BODO_TPU_BENCH_PROBE_ATTEMPTS",
                                   attempts))
     backoff_s = int(os.environ.get("BODO_TPU_BENCH_PROBE_BACKOFF",
                                    backoff_s))
+    from bodo_tpu.config import config as _cfg
+    budget_s = float(getattr(_cfg, "bench_probe_budget_s", 150.0))
     resil = _resilience()
     probe_src = (
         "import jax, json; d = jax.devices(); "
@@ -132,7 +150,7 @@ def _probe_accelerator(timeout_s: int = 75, attempts: int = 6,
         "'device_kind': d[0].device_kind, 'n': len(d)}))")
     info = {"attempted": True, "ok": False, "attempts": 0,
             "total_s": 0.0, "timeout_s": timeout_s,
-            "max_attempts": attempts}
+            "max_attempts": attempts, "budget_s": budget_s}
 
     def _once():
         info["attempts"] += 1
@@ -156,7 +174,8 @@ def _probe_accelerator(timeout_s: int = 75, attempts: int = 6,
             policy=resil.RetryPolicy(
                 max_attempts=attempts, base_s=backoff_s, factor=1.0,
                 max_backoff_s=backoff_s,
-                deadline_s=attempts * (timeout_s + backoff_s)),
+                deadline_s=min(budget_s,
+                               attempts * (timeout_s + backoff_s))),
             # every probe failure (timeout, bad rc, unparseable stdout)
             # is worth retrying — the tunnel comes and goes
             classify=lambda e: "accelerator")
@@ -376,6 +395,65 @@ def bench_tpch(args):
     return 1 if failed else 0
 
 
+def _gen_encoding_files(data_dir: str, n_rows: int):
+    """Write one small parquet file per encoding of interest for the
+    per-encoding scan microbench (capped at 200k rows — the point is
+    decode routing, not sustained throughput). Yields (name, path);
+    files are reused across rounds once written."""
+    import numpy as np
+    import pandas as pd
+    import pyarrow.parquet as papq
+
+    n = min(n_rows, 200_000)
+    base = os.path.join(data_dir, f"enc_{n}")
+    os.makedirs(base, exist_ok=True)
+    rng = np.random.default_rng(11)
+    words = np.array([f"w{i:03d}" for i in range(64)])
+    cases = [
+        ("plain",
+         pd.DataFrame({"f64": rng.normal(size=n),
+                       "i64": rng.integers(0, 1 << 40, n)}),
+         {"use_dictionary": False}),
+        ("dict",
+         pd.DataFrame({"i": rng.integers(0, 32, n),
+                       "s": words[rng.integers(0, 64, n)]}),
+         {"use_dictionary": True}),
+        ("rle_bool",
+         pd.DataFrame({"b": rng.integers(0, 2, n).astype(bool)}),
+         {"version": "2.6"}),
+        ("delta",
+         pd.DataFrame({"i": np.cumsum(rng.integers(0, 9, n))}),
+         {"use_dictionary": False,
+          "column_encoding": {"i": "DELTA_BINARY_PACKED"}}),
+        ("byte_stream_split",
+         pd.DataFrame({"f": rng.normal(size=n).astype(np.float32)}),
+         {"use_dictionary": False,
+          "column_encoding": {"f": "BYTE_STREAM_SPLIT"}}),
+        ("nulls",
+         pd.DataFrame({"f": np.where(rng.random(n) < 0.2, np.nan,
+                                     rng.normal(size=n)),
+                       "i": pd.Series(rng.integers(0, 1000, n),
+                                      dtype="Int64").where(
+                           pd.Series(rng.random(n) >= 0.2))}),
+         {}),
+    ]
+    for name, df, kw in cases:
+        path = os.path.join(base, f"{name}.parquet")
+        if not os.path.exists(path):
+            try:
+                df.to_parquet(path, engine="pyarrow", index=False, **kw)
+            except Exception as e:
+                print(f"enc file {name} skipped: {e}", file=sys.stderr)
+                continue
+        # sanity: the encoding actually landed (column_encoding support
+        # varies across pyarrow versions)
+        try:
+            papq.ParquetFile(path).metadata
+        except Exception:
+            continue
+        yield name, path
+
+
 def bench_scan(args, n_rows: int):
     """--suite scan: scan-path micro-benchmark. Cold pass (empty footer
     cache) and hot pass (footers cached) over the taxi parquet+csv
@@ -437,7 +515,43 @@ def bench_scan(args, n_rows: int):
     stream_s = time.perf_counter() - t0
     stream_stats = io_pool.io_stats()
     print(f"stream: {rows} rows in {stream_s:.3f}s, overlap "
-          f"{stream_stats['overlap_ratio']:.2f}", file=sys.stderr)
+          f"{stream_stats['overlap_ratio']:.2f}, device_decode_frac "
+          f"{stream_stats.get('device_decode_frac', 0.0):.2f}",
+          file=sys.stderr)
+
+    # per-encoding device-decode microbench: one small file per parquet
+    # encoding. Device-eligible encodings (PLAIN, dictionary, RLE bool,
+    # def-levels) should decode on-chip (frac ~= 1.0); DELTA_* and
+    # BYTE_STREAM_SPLIT columns fall back to the host decoder per
+    # column, which shows up as fallback_cols > 0 and frac < 1.
+    enc_results = {}
+    from bodo_tpu.config import config as _cfg, set_config
+    _old_min = _cfg.device_decode_min_bytes
+    # the microfiles are deliberately small; this section measures
+    # decode ROUTING, so drop the size gate for its duration
+    set_config(device_decode_min_bytes=0)
+    for enc_name, enc_path in _gen_encoding_files(data_dir, n_rows):
+        clear_footer_cache()
+        read_parquet(enc_path)  # warm: footer + decode-program compiles
+        io_pool.reset_io_stats()
+        t0 = time.perf_counter()
+        t = read_parquet(enc_path)
+        jax.block_until_ready(next(iter(t.columns.values())).data)
+        enc_s = time.perf_counter() - t0
+        st = io_pool.io_stats()
+        sz = os.path.getsize(enc_path)
+        enc_results[enc_name] = {
+            "mb_per_s": round(sz / enc_s / 1e6, 1),
+            "file_mb": round(sz / 1e6, 2),
+            "device_decode_frac": round(
+                st.get("device_decode_frac", 0.0), 4),
+            "device_decode_pages": st.get("device_decode_pages", 0),
+            "fallback_cols": st.get("device_fallback_cols", 0)}
+    set_config(device_decode_min_bytes=_old_min)
+    if enc_results:
+        print("encodings: " + "  ".join(
+            f"{k} {v['mb_per_s']}MB/s frac={v['device_decode_frac']}"
+            for k, v in enc_results.items()), file=sys.stderr)
 
     detail = {"rows": n_rows, "scanned_mb": round(scanned / 1e6, 1),
               "cold_s": round(cold_s, 3), "hot_s": round(hot_s, 3),
@@ -445,6 +559,11 @@ def bench_scan(args, n_rows: int):
               "hot_mb_per_s": round(hot_mbps, 1),
               "stream_s": round(stream_s, 3),
               "overlap_ratio": round(stream_stats["overlap_ratio"], 4),
+              "device_decode_frac": round(
+                  stream_stats.get("device_decode_frac", 0.0), 4),
+              "device_fallback_cols": stream_stats.get(
+                  "device_fallback_cols", 0),
+              "encodings": enc_results,
               "platform": devs[0].platform,
               "device_kind": devs[0].device_kind,
               "n_devices": len(devs),
@@ -1191,6 +1310,36 @@ def main():
               "probe": getattr(args, "probe", {"attempted": False}),
               "resilience": tracing.resilience_stats(),
               "aqe": tracing.aqe_stats()}
+    # Regression guard: r05 shipped a round where fusion was on yet
+    # pallas_traced_into_pipeline read 0 — the dense-accumulate kernel
+    # had silently dropped out of the fused pipeline and the artifact
+    # recorded it without complaint. If the hot run traced nothing,
+    # rerun the interpret-mode probe as a rescue: it traces on any
+    # backend, so a zero THERE is a real routing regression rather
+    # than a backend artifact, and the round fails loudly.
+    from bodo_tpu.config import config as _live_cfg
+    if getattr(_live_cfg, "fusion", True):
+        guard = {"hot_trace_count": int(PK.trace_count)}
+        if PK.trace_count == 0:
+            try:
+                rescue = _fusion_pallas_probe(True)
+                guard["probe"] = rescue
+                guard["rescued"] = (
+                    rescue["pallas_traced_into_pipeline"] > 0)
+            except Exception as e:
+                guard["probe_error"] = f"{type(e).__name__}: {e}"
+                guard["rescued"] = False
+            if not guard["rescued"]:
+                detail["pallas_guard"] = guard
+                print(json.dumps({
+                    "metric": "nyc_taxi_speedup_vs_pandas",
+                    "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+                    "error": ("pallas_traced_into_pipeline == 0 with "
+                              "fusion on, and the interpret-mode probe "
+                              "could not trace either"),
+                    "detail": detail}))
+                return 1
+        detail["pallas_guard"] = guard
     if pallas_proof is not None:
         detail["pallas_mxu"] = pallas_proof
     if args.explain:

@@ -127,6 +127,12 @@ RUNTIME_RESULT_DIST = {
     "head": REP,         # relational.head_table gathers
     "reduce": REP,       # relational.reduce_table returns host scalars
     "nonequi_join": REP,  # ops/nonequi runs on gathered inputs
+    # a parquet scan materializes replicated on this host no matter
+    # which decode route ran (host pyarrow OR io/device_decode's raw-
+    # page programs) — the caller's _maybe_shard does the 1D reshard.
+    # The device route builds Tables directly (no arrow_to_table), so
+    # this is the contract keeping both routes interchangeable.
+    "read_parquet": REP,
 }
 
 

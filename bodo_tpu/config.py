@@ -126,6 +126,34 @@ class Config:
     io_threads: int = field(
         default_factory=lambda: _env_int("BODO_TPU_IO_THREADS", 0)
     )
+    # Device-side parquet decode (io/device_decode.py): pool workers
+    # ship raw page bytes and jitted XLA programs decode PLAIN
+    # fixed-width / dictionary / RLE-bool pages and definition levels
+    # directly into device buffers. Columns whose encoding the device
+    # programs don't cover (DELTA_*, BYTE_STREAM_SPLIT, non-dict
+    # strings, nested) transparently fall back to the host pyarrow
+    # decode per column. Off -> every page decodes on host (pre-PR 9
+    # behavior).
+    device_decode: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_DEVICE_DECODE", True)
+    )
+    # Minimum estimated decoded size (uncompressed bytes, from footer
+    # row-group metadata) before a read takes the device route. Tiny
+    # reads decode faster on host than the program dispatch costs, and
+    # every distinct page shape pins an XLA executable — not worth it
+    # below ~1 MiB. 0 -> always take the device route when enabled.
+    device_decode_min_bytes: int = field(
+        default_factory=lambda: _env_int(
+            "BODO_TPU_DEVICE_DECODE_MIN_BYTES", 1 << 20)
+    )
+    # Total wall-clock budget (seconds) for the bench accelerator probe
+    # across ALL retry attempts; <= 0 means the per-attempt
+    # timeout x attempts product is the only cap. Guards against the
+    # r05-style retry storm (6 x 75s timeouts before CPU fallback).
+    bench_probe_budget_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_BENCH_PROBE_BUDGET",
+                                           150.0)
+    )
     # -- frontend ------------------------------------------------------------
     # Fall back to real pandas for unsupported args (reference:
     # bodo/pandas/utils.py:346 check_args_fallback).

@@ -151,6 +151,20 @@ def clear_footer_cache() -> None:
         _footer_cache.clear()
 
 
+def _raw_range(path: str, start: int, size: int) -> bytes:
+    """Read one raw byte range (a column chunk's pages, offsets straight
+    from the cached footer) — what the device-decode path ships instead
+    of decoded tables. Local paths use seek/read; remote handles go
+    through fsspec, which serves ranged reads from its block cache."""
+    with _opened(path) as src:
+        if hasattr(src, "seek"):
+            src.seek(start)
+            return src.read(size)
+        with open(src, "rb") as f:
+            f.seek(start)
+            return f.read(size)
+
+
 def dataset_signature(path):
     """Fingerprint of a whole dataset: tuple of per-file signatures.
     Shared by the AQE stats store so persisted cardinalities invalidate
@@ -244,6 +258,11 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
         label="read_parquet", point="io.read")
 
 
+def _device_decode_enabled() -> bool:
+    from bodo_tpu.config import config
+    return bool(getattr(config, "device_decode", False))
+
+
 def _scan_units(files):
     """(file, row_group, total_byte_size) scan units, footers from the
     cache (each file's footer parsed at most once per mtime)."""
@@ -283,12 +302,15 @@ def _decode_row_group(unit, columns):
     """Pool task: decode one (file, row_group) with the cached footer —
     the file opens once for data pages only. Fires the io.read fault
     point so armed chaos reaches pool threads too."""
+    from bodo_tpu.runtime import io_pool
     f, rg, _ = unit
     resilience.maybe_inject("io.read")
     with _opened(f) as src:
         pf = pq.ParquetFile(src, metadata=footer_metadata(f))
-        return pf.read_row_group(
+        at = pf.read_row_group(
             rg, columns=list(columns) if columns else None)
+    io_pool.count("host_decode_bytes", int(at.nbytes))
+    return at
 
 
 def _read_units(units, columns):
@@ -322,15 +344,29 @@ def _read_parquet_once(path, columns, process_index, process_count) -> Table:
         # parquet_reader.cpp get_scan_units distribution), byte-weighted
         lo, hi = _stripe_by_bytes([u[2] for u in units], pi, pc_)
     mine = units[lo:hi]
+    t = None
     if mine:
-        at = _read_units(mine, columns)
+        # device route first: pool workers ship raw page bytes, jitted
+        # programs decode on-chip; columns the programs don't cover fall
+        # back to host per column INSIDE the route. None means the whole
+        # dataset can't take the route (exotic layout) — classic path.
+        if _device_decode_enabled():
+            from bodo_tpu.io import device_decode as _dd
+            t = _dd.read_units_table(mine, columns)
+        if t is None:
+            at = _read_units(mine, columns)
     elif units:  # fewer units than processes: empty slice, schema kept
         at = _decode_row_group(units[0], columns).slice(0, 0)
     else:
         with _opened(files[0]) as src:
             at = pq.read_table(src, columns=list(columns) if columns
                                else None).slice(0, 0)
-    t = arrow_to_table(at)
+    if t is None:
+        t = arrow_to_table(at)
+    # runtime contract check: a scan always materializes replicated on
+    # this host (the caller shards), on both decode routes
+    from bodo_tpu.analysis.plan_validator import check_kernel_result
+    check_kernel_result("read_parquet", t.distribution)
     # footer stats attach on EVERY path (the multi-process return used
     # to skip them, losing min/max pushdown on multi-host reads), but
     # restricted to the row groups this process actually read — whole-

@@ -118,7 +118,11 @@ _programs = FusionProgramCache(maxsize=config.kernel_cache_size)
 
 _stats = {"groups_planned": 0, "groups_executed": 0, "stream_chains": 0,
           "partial_agg": 0, "fallbacks": 0, "donated": 0,
-          "budget_spent": 0}
+          "budget_spent": 0,
+          # scan batches entering fused chains straight off the device
+          # decode path (io/device_decode.py) — no host round-trip
+          # between ingest and the compiled chain body
+          "device_scan_batches": 0}
 
 # structural signatures whose trace failed: don't re-trace every query
 _failed: set = set()
@@ -961,6 +965,10 @@ def fused_batches(steps, src, sharded: bool = False):
     def gen():
         fused_ok = True
         for b in src:
+            if getattr(b, "_device_decoded", False):
+                # scan batch arrived straight off the device decode
+                # path: ingest -> fused chain with no host round-trip
+                _stats["device_scan_batches"] += 1
             if fused_ok:
                 try:
                     yield _run_chain(b, steps)

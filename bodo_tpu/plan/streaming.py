@@ -154,6 +154,31 @@ def parquet_batches(path: str, columns: Optional[Sequence[str]],
     cols = list(columns) if columns else None
     _END = object()
 
+    from bodo_tpu.config import config
+    _units = [(f, rg) for f in _dataset_files(path)
+              for rg in range(footer_metadata(f).num_row_groups)]
+    if getattr(config, "device_decode", False):
+        from bodo_tpu.io import device_decode as _dd
+    else:
+        _dd = None
+    if _dd is not None and _dd.worth_device_decode(_units):
+        # device route: pool-side raw-page bundles (prefetched BYTES,
+        # admission charged at compressed+decoded size via
+        # RawRowGroup.nbytes) decode on-chip at the consumer, then
+        # re-slice to the fixed batch capacity. Per-pull retry lives
+        # inside raw_bundles; unsupported columns fall back per column
+        # inside decode, so this route never rejects a dataset.
+        from bodo_tpu.runtime.io_pool import prefetched
+
+        bundles = prefetched(_dd.raw_bundles(path, cols, units=_units),
+                             label="parquet_raw")
+        for b in _dd.decoded_batches(bundles, batch_rows):
+            dd_flag = getattr(b, "_device_decoded", False)
+            b = tracker.absorb(b)
+            b._device_decoded = dd_flag
+            yield b
+        return
+
     def raw() -> Iterator[pa.Table]:
         for f in _dataset_files(path):
             with _opened(f) as src:

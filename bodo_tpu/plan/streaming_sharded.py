@@ -134,9 +134,11 @@ def table_batches_sharded(t: Table, batch_rows: int,
 
 def parquet_batches_sharded(path: str, columns: Optional[Sequence[str]],
                             batch_rows: int, mesh=None) -> Iterator[Table]:
-    """Stream a parquet dataset as 1D batches: host-read fixed row windows
-    (bounded host memory), scatter each over the mesh at a FIXED per-shard
-    capacity so every downstream kernel compiles once."""
+    """Stream a parquet dataset as 1D batches: fixed row windows scatter
+    over the mesh at a FIXED per-shard capacity so every downstream
+    kernel compiles once. With device decode on, the inner source ships
+    raw page bytes and decodes on-chip (io/device_decode.py), so the
+    host never materializes decoded windows at all."""
     from bodo_tpu.plan.streaming import parquet_batches
     from bodo_tpu.runtime.io_pool import prefetched
     # prefetch below the scatter: Arrow decode of window k+1 overlaps
@@ -169,7 +171,13 @@ def _shard_batches(src: Iterator[Table], batch_rows: int,
     with mesh_mod.use_mesh(m):
         for rep_batch in src:
             sh = rep_batch.shard()
-            yield shard_recapacity(sh, bcap_s, m)
+            out = shard_recapacity(sh, bcap_s, m)
+            # scan provenance survives the scatter: fusion's
+            # device_scan_batches counter and the bench scan suite read
+            # this flag off sharded batches too
+            if getattr(rep_batch, "_device_decoded", False):
+                out._device_decoded = True
+            yield out
 
 
 # ---------------------------------------------------------------------------

@@ -59,6 +59,15 @@ def _zero() -> dict:
         "footer_misses": 0,
         "parallel_units": 0,    # row groups / csv chunks decoded on pool
         "parallel_reads": 0,
+        # device-side parquet decode (io/device_decode.py)
+        "device_decode_s": 0.0,       # consumer-side on-chip decode time
+        "device_decode_pages": 0,     # pages decoded by jitted programs
+        "device_decode_cols": 0,      # column chunks decoded on device
+        "device_fallback_cols": 0,    # column chunks demoted to host
+        "device_decode_errors": 0,    # planned-but-failed device decodes
+        "device_decode_bytes": 0,     # decoded bytes produced on device
+        "host_decode_bytes": 0,       # decoded bytes produced by pyarrow
+        "raw_bytes": 0,               # raw (compressed) page bytes shipped
     }
 
 
@@ -91,6 +100,10 @@ def io_stats() -> dict:
     out["overlap_s"] = overlap
     out["overlap_ratio"] = (overlap / out["decode_s"]
                             if out["decode_s"] > 0 else 0.0)
+    # fraction of decoded output bytes produced on device rather than by
+    # host pyarrow (the scan target from ROADMAP item 3)
+    dd, hd = out["device_decode_bytes"], out["host_decode_bytes"]
+    out["device_decode_frac"] = dd / (dd + hd) if (dd + hd) > 0 else 0.0
     return out
 
 

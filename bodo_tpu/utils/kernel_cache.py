@@ -96,6 +96,24 @@ class FusionProgramCache(KernelCache):
         self.compile_s = 0.0
 
 
+class DecodeProgramCache(FusionProgramCache):
+    """LRU of jitted parquet page-decode programs (io/device_decode.py),
+    keyed by the page spec: encoding kind x output dtype x power-of-two
+    shape buckets (page bytes, value count, run-table length, dictionary
+    length) x null handling x timestamp scale. Bucketing makes the live
+    program population a function of the SCHEMA, not the page count, so
+    a million-page scan dispatches a handful of executables. Shares the
+    fusion cache's hit/miss/compile accounting (EXPLAIN ANALYZE, the
+    metrics registry, and tracing.profile() read the same shape)."""
+
+    def __init__(self, maxsize: int = 128):
+        super().__init__(maxsize=maxsize)
+
+    def clear(self):
+        super().clear()
+        self.reset_stats()
+
+
 def _leaf_key(x):
     shape = getattr(x, "shape", None)
     if shape is not None and hasattr(x, "dtype"):

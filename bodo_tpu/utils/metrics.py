@@ -681,15 +681,22 @@ def sync_engine_metrics() -> None:
         for key in ("prefetch_hits", "prefetch_streams", "prefetch_depth",
                     "stalls", "footer_hits", "footer_misses",
                     "parallel_units", "parallel_reads", "decode_batches",
-                    "decode_bytes"):
+                    "decode_bytes", "device_decode_pages",
+                    "device_decode_cols", "device_fallback_cols",
+                    "device_decode_errors", "device_decode_bytes",
+                    "host_decode_bytes", "raw_bytes"):
             g.labels(event=key).set(ios.get(key, 0))
         g = gauge("bodo_tpu_io_seconds", "io pipeline time split",
                   ("phase",))
-        for phase in ("decode_s", "stall_s", "overlap_s"):
+        for phase in ("decode_s", "stall_s", "overlap_s",
+                      "device_decode_s"):
             g.labels(phase=phase[:-2]).set(ios.get(phase, 0.0))
         gauge("bodo_tpu_io_overlap_ratio",
               "decode time hidden behind consumer compute").set(
             ios.get("overlap_ratio", 0.0))
+        gauge("bodo_tpu_scan_device_decode_frac",
+              "fraction of decoded scan bytes produced on device").set(
+            ios.get("device_decode_frac", 0.0))
     except Exception:  # pragma: no cover
         pass
     # -- shardcheck (plan validator / lint / lockstep) -----------------------
@@ -747,8 +754,8 @@ def sync_engine_metrics() -> None:
                       "whole-stage fusion events", ("kind",))
             for k in ("groups_planned", "groups_executed",
                       "stream_chains", "partial_agg", "fallbacks",
-                      "donated", "hits", "misses", "compiles",
-                      "evictions"):
+                      "donated", "device_scan_batches", "hits",
+                      "misses", "compiles", "evictions"):
                 g.labels(kind=k).set(fs.get(k, 0))
             gauge("bodo_tpu_fusion_compile_seconds",
                   "cumulative fused-program compile wall seconds").set(

@@ -518,7 +518,9 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
     ios = series("bodo_tpu_io_events_total")
     for key in ("prefetch_hits", "prefetch_streams", "prefetch_depth",
                 "stalls", "footer_hits", "footer_misses",
-                "parallel_units", "parallel_reads", "decode_batches"):
+                "parallel_units", "parallel_reads", "decode_batches",
+                "device_decode_pages", "device_decode_cols",
+                "device_fallback_cols", "device_decode_errors"):
         counters[f"io:{key}"] = ios.get((key,), 0)
     # time-valued io rows: decode seconds (worker-side), consumer stall
     # seconds, and the decode time hidden behind compute
@@ -536,6 +538,18 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
                              "total_s": io_s.get(("overlap",), 0.0),
                              "max_s": 0.0, "rows": 0,
                              "ratio": round(ratio, 4)}
+    # device-side parquet decode: page programs dispatched, on-chip
+    # decode seconds, decoded bytes, and the device fraction of all
+    # decoded scan output
+    if ios.get(("device_decode_pages",)) or \
+            ios.get(("device_fallback_cols",)):
+        frac = series("bodo_tpu_scan_device_decode_frac").get((), 0.0)
+        out["io:device_decode"] = {
+            "count": int(ios.get(("device_decode_pages",), 0)),
+            "total_s": io_s.get(("device_decode",), 0.0),
+            "max_s": 0.0, "rows": 0,
+            "bytes": int(ios.get(("device_decode_bytes",), 0)),
+            "frac": round(frac, 4)}
     pv = series("bodo_tpu_plans_validated_total").get((), 0)
     if pv:
         counters["lint:plan_validated"] = pv
@@ -557,7 +571,8 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
     fus = series("bodo_tpu_fusion_events_total")
     if any(fus.values()):
         for key in ("groups_planned", "groups_executed", "stream_chains",
-                    "partial_agg", "fallbacks", "donated"):
+                    "partial_agg", "fallbacks", "donated",
+                    "device_scan_batches"):
             n = fus.get((key,), 0)
             if n:
                 out[f"fusion:{key}"] = {"count": int(n), "total_s": 0.0,

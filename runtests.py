@@ -51,7 +51,7 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # globals.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
-             "test_telemetry.py")
+             "test_telemetry.py", "test_device_decode.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

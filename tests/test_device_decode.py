@@ -1,0 +1,373 @@
+"""Device-side parquet decode (io/device_decode.py) tests.
+
+Parity contract: for every supported encoding the device decoder must
+be bit-identical to the host path (arrow_bridge.arrow_to_table over
+pyarrow) — data in the live region, validity masks, and string
+dictionaries. Unsupported encodings (DELTA_*, BYTE_STREAM_SPLIT) must
+fall back per COLUMN, transparently, and still match the oracle.
+
+Also covers: the raw thrift page walker + hybrid RLE/bit-packed parser
+as units, multi-page/multi-row-group stitching, dict-page spill,
+codecs, the BODO_TPU_DEVICE_DECODE toggle, observability counters
+(io_stats / tracing.profile() / prometheus gauge), and the
+distribution sweep through the frontend.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import bodo_tpu  # noqa: F401  (enables x64, registers mesh)
+import jax
+from bodo_tpu.config import config, set_config
+from bodo_tpu.io import device_decode as dd
+from bodo_tpu.io import read_parquet
+from bodo_tpu.io.arrow_bridge import arrow_to_table
+from bodo_tpu.io.parquet import clear_footer_cache, footer_metadata
+from bodo_tpu.runtime import io_pool
+
+
+@pytest.fixture(autouse=True)
+def _fresh(mesh8):
+    old = (config.device_decode, config.device_decode_min_bytes)
+    # test files are tiny — drop the size gate so they take the route
+    set_config(device_decode_min_bytes=0)
+    clear_footer_cache()
+    io_pool.reset_io_stats()
+    yield
+    set_config(device_decode=old[0], device_decode_min_bytes=old[1])
+
+
+def _np(x):
+    return np.asarray(jax.device_get(x))
+
+
+def _assert_col_parity(name, got, want, n):
+    """Bit-parity between a device-decoded Column and the host oracle
+    Column over the live region (padding is engine-internal)."""
+    da, db = _np(got.data)[:n], _np(want.data)[:n]
+    if da.dtype.kind == "f":
+        assert np.array_equal(da, db, equal_nan=True), name
+    else:
+        assert np.array_equal(da, db), name
+    assert (got.valid is None) == (want.valid is None), name
+    if got.valid is not None:
+        assert np.array_equal(_np(got.valid)[:n], _np(want.valid)[:n]), name
+    assert (got.dictionary is None) == (want.dictionary is None), name
+    if got.dictionary is not None:
+        assert np.array_equal(np.asarray(got.dictionary),
+                              np.asarray(want.dictionary)), name
+
+
+def _assert_table_parity(t, path, columns=None):
+    ot = arrow_to_table(papq.read_table(path, columns=columns))
+    assert t.nrows == ot.nrows
+    assert list(t.columns) == list(ot.columns)
+    for cname in ot.columns:
+        _assert_col_parity(cname, t.columns[cname], ot.columns[cname],
+                           t.nrows)
+
+
+def _mixed_frame(n, seed=0, nulls=False):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "i64": rng.integers(-10**12, 10**12, n),
+        "i32": rng.integers(-10**6, 10**6, n).astype(np.int32),
+        "f64": rng.standard_normal(n),
+        "f32": rng.standard_normal(n).astype(np.float32),
+        "b": rng.integers(0, 2, n).astype(bool),
+        "s": rng.choice(["alpha", "beta", "gamma", "delta"], n),
+        "ts": pd.to_datetime(rng.integers(0, 10**18, n)),
+    })
+    if nulls:
+        for c in ["i64", "f64", "s", "ts"]:
+            df.loc[rng.random(n) < 0.15, c] = None
+    return df
+
+
+# ---------------------------------------------------------------------------
+# thrift page walker + hybrid parser units
+# ---------------------------------------------------------------------------
+
+def test_parse_page_headers_walk(tmp_path):
+    """The raw thrift walker finds every page the footer promises."""
+    path = str(tmp_path / "w.parquet")
+    _mixed_frame(4000, nulls=True).to_parquet(
+        path, row_group_size=1500, data_page_size=2048)
+    md = footer_metadata(path)
+    for rg in range(md.num_row_groups):
+        bundle = dd.fetch_row_group(path, rg, None, inject=False)
+        nrg = md.row_group(rg).num_rows
+        for rc in bundle.device_cols.values():
+            assert sum(p.num_values for p in rc.pages) == nrg
+
+
+def test_hybrid_rle_run():
+    # one RLE run: header = count<<1, then bit_width bytes of value
+    bw = 3
+    buf = bytes([10 << 1, 0b101])  # 10 repeats of value 5
+    rt = dd._parse_hybrid(buf, 0, len(buf), bw, 10)
+    assert rt.is_rle[0] and rt.vals[0] == 5 and rt.starts[0] == 0
+
+
+def test_hybrid_bitpacked_run():
+    # bit-packed run: header = (groups<<1)|1, groups of 8 values
+    bw = 1
+    buf = bytes([(1 << 1) | 1, 0b10101010])  # 8 values 0,1,0,1,...
+    rt = dd._parse_hybrid(buf, 0, len(buf), bw, 8)
+    assert not rt.is_rle[0] and rt.starts[0] == 0
+
+
+def test_hybrid_inexact_stream():
+    """exact=False stops at stream end — dict-index and bool value
+    streams store only the NON-null entries, so page num_values is an
+    upper bound there."""
+    buf = bytes([4 << 1, 7])  # 4 repeats, stream then ends
+    rt = dd._parse_hybrid(buf, 0, len(buf), 3, 50, exact=False)
+    assert rt.starts.shape[0] == 1
+    with pytest.raises(dd.Unsupported):
+        dd._parse_hybrid(buf, 0, len(buf), 3, 50, exact=True)
+
+
+# ---------------------------------------------------------------------------
+# per-encoding parity (device + host-fallback routes vs pyarrow)
+# ---------------------------------------------------------------------------
+
+def _roundtrip(tmp_path, df, expect_fallback=0, **writer_kw):
+    path = str(tmp_path / "t.parquet")
+    df.to_parquet(path, engine="pyarrow", index=False, **writer_kw)
+    io_pool.reset_io_stats()
+    t = read_parquet(path)
+    _assert_table_parity(t, path)
+    st = io_pool.io_stats()
+    assert st["device_fallback_cols"] == expect_fallback
+    if expect_fallback == 0:
+        assert st["device_decode_pages"] > 0
+        assert st["device_decode_frac"] == 1.0
+    assert st["device_decode_errors"] == 0
+    return st
+
+
+def test_parity_dictionary(tmp_path):
+    _roundtrip(tmp_path, _mixed_frame(3000))
+
+
+def test_parity_plain(tmp_path):
+    _roundtrip(tmp_path, _mixed_frame(3000).drop(columns=["s"]),
+               use_dictionary=False)
+
+
+def test_parity_rle_bool_v2(tmp_path):
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({"b": rng.integers(0, 2, 4000).astype(bool),
+                       "runs": np.repeat([True, False], 2000)})
+    _roundtrip(tmp_path, df, version="2.6")
+
+
+def test_parity_def_levels(tmp_path):
+    _roundtrip(tmp_path, _mixed_frame(3000, nulls=True))
+
+
+def test_fallback_delta_binary_packed(tmp_path):
+    rng = np.random.default_rng(4)
+    df = pd.DataFrame({"d": np.cumsum(rng.integers(0, 9, 3000)),
+                       "ok": rng.standard_normal(3000)})
+    st = _roundtrip(tmp_path, df, expect_fallback=1,
+                    use_dictionary=False,
+                    column_encoding={"d": "DELTA_BINARY_PACKED",
+                                     "ok": "PLAIN"})
+    # the clean column still decoded on device
+    assert st["device_decode_pages"] > 0
+    assert 0.0 < st["device_decode_frac"] < 1.0
+
+
+def test_fallback_byte_stream_split(tmp_path):
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({"f": rng.standard_normal(3000).astype(np.float32),
+                       "ok": rng.integers(0, 100, 3000)})
+    _roundtrip(tmp_path, df, expect_fallback=1,
+               use_dictionary=False,
+               column_encoding={"f": "BYTE_STREAM_SPLIT", "ok": "PLAIN"})
+
+
+def test_fallback_dict_page_spill(tmp_path):
+    """A dictionary page that overflows mid-chunk (tiny page limit
+    forces a PLAIN spill) demotes that column to the host decoder."""
+    rng = np.random.default_rng(6)
+    df = pd.DataFrame({
+        "s": np.array([f"key_{i:06d}" for i in
+                       rng.integers(0, 4000, 6000)]),
+        "i": rng.integers(0, 10, 6000)})
+    st = _roundtrip(tmp_path, df, expect_fallback=1,
+                    dictionary_pagesize_limit=1024)
+    assert st["host_decode_bytes"] > 0
+
+
+@pytest.mark.parametrize("codec", ["NONE", "gzip", "zstd"])
+def test_parity_codecs(tmp_path, codec):
+    _roundtrip(tmp_path, _mixed_frame(2000, nulls=True),
+               compression=codec)
+
+
+def test_parity_timestamp_date(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 2000
+    path = str(tmp_path / "ts.parquet")
+    tbl = pa.table({
+        "ts_us": pa.array(rng.integers(0, 10**15, n),
+                          pa.timestamp("us")),
+        "d": pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                      pa.date32()),
+    })
+    papq.write_table(tbl, path)
+    t = read_parquet(path)
+    _assert_table_parity(t, path)
+
+
+def test_parity_multipage_multirowgroup(tmp_path):
+    path = str(tmp_path / "mp.parquet")
+    _mixed_frame(9000, nulls=True).to_parquet(
+        path, index=False, row_group_size=2500, data_page_size=2048)
+    io_pool.reset_io_stats()
+    t = read_parquet(path)
+    _assert_table_parity(t, path)
+    md = footer_metadata(path)
+    # genuinely multi-page: more device pages than columns x row groups
+    st = io_pool.io_stats()
+    assert st["device_decode_pages"] > md.num_columns * md.num_row_groups
+
+
+def test_column_pruning(tmp_path):
+    path = str(tmp_path / "p.parquet")
+    _mixed_frame(2500, nulls=True).to_parquet(path, index=False)
+    t = read_parquet(path, columns=["f64", "s"])
+    assert list(t.columns) == ["f64", "s"]
+    _assert_table_parity(t, path, columns=["f64", "s"])
+
+
+# ---------------------------------------------------------------------------
+# routing, toggle, observability
+# ---------------------------------------------------------------------------
+
+def test_toggle_parity_and_counters(tmp_path):
+    path = str(tmp_path / "tog.parquet")
+    _mixed_frame(2500, nulls=True).to_parquet(path, index=False)
+
+    set_config(device_decode=False)
+    io_pool.reset_io_stats()
+    t_host = read_parquet(path)
+    st = io_pool.io_stats()
+    assert st["device_decode_pages"] == 0
+    assert st["device_decode_frac"] == 0.0
+
+    set_config(device_decode=True)
+    io_pool.reset_io_stats()
+    t_dev = read_parquet(path)
+    st = io_pool.io_stats()
+    assert st["device_decode_pages"] > 0
+    assert st["device_decode_frac"] == 1.0
+    assert getattr(t_dev, "_device_decoded", False)
+
+    for cname in t_host.columns:
+        _assert_col_parity(cname, t_dev.columns[cname],
+                           t_host.columns[cname], t_host.nrows)
+
+
+def test_size_gate_routes_small_reads_to_host(tmp_path):
+    """Below device_decode_min_bytes the read stays on the host path
+    (dispatch overhead + executable pinning aren't worth it)."""
+    path = str(tmp_path / "tiny.parquet")
+    _mixed_frame(500).to_parquet(path, index=False)
+    set_config(device_decode_min_bytes=1 << 30)
+    io_pool.reset_io_stats()
+    t = read_parquet(path)
+    st = io_pool.io_stats()
+    assert st["device_decode_pages"] == 0
+    assert st["device_decode_frac"] == 0.0
+    _assert_table_parity(t, path)
+
+
+def test_profile_and_gauge(tmp_path):
+    from bodo_tpu.utils import metrics, tracing
+    path = str(tmp_path / "obs.parquet")
+    _mixed_frame(2000).to_parquet(path, index=False)
+    set_config(tracing_level=1)
+    tracing.reset()
+    io_pool.reset_io_stats()
+    try:
+        read_parquet(path)
+    finally:
+        set_config(tracing_level=0)
+    prof = tracing.profile()
+    assert "io:device_decode" in prof
+    assert prof["io:device_decode"]["count"] > 0
+    assert prof["io:device_decode"]["frac"] == 1.0
+    metrics.sync_engine_metrics()
+    text = metrics.expose_text()
+    assert "bodo_tpu_scan_device_decode_frac 1" in text
+    assert 'event="device_decode_pages"' in text
+
+
+def test_program_cache_reuse(tmp_path):
+    """Same schema + page shape across files hits the decode-program
+    cache instead of compiling fresh XLA programs."""
+    dd.clear_programs()
+    for i in range(3):
+        path = str(tmp_path / f"c{i}.parquet")
+        _mixed_frame(2000, seed=i).to_parquet(path, index=False)
+        read_parquet(path)
+    st = dd.decode_program_stats()
+    assert st["hits"] > st["misses"]
+
+
+def test_streaming_batches_flagged(tmp_path):
+    """decoded_batches slices carry the _device_decoded marker that
+    plan/fusion counts as device_scan_batches."""
+    path = str(tmp_path / "st.parquet")
+    _mixed_frame(6000, nulls=True).to_parquet(
+        path, index=False, row_group_size=2000)
+    rows = 0
+    nb = 0
+    for b in dd.decoded_batches(dd.raw_bundles(path, None), 1000):
+        assert getattr(b, "_device_decoded", False)
+        rows += b.nrows
+        nb += 1
+    assert rows == 6000 and nb >= 6
+
+
+def test_read_units_unsupported_returns_none(tmp_path):
+    """A wholly exotic file makes the device route bow out (None) so
+    io/parquet.py falls through to the host reader."""
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({"d": np.cumsum(rng.integers(0, 9, 1000))})
+    path = str(tmp_path / "ex.parquet")
+    df.to_parquet(path, index=False, use_dictionary=False,
+                  column_encoding={"d": "DELTA_BINARY_PACKED"})
+    t = read_parquet(path)  # full read still works via fallback
+    _assert_table_parity(t, path)
+    st = io_pool.io_stats()
+    assert st["device_decode_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# frontend distribution sweep
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["rep", "1d8", "1d1"])
+def test_frontend_sweep(tmp_path, mode):
+    from bodo_tpu import pandas_api as bpd
+    from tests.utils import _mode
+
+    df = _mixed_frame(4000, nulls=True)
+    path = str(tmp_path / f"sweep_{mode}.parquet")
+    df.to_parquet(path, index=False, row_group_size=1500)
+    expect = pd.read_parquet(path)
+    with _mode(mode):
+        got = bpd.read_parquet(path).to_pandas()
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True), expect.reset_index(drop=True),
+        check_dtype=False)

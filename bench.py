@@ -11,6 +11,14 @@ Suites:
     publishes no absolute in-repo numbers). Exits nonzero if any
     supported query fails.
 
+  --suite comm: communication-observatory bill of health — accounting
+    overhead (bar < 0.02), per-collective MB/s, and straggler
+    attribution under an injected latency fault.
+
+Any suite accepts --compare to run the benchwatch trajectory check
+(python -m bodo_tpu.benchwatch) over the repo's BENCH_r*.json after
+the run.
+
 Usage: python bench.py [--suite taxi|tpch] [--rows N] [--quick] [--cpu]
 """
 
@@ -660,6 +668,169 @@ def bench_lockstep(args, n_rows: int):
     return 0
 
 
+def bench_comm(args, n_rows: int):
+    """--suite comm: the communication observatory's bill of health.
+
+    Three legs in one JSON artifact:
+      1. overhead — identical shuffle-heavy pipeline with per-collective
+         accounting (parallel/comm.py) off then on; the headline metric
+         is the fractional slowdown, acceptance bar < 0.02;
+      2. throughput — per-collective dispatch counts, MB moved, and
+         MB/s from the armed runs' accounting rows;
+      3. skew — a 2-process gang with lockstep + an injected latency
+         fault on one rank (`collective@1=latency:...`); the parent
+         checks the observatory pins the straggler to the injected
+         rank (the rank whose own cumulative peer-wait is smallest).
+    """
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    import bodo_tpu
+    from bodo_tpu import relational
+    from bodo_tpu.config import set_config
+    from bodo_tpu.parallel import comm
+    from bodo_tpu.plan import physical
+    from bodo_tpu.table.table import Table
+
+    devs = jax.devices()[:args.mesh]
+    args.mesh = len(devs)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+    set_config(shard_min_rows=0)
+    rng = np.random.default_rng(0)
+    pdf = pd.DataFrame({"k": rng.integers(0, 128, n_rows),
+                        "v": rng.random(n_rows)})
+    t = physical._maybe_shard(Table.from_pandas(pdf))
+    reps = 3 if args.quick else 10
+
+    def pipeline():
+        s = relational.shuffle_by_key(t, ["k"])
+        g = relational.groupby_agg(s, ["k"], [("v", "sum", "vs")])
+        out = g.gather() if g.distribution == "1D" else g
+        jax.block_until_ready(next(iter(out.columns.values())).data)
+
+    def measure() -> float:
+        pipeline()  # warm the kernel cache
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            pipeline()
+        return (time.perf_counter() - t0) / reps
+
+    set_config(comm_accounting=False)
+    try:
+        base_s = measure()
+    finally:
+        set_config(comm_accounting=True)
+    comm.reset()
+    armed_s = measure()
+    st = comm.stats()
+    overhead = (armed_s - base_s) / base_s if base_s > 0 else 0.0
+
+    per_op = {}
+    for op, r in sorted(comm.per_op().items()):
+        mb = (r["bytes_in"] + r["bytes_out"]) / 1e6
+        row = {"count": r["count"], "mb": round(mb, 3),
+               "wall_s": round(r["wall_s"], 4),
+               "wait_s": round(r["wait_s"], 6)}
+        if r["wall_s"] > 0:
+            row["mb_per_s"] = round(mb / r["wall_s"], 1)
+        per_op[op] = row
+
+    # leg 3: arrival-skew attribution under an injected latency fault.
+    # CPU gangs are heavyweight; degrade to a note rather than fail the
+    # artifact when the gang cannot come up.
+    skew: dict = {"attempted": False}
+    if not getattr(args, "no_gang", False):
+        skew = _comm_skew_probe(quick=args.quick)
+    comm_frac = st["wall_s"] / (reps * armed_s) if armed_s else 0.0
+
+    print(f"comm: base {base_s:.4f}s armed {armed_s:.4f}s "
+          f"({st['dispatches']} dispatches accounted, "
+          f"{(st['bytes_in'] + st['bytes_out']) / 1e6:.1f}MB moved)",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "comm_overhead_frac",
+        "value": round(max(overhead, 0.0), 4),
+        "unit": "frac",
+        "vs_baseline": round(1.0 + overhead, 4),
+        "detail": {"rows": n_rows, "reps": reps,
+                   "base_s": round(base_s, 4),
+                   "armed_s": round(armed_s, 4),
+                   "dispatches": st["dispatches"],
+                   "bytes_in": st["bytes_in"],
+                   "bytes_out": st["bytes_out"],
+                   "comm_wall_frac": round(comm_frac, 4),
+                   "per_op": per_op,
+                   "skew": skew,
+                   "n_devices": args.mesh,
+                   "platform": devs[0].platform,
+                   "probe": getattr(args, "probe",
+                                    {"attempted": False})},
+    }))
+    return 0
+
+
+def _comm_skew_probe(quick: bool = False) -> dict:
+    """Spawn a 2-rank gang, delay rank 1 at every collective dispatch
+    with an injected latency fault, and verify the observatory's skew
+    attribution names rank 1 (smallest own wait = everyone waits for
+    it). Returns a JSON-safe verdict; degrades to an error note if the
+    gang cannot run here."""
+    from bodo_tpu.spawn import SpawnError, run_spmd
+
+    delay = 0.05 if quick else 0.2
+
+    def worker(rank):
+        # cross-process jax collectives are not implemented on the CPU
+        # backend, so the probe drives the HOST-level dispatch path the
+        # relational dispatchers take (fault point -> lockstep
+        # rendezvous -> comm accounting) — the layer under test —
+        # without any jax computation
+        from bodo_tpu.analysis import lockstep
+        from bodo_tpu.config import set_config
+        from bodo_tpu.parallel import comm as _comm
+        from bodo_tpu.runtime import resilience
+        # every collective dispatch on rank 1 arrives `delay` late;
+        # rank 0 burns that as peer-wait at the lockstep rendezvous
+        set_config(faults=f"collective@1=latency:{delay}:1:0")
+        for op in ("groupby_agg", "sort_table") * 4:
+            resilience.maybe_inject("collective")
+            wait = lockstep.pre_collective(op)
+            _comm.record(op, bytes_in=1 << 20, wait_s=wait)
+        return _comm.stats()
+
+    # workers inherit os.environ: arm lockstep, and drop the parent's
+    # forced host-device-count XLA flag — each gang rank contributes
+    # its own single CPU device to the distributed mesh
+    env_prev = {k: os.environ.get(k)
+                for k in ("BODO_TPU_LOCKSTEP", "XLA_FLAGS")}
+    os.environ["BODO_TPU_LOCKSTEP"] = "1"
+    os.environ.pop("XLA_FLAGS", None)
+    try:
+        results = run_spmd(worker, 2, timeout=240)
+    except (SpawnError, Exception) as e:  # noqa: BLE001
+        return {"attempted": True, "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    waits = {r: float(st["wait_s"]) for r, st in enumerate(results)}
+    straggler = min(waits, key=lambda r: (waits[r], r))
+    return {
+        "attempted": True, "ok": True,
+        "injected_rank": 1,
+        "injected_delay_s": delay,
+        "rank_wait_s": {str(r): round(w, 4)
+                        for r, w in sorted(waits.items())},
+        "straggler_rank": straggler,
+        "attribution_correct": straggler == 1,
+        "dispatches": int(results[0]["dispatches"]),
+    }
+
+
 def bench_trace(args, n_rows: int):
     """--suite trace: overhead of query-span tracing (utils/tracing.py)
     on the taxi hot path. Runs the identical pipeline untraced and
@@ -1076,6 +1247,21 @@ def _taxi_explain(args, pq: str, csv: str) -> dict:
     return out
 
 
+def _finish(args, rc: int) -> int:
+    """Suite epilogue: with --compare, run the benchwatch trajectory
+    comparison (bodo_tpu/benchwatch.py) over the repo's BENCH_r*.json
+    artifacts and report on stderr. Regressions warn but never change
+    the suite's exit code — `benchwatch --check` is the CI gate."""
+    if getattr(args, "compare", False):
+        try:
+            from bodo_tpu import benchwatch
+            out = benchwatch.watch(_REPO)
+            print(benchwatch.render(out), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"benchwatch comparison failed: {e}", file=sys.stderr)
+    return rc
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=None,
@@ -1093,8 +1279,16 @@ def main():
                          "as a collectives correctness probe)")
     ap.add_argument("--suite",
                     choices=["taxi", "tpch", "scan", "lockstep",
-                             "trace", "fusion", "telemetry"],
+                             "trace", "fusion", "telemetry", "comm"],
                     default="taxi")
+    ap.add_argument("--compare", action="store_true",
+                    help="after the suite, run the benchwatch "
+                         "trajectory comparison over BENCH_r*.json "
+                         "(bodo_tpu/benchwatch.py) and report "
+                         "regressions on stderr")
+    ap.add_argument("--no-gang", action="store_true", dest="no_gang",
+                    help="comm: skip the 2-process injected-latency "
+                         "skew probe")
     ap.add_argument("--explain", action="store_true",
                     help="taxi: EXPLAIN ANALYZE the plan-based pipeline "
                          "and run a --procs gang emitting one merged "
@@ -1114,6 +1308,11 @@ def main():
             args.mesh = 8  # collectives must actually dispatch
         if args.rows is None and not args.quick:
             args.rows = 500_000  # checker cost, not scan cost
+    if args.suite == "comm":
+        if args.mesh is None:
+            args.mesh = 8  # collectives must actually dispatch
+        if args.rows is None and not args.quick:
+            args.rows = 500_000  # accounting cost, not scan cost
     if args.suite == "trace" and args.rows is None and not args.quick:
         args.rows = 500_000  # span cost, not scan cost
     if args.suite == "fusion" and args.rows is None and not args.quick:
@@ -1173,19 +1372,21 @@ def main():
     if args.suite == "tpch":
         if args.rows is None:
             args.rows = 2000 if args.quick else 200_000
-        return bench_tpch(args)
+        return _finish(args, bench_tpch(args))
     if args.suite == "scan":
         if args.mesh is None:
             args.mesh = 1
-        return bench_scan(args, n_rows)
+        return _finish(args, bench_scan(args, n_rows))
     if args.suite == "lockstep":
-        return bench_lockstep(args, n_rows)
+        return _finish(args, bench_lockstep(args, n_rows))
+    if args.suite == "comm":
+        return _finish(args, bench_comm(args, n_rows))
     if args.suite == "trace":
-        return bench_trace(args, n_rows)
+        return _finish(args, bench_trace(args, n_rows))
     if args.suite == "fusion":
-        return bench_fusion(args, n_rows)
+        return _finish(args, bench_fusion(args, n_rows))
     if args.suite == "telemetry":
-        return bench_telemetry(args, n_rows)
+        return _finish(args, bench_telemetry(args, n_rows))
 
     import pandas as pd  # noqa: F401
 
@@ -1384,7 +1585,7 @@ def main():
         "vs_baseline": round(value / 3.0, 3),
         "detail": detail,
     }))
-    return 0
+    return _finish(args, 0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,180 @@
+"""Rank-aware critical-path analysis over the merged multi-rank trace.
+
+Input is the dict ``spawn.merge_trace_shards`` produces (also written to
+flight-recorder bundles as ``trace_merged.json``): one Chrome-trace
+timeline with per-rank lanes (``pid`` = rank) whose timestamps are
+normalized to the gang origin. The analyzer answers the question the
+per-op profile cannot: WHICH chain of spans actually bounds query wall,
+and how much of that chain is communication (``comm:*`` spans from
+parallel/comm.py) versus compute.
+
+The path is extracted backward-greedily: start from the span that ends
+last, then repeatedly hop (across ranks freely — the lanes share one
+clock) to the latest-ending span that finished before the current one
+began. With the gang executing in SPMD lockstep this recovers the
+straggler-bound chain: wherever one rank lagged, its span is the
+latest-ending predecessor and the path routes through it.
+
+Straggler attribution uses the per-dispatch ``wait_s`` the comm spans
+carry: the rank everyone waits FOR is the one whose own cumulative wait
+is SMALLEST (peers burn wait-time at the rendezvous while the straggler
+arrives late and proceeds immediately). ``doctor.py`` applies the same
+logic to the lockstep arrival stamps when no merged trace is present.
+
+Stdlib-only; a triage tool must load anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+COMM_PREFIX = "comm:"
+
+
+def _complete_events(trace: dict,
+                     query_id: Optional[str] = None) -> List[dict]:
+    evs = []
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        if query_id is not None and \
+                (ev.get("args") or {}).get("query_id") != query_id:
+            continue
+        evs.append(ev)
+    return evs
+
+
+def critical_path(trace: dict,
+                  query_id: Optional[str] = None) -> Optional[dict]:
+    """Extract the rank-aware longest chain for one query (or the whole
+    timeline when ``query_id`` is None). Returns None when the trace
+    has no complete events for the query."""
+    evs = _complete_events(trace, query_id)
+    if not evs:
+        return None
+
+    def end(ev) -> float:
+        return float(ev.get("ts", 0.0)) + float(ev.get("dur", 0.0))
+
+    # tie-break toward the latest START (the most specific/nested span)
+    # so the path prefers leaves over the parents that contain them
+    def key(ev):
+        return (end(ev), float(ev.get("ts", 0.0)))
+
+    cur = max(evs, key=key)
+    chain = [cur]
+    while True:
+        t_start = float(cur.get("ts", 0.0))
+        preds = [e for e in evs if end(e) <= t_start]
+        if not preds:
+            break
+        cur = max(preds, key=key)
+        chain.append(cur)
+    chain.reverse()
+
+    comm_us = compute_us = 0.0
+    path = []
+    for ev in chain:
+        name = ev.get("name", "")
+        dur = float(ev.get("dur", 0.0))
+        is_comm = name.startswith(COMM_PREFIX)
+        if is_comm:
+            comm_us += dur
+        else:
+            compute_us += dur
+        entry = {"name": name, "rank": int(ev.get("pid", 0)),
+                 "ts_us": round(float(ev.get("ts", 0.0)), 3),
+                 "dur_us": round(dur, 3),
+                 "kind": "comm" if is_comm else "compute"}
+        args = ev.get("args") or {}
+        if is_comm:
+            if args.get("wait_s"):
+                entry["wait_s"] = float(args["wait_s"])
+            if args.get("site"):
+                entry["site"] = args["site"]
+        path.append(entry)
+    wall_us = end(chain[-1]) - float(chain[0].get("ts", 0.0))
+    total = comm_us + compute_us
+    return {
+        "query_id": query_id,
+        "n_events": len(evs),
+        "path": path,
+        "wall_us": round(wall_us, 3),
+        "comm_us": round(comm_us, 3),
+        "compute_us": round(compute_us, 3),
+        "comm_frac": round(comm_us / total, 4) if total else 0.0,
+    }
+
+
+def straggler(trace: dict) -> Optional[dict]:
+    """Attribute arrival skew to a rank from the per-dispatch peer-wait
+    the ``comm:*`` spans carry. The suspect is the rank with the
+    SMALLEST cumulative wait (its peers did the waiting); attribution
+    is only confident when the spread is meaningful."""
+    waits: Dict[int, float] = {}
+    sites: Dict[str, float] = {}
+    for ev in _complete_events(trace):
+        name = ev.get("name", "")
+        if not name.startswith(COMM_PREFIX):
+            continue
+        args = ev.get("args") or {}
+        w = float(args.get("wait_s") or 0.0)
+        rank = int(ev.get("pid", 0))
+        waits[rank] = waits.get(rank, 0.0) + w
+        if w:
+            site = f"{name[len(COMM_PREFIX):]}@" \
+                   f"{args.get('site', '<unknown>')}"
+            sites[site] = sites.get(site, 0.0) + w
+    if len(waits) < 2:
+        return None
+    lo_rank = min(waits, key=lambda r: (waits[r], r))
+    hi_rank = max(waits, key=lambda r: (waits[r], -r))
+    skew = waits[hi_rank] - waits[lo_rank]
+    out = {
+        "rank_wait_s": {str(r): round(w, 6)
+                        for r, w in sorted(waits.items())},
+        "straggler_rank": lo_rank,
+        "skew_s": round(skew, 6),
+        # confident: the straggler's peers each waited noticeably more
+        # than it did (10ms floor keeps scheduler jitter out)
+        "confident": skew > 0.01
+        and waits[hi_rank] > 2.0 * max(waits[lo_rank], 1e-9),
+    }
+    if sites:
+        dom = max(sites, key=lambda s: (sites[s], s))
+        out["dominant_site"] = dom
+        out["dominant_site_wait_s"] = round(sites[dom], 6)
+    return out
+
+
+def analyze(trace: dict) -> dict:
+    """Whole-trace verdict: per-query critical paths + straggler
+    attribution + a per-op comm roll-up. ``doctor`` embeds this when a
+    bundle carries a merged trace."""
+    queries = {}
+    for qid in trace.get("query_ids", []) or []:
+        cp = critical_path(trace, qid)
+        if cp is not None:
+            queries[qid] = cp
+    overall = critical_path(trace)
+    comm_ops: Dict[str, dict] = {}
+    for ev in _complete_events(trace):
+        name = ev.get("name", "")
+        if not name.startswith(COMM_PREFIX):
+            continue
+        args = ev.get("args") or {}
+        r = comm_ops.setdefault(name[len(COMM_PREFIX):], {
+            "count": 0, "bytes_in": 0, "bytes_out": 0,
+            "wall_us": 0.0, "wait_s": 0.0})
+        r["count"] += 1
+        r["bytes_in"] += int(args.get("bytes_in") or 0)
+        r["bytes_out"] += int(args.get("bytes_out") or 0)
+        r["wall_us"] += float(ev.get("dur", 0.0))
+        r["wait_s"] += float(args.get("wait_s") or 0.0)
+    return {
+        "ranks": trace.get("ranks", []),
+        "queries": queries,
+        "overall": overall,
+        "straggler": straggler(trace),
+        "comm_ops": comm_ops,
+    }

@@ -75,6 +75,8 @@ RULES = {
         "module-global state written without holding a lock",
     "fusion-host-call":
         "host sync inside a @fusion_stage-decorated traced body",
+    "swallowed-collective":
+        "collective inside a try whose handler swallows divergence",
 }
 
 # names that identify process/shard identity in a branch condition
@@ -326,6 +328,55 @@ class _Checker(ast.NodeVisitor):
         self._locks_held += lockish
         self.generic_visit(node)
         self._locks_held -= lockish
+
+    # -- try/except around collectives ------------------------------------
+
+    # exception names wide enough to catch a lockstep divergence (or
+    # any gang-consistency error) — swallowing one desynchronizes the
+    # swallowing rank from peers still inside (or dead at) the op
+    _BROAD_EXC = {"Exception", "BaseException", "LockstepError"}
+
+    def _handler_swallows(self, h: ast.ExceptHandler) -> bool:
+        names: Set[str] = set()
+        if h.type is None:
+            names.add("BaseException")  # bare except
+        else:
+            types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else [h.type]
+            for tnode in types:
+                names.add(_terminal(tnode) if
+                          isinstance(tnode, ast.Call) else
+                          _dotted(tnode).rsplit(".", 1)[-1])
+        if not names & self._BROAD_EXC:
+            return False
+        # a handler that re-raises (or exits the process) propagates
+        # the divergence instead of swallowing it
+        for n in ast.walk(h):
+            if isinstance(n, ast.Raise):
+                return False
+            if isinstance(n, ast.Call) and \
+                    _terminal(n.func) in ("_exit", "exit", "abort"):
+                return False
+        return True
+
+    def visit_Try(self, node: ast.Try):
+        swallowing = [h for h in node.handlers
+                      if self._handler_swallows(h)]
+        if swallowing:
+            for n in ast.walk(ast.Module(body=node.body,
+                                         type_ignores=[])):
+                if isinstance(n, ast.Call) and \
+                        _terminal(n.func) in _COLLECTIVE_NAMES:
+                    t = _terminal(n.func)
+                    self._add(
+                        "swallowed-collective", n,
+                        f"collective {t!r} inside a try whose handler "
+                        f"catches broadly without re-raising: a "
+                        f"divergence error (LockstepError) raised here "
+                        f"is swallowed on THIS rank while peers wedge "
+                        f"in (or die at) the op — catch narrowly or "
+                        f"re-raise")
+        self.generic_visit(node)
 
     # -- calls ------------------------------------------------------------
 

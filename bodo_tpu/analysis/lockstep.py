@@ -166,15 +166,19 @@ def _call_site() -> str:
     return "<internal>"
 
 
-def pre_collective(op: str) -> None:
+def pre_collective(op: str) -> float:
     """Record + cross-check one host-level collective dispatch. Called
-    by relational._inject_collective / shuffle_by_key right before the
-    sharded kernel dispatches. No-op unless config.lockstep."""
+    by relational._inject_collective / shuffle_by_key and the streaming
+    executors' per-batch steps right before the sharded kernel
+    dispatches. Returns the seconds this rank spent waiting for its
+    peers to arrive (0.0 without peers or with the checker off) — the
+    arrival-skew signal the comm observatory records per dispatch."""
     if not config.lockstep:
-        return
+        return 0.0
     c = _get_checker()
-    if c is not None:
-        c.check(op, _call_site())
+    if c is None:
+        return 0.0
+    return c.check(op, _call_site())
 
 
 def register_fusion_manifest(group_fp: str, ops, collectives: int) -> None:
@@ -199,20 +203,21 @@ def fusion_manifests() -> Dict[str, dict]:
         return {k: dict(v) for k, v in _manifests.items()}
 
 
-def pre_fused(group_fp: str) -> None:
+def pre_fused(group_fp: str) -> float:
     """Sequence-number one fused-group dispatch as a composite
     collective. The fingerprint is the group fp alone (derived from the
     group's structural signature, so identical across ranks even when a
     rank registered its manifest in a different order); the manifest
-    resolves the fp back to member ops for diagnostics/profiling."""
+    resolves the fp back to member ops for diagnostics/profiling.
+    Returns the peer-wait seconds like pre_collective."""
     if not config.lockstep:
-        return
+        return 0.0
     c = _get_checker()
     if c is None:
-        return
+        return 0.0
     with _lock:
         _stats["fused_dispatches"] += 1
-    c.check(f"fused[{group_fp}]", _call_site())
+    return c.check(f"fused[{group_fp}]", _call_site())
 
 
 def _get_checker() -> Optional["Checker"]:
@@ -262,12 +267,15 @@ class _PeerLog:
         for line in lines:
             if "\t" not in line:
                 continue
-            s, fp = line.split("\t", 1)
+            # seq \t fingerprint [\t arrival-ts] — the third field is
+            # the wall-clock arrival stamp doctor's skew triage reads;
+            # the cross-check compares fingerprints only
+            parts = line.split("\t")
             try:
-                seq = int(s)
+                seq = int(parts[0])
             except ValueError:
                 continue
-            self._entries[seq] = fp
+            self._entries[seq] = parts[1]
             self._last = max(self._last, seq)
 
     def entry(self, seq: int) -> Optional[str]:
@@ -313,18 +321,21 @@ class Checker:
                 pass
             self._f = None
 
-    def check(self, op: str, site: str) -> None:
+    def check(self, op: str, site: str) -> float:
         fingerprint = f"{op}@{site}"
         with self._mu:
             self.seq += 1
             seq = self.seq
             if self._f is not None:
-                self._f.write(f"{seq}\t{fingerprint}\n")
+                # third field: wall-clock arrival stamp — per-seq skew
+                # across ranks is reconstructed from these by doctor's
+                # comm triage (the rank arriving LAST is the straggler)
+                self._f.write(f"{seq}\t{fingerprint}\t{time.time():.6f}\n")
                 self._f.flush()
         with _lock:
             _stats["collectives"] += 1
         if self.nprocs <= 1 or self._f is None:
-            return
+            return 0.0
         t0 = time.monotonic()
         deadline = t0 + float(config.lockstep_timeout_s)
         for peer in range(self.nprocs):
@@ -371,3 +382,4 @@ class Checker:
         with _lock:
             _stats["wait_s"] += wait
             _stats["max_wait_s"] = max(_stats["max_wait_s"], wait)
+        return wait

@@ -1,0 +1,248 @@
+"""Bench regression watcher: ``python -m bodo_tpu.benchwatch``.
+
+The repo accumulates one ``BENCH_r<NN>.json`` artifact per growth round
+(written by the driver that runs ``bench.py``); each carries the stable
+envelope ``{"n", "cmd", "rc", "tail", "parsed"}`` where ``parsed`` is
+the bench's summary line ``{"metric", "value", "unit", "vs_baseline",
+"detail"}``. This module is the trajectory's watchdog: it validates
+every artifact against that schema — loudly, a malformed artifact is a
+broken contract, not something to skip — groups records per metric,
+and compares the newest run against the history with direction-aware
+relative thresholds (an ``x``/``MB/s`` metric regresses when it drops;
+an ``s``/``frac`` metric regresses when it rises).
+
+``bench.py --compare`` invokes the same comparison after a fresh run,
+and ``runtests.py`` (full suite) runs ``--check`` as a gate so a
+silently-degrading trajectory fails CI rather than a human's memory.
+
+Stdlib-only on purpose: the watcher must run on machines with no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# direction-aware threshold semantics keyed by the metric's unit
+_HIGHER_BETTER = {"x", "mb/s", "gb/s", "mrows/s", "rows/s", "qps"}
+_LOWER_BETTER = {"s", "ms", "us", "frac", "%", "ratio"}
+
+_ENVELOPE_KEYS = ("n", "cmd", "rc", "parsed")
+_PARSED_KEYS = ("metric", "value", "unit")
+
+
+def _validate(rec: dict, path: str) -> List[str]:
+    """Schema errors for one artifact (empty list == valid)."""
+    errs = []
+    for k in _ENVELOPE_KEYS:
+        if k not in rec:
+            errs.append(f"{path}: missing envelope key {k!r}")
+    parsed = rec.get("parsed")
+    if parsed is None and not errs:
+        return errs  # rc may be nonzero with nothing parsed
+    if not isinstance(parsed, dict):
+        errs.append(f"{path}: 'parsed' is not an object")
+        return errs
+    for k in _PARSED_KEYS:
+        if k not in parsed:
+            errs.append(f"{path}: parsed summary missing {k!r}")
+    if "value" in parsed and not isinstance(parsed["value"],
+                                            (int, float)):
+        errs.append(f"{path}: parsed 'value' is not numeric")
+    return errs
+
+
+def load_trajectory(bench_dir: str) -> dict:
+    """Read and validate every BENCH_r*.json under ``bench_dir``.
+    Returns {"records": [...sorted by round...], "errors": [...]};
+    unreadable or schema-violating artifacts land in errors and are
+    excluded from records."""
+    records, errors = [], []
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "BENCH_r*.json"))):
+        m = _BENCH_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, "r") as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}: not a JSON object")
+            continue
+        errs = _validate(rec, os.path.basename(path))
+        if errs:
+            errors.extend(errs)
+            continue
+        rec["_round"] = int(m.group(1))
+        rec["_path"] = os.path.basename(path)
+        records.append(rec)
+    records.sort(key=lambda r: r["_round"])
+    return {"records": records, "errors": errors}
+
+
+def _direction(unit: str) -> int:
+    """+1 when larger values are better, -1 when smaller are, 0 when
+    the unit is unknown (compared informationally, never failed)."""
+    u = (unit or "").strip().lower()
+    if u in _HIGHER_BETTER:
+        return 1
+    if u in _LOWER_BETTER:
+        return -1
+    return 0
+
+
+def compare(records: List[dict], *, threshold: float = 0.15,
+            against: str = "best") -> dict:
+    """Compare the newest run of each metric against its history.
+
+    ``against`` picks the reference: "best" (history's best value in
+    the metric's direction — catches decay from the high-water mark),
+    "prev" (previous round only), or "median". A metric regresses when
+    the latest value is worse than the reference by more than
+    ``threshold`` (relative). Metrics seen only once are "new"."""
+    by_metric: Dict[str, List[dict]] = {}
+    for rec in records:
+        parsed = rec.get("parsed")
+        if not parsed:
+            continue
+        by_metric.setdefault(parsed["metric"], []).append(rec)
+
+    verdicts = {}
+    for metric, recs in sorted(by_metric.items()):
+        latest = recs[-1]
+        lval = float(latest["parsed"]["value"])
+        unit = latest["parsed"].get("unit", "")
+        sign = _direction(unit)
+        v: dict = {
+            "unit": unit,
+            "latest": lval,
+            "latest_round": latest["_round"],
+            "rounds": len(recs),
+            "series": [round(float(r["parsed"]["value"]), 6)
+                       for r in recs],
+        }
+        hist = [float(r["parsed"]["value"]) for r in recs[:-1]]
+        if not hist:
+            v["status"] = "new"
+            verdicts[metric] = v
+            continue
+        if against == "prev":
+            ref = hist[-1]
+        elif against == "median":
+            s = sorted(hist)
+            ref = s[len(s) // 2]
+        else:  # best
+            ref = max(hist) if sign >= 0 else min(hist)
+        v["reference"] = round(ref, 6)
+        v["against"] = against
+        if ref:
+            delta = (lval - ref) / abs(ref)
+        else:
+            delta = 0.0 if lval == ref else 1.0
+        v["delta_frac"] = round(delta, 4)
+        if sign == 0:
+            v["status"] = "untracked"  # unknown unit: report only
+        elif sign * delta < -threshold:
+            v["status"] = "regression"
+        elif sign * delta > threshold:
+            v["status"] = "improvement"
+        else:
+            v["status"] = "stable"
+        verdicts[metric] = v
+
+    failed_runs = [r["_path"] for r in records if r.get("rc")]
+    return {
+        "metrics": verdicts,
+        "threshold": threshold,
+        "failed_runs": failed_runs,
+        "regressions": sorted(m for m, v in verdicts.items()
+                              if v["status"] == "regression"),
+    }
+
+
+def watch(bench_dir: str, *, threshold: float = 0.15,
+          against: str = "best") -> dict:
+    """load_trajectory + compare in one verdict dict (adds "errors"
+    and an overall "ok" that --check gates on)."""
+    traj = load_trajectory(bench_dir)
+    out = compare(traj["records"], threshold=threshold,
+                  against=against)
+    out["errors"] = traj["errors"]
+    out["n_artifacts"] = len(traj["records"])
+    out["ok"] = (not traj["errors"] and not out["regressions"]
+                 and bool(traj["records"]))
+    if not traj["records"] and not traj["errors"]:
+        out["errors"] = [f"no BENCH_r*.json artifacts in "
+                         f"{os.path.abspath(bench_dir)}"]
+        out["ok"] = False
+    return out
+
+
+def render(out: dict) -> str:
+    lines = [f"BENCH WATCH  artifacts={out.get('n_artifacts', 0)}  "
+             f"threshold={out['threshold']:.0%}"]
+    for metric, v in sorted(out["metrics"].items()):
+        flag = {"regression": "REGRESSION", "improvement": "improved",
+                "stable": "ok", "new": "new",
+                "untracked": "untracked"}[v["status"]]
+        line = (f"  {metric}: {v['latest']:g} {v['unit']} "
+                f"(round {v['latest_round']}, {flag}")
+        if "reference" in v:
+            line += (f"; {v['delta_frac']:+.1%} vs {v['against']} "
+                     f"{v['reference']:g}")
+        lines.append(line + ")")
+        series = " -> ".join(f"{x:g}" for x in v["series"][-8:])
+        lines.append(f"    trajectory: {series}")
+    for path in out.get("failed_runs", []):
+        lines.append(f"  WARNING: {path} recorded a nonzero bench rc")
+    for err in out.get("errors", []):
+        lines.append(f"  SCHEMA ERROR: {err}")
+    verdict = "OK" if out.get("ok") else "FAIL"
+    if out.get("regressions"):
+        verdict += " (regressed: " + ", ".join(out["regressions"]) + ")"
+    lines.append(f"verdict: {verdict}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bodo_tpu.benchwatch",
+        description="Compare the BENCH_r*.json bench trajectory and "
+                    "flag regressions.")
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default: .)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative regression threshold (default 0.15)")
+    ap.add_argument("--against", choices=("best", "prev", "median"),
+                    default="best",
+                    help="history reference to compare the latest "
+                         "round to (default: best)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any regression, schema violation, "
+                         "or empty trajectory (CI gate)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable verdict")
+    args = ap.parse_args(argv)
+    out = watch(args.dir, threshold=args.threshold,
+                against=args.against)
+    if args.json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print(render(out))
+    if args.check and not out["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

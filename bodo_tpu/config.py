@@ -187,6 +187,14 @@ class Config:
     trace_dir: str = field(
         default_factory=lambda: _env_str("BODO_TPU_TRACE_DIR", "")
     )
+    # Communication observatory (parallel/comm.py): per-collective
+    # bytes/wall/peer-wait accounting at every host-level dispatch site.
+    # On by default — the accounting is a dict update per DISPATCH (not
+    # per element); bench.py --suite comm pins the overhead < 2%.
+    comm_accounting: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_COMM_ACCOUNTING",
+                                          True)
+    )
     # -- telemetry / flight recorder (runtime/telemetry.py) ------------------
     # Background sampler: one daemon thread snapshotting subsystem stats
     # (governor occupancy, io queue depth, fusion cache, lockstep head,

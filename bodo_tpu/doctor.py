@@ -9,7 +9,11 @@ starts with, without the operator opening a single JSON file:
      the per-rank lockstep side-channel logs;
   2. WAS it memory — the RSS / governor-spill timeline from the
      telemetry ring, rendered as a sparkline around the failure;
-  3. WHAT was it running — the slowest recorded queries with their
+  3. WAS a rank dragging — per-dispatch arrival-skew triage from the
+     lockstep timestamps names the straggler rank and the dominant
+     collective site; with a merged trace in the bundle the
+     critical-path analyzer's comm-vs-compute verdict is embedded too;
+  4. WHAT was it running — the slowest recorded queries with their
      EXPLAIN ANALYZE trees.
 
 ``triage(bundle)`` returns the machine-readable verdict; ``render``
@@ -24,7 +28,7 @@ import json
 import os
 import re
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _LOCKSTEP_RE = re.compile(r"^lockstep_(\d+)\.log$")
 _SHARD_RE = re.compile(r"^trace_shard_(\d+)\.json$")
@@ -39,32 +43,46 @@ def _read_json(path: str):
         return None
 
 
-def _parse_lockstep_logs(bundle: str) -> Dict[int, Dict[int, str]]:
-    """{rank: {seq: fingerprint}} from the copied side-channel logs."""
+def _parse_lockstep_logs(
+        bundle: str) -> Tuple[Dict[int, Dict[int, str]],
+                              Dict[int, Dict[int, float]]]:
+    """({rank: {seq: fingerprint}}, {rank: {seq: arrival_ts}}) from the
+    copied side-channel logs. Lines are ``seq\\tfingerprint`` with an
+    optional third arrival-timestamp field (newer logs); the timestamp
+    map only carries entries whose line had one."""
     logs: Dict[int, Dict[int, str]] = {}
+    arrivals: Dict[int, Dict[int, float]] = {}
     try:
         names = os.listdir(bundle)
     except OSError:
-        return logs
+        return logs, arrivals
     for name in names:
         m = _LOCKSTEP_RE.match(name)
         if not m:
             continue
         entries: Dict[int, str] = {}
+        stamps: Dict[int, float] = {}
         try:
             with open(os.path.join(bundle, name), "r") as f:
                 for line in f:
                     if "\t" not in line:
                         continue
-                    s, fp = line.rstrip("\n").split("\t", 1)
+                    parts = line.rstrip("\n").split("\t")
                     try:
-                        entries[int(s)] = fp
+                        seq = int(parts[0])
                     except ValueError:
                         continue
+                    entries[seq] = parts[1]
+                    if len(parts) > 2:
+                        try:
+                            stamps[seq] = float(parts[2])
+                        except ValueError:
+                            pass
         except OSError:
             continue
         logs[int(m.group(1))] = entries
-    return logs
+        arrivals[int(m.group(1))] = stamps
+    return logs, arrivals
 
 
 def _triage_lockstep(logs: Dict[int, Dict[int, str]]) -> Optional[dict]:
@@ -103,6 +121,61 @@ def _triage_lockstep(logs: Dict[int, Dict[int, str]]) -> Optional[dict]:
         if stuck:
             out["stuck_seq"] = lag + 1
             out["stuck_collective"] = stuck[0]
+    return out
+
+
+def _triage_comm(logs: Dict[int, Dict[int, str]],
+                 arrivals: Dict[int, Dict[int, float]],
+                 skew_floor: float = 0.01) -> Optional[dict]:
+    """Arrival-skew attribution from the lockstep timestamps: for every
+    dispatch sequence number that at least two ranks stamped, the rank
+    arriving LAST is the one its peers waited for. Sums that lateness
+    per rank to name the straggler, and per collective fingerprint to
+    name the dominant site. Needs 3-field logs (older 2-field logs have
+    no stamps → None)."""
+    ranks = [r for r, st in arrivals.items() if st]
+    if len(ranks) < 2:
+        return None
+    seqs = set()
+    for r in ranks:
+        seqs.update(arrivals[r])
+    late_by_rank: Dict[int, float] = {r: 0.0 for r in ranks}
+    last_count: Dict[int, int] = {r: 0 for r in ranks}
+    site_skew: Dict[str, float] = {}
+    n_skewed = 0
+    for seq in sorted(seqs):
+        stamped = {r: arrivals[r][seq] for r in ranks
+                   if seq in arrivals[r]}
+        if len(stamped) < 2:
+            continue
+        first = min(stamped.values())
+        last_rank = max(stamped, key=lambda r: (stamped[r], r))
+        skew = stamped[last_rank] - first
+        late_by_rank[last_rank] += skew
+        if skew > skew_floor:
+            n_skewed += 1
+            last_count[last_rank] += 1
+            fp = logs.get(last_rank, {}).get(seq)
+            if fp:
+                site_skew[fp] = site_skew.get(fp, 0.0) + skew
+    straggler = max(late_by_rank,
+                    key=lambda r: (late_by_rank[r], -r))
+    total_late = sum(late_by_rank.values())
+    out = {
+        "late_s_by_rank": {str(r): round(v, 6)
+                           for r, v in sorted(late_by_rank.items())},
+        "straggler_rank": straggler,
+        "straggler_late_s": round(late_by_rank[straggler], 6),
+        "n_skewed_dispatches": n_skewed,
+        # confident: one rank owns most of the observed lateness and
+        # the total is above scheduler-jitter noise
+        "confident": total_late > skew_floor
+        and late_by_rank[straggler] > 0.5 * total_late,
+    }
+    if site_skew:
+        dom = max(site_skew, key=lambda s: (site_skew[s], s))
+        out["dominant_site"] = dom
+        out["dominant_site_skew_s"] = round(site_skew[dom], 6)
     return out
 
 
@@ -152,7 +225,9 @@ def triage(bundle: str) -> dict:
         out["hung_ranks"] = sorted(
             int(r) for r, d in ranks.items()
             if d.get("state") in ("hung", "timeout"))
-    out["lockstep"] = _triage_lockstep(_parse_lockstep_logs(bundle))
+    logs, arrivals = _parse_lockstep_logs(bundle)
+    out["lockstep"] = _triage_lockstep(logs)
+    out["comm"] = _triage_comm(logs, arrivals)
     out["memory"] = _triage_memory(
         _read_json(os.path.join(bundle, "telemetry.json")))
     slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
@@ -166,6 +241,15 @@ def triage(bundle: str) -> dict:
         int(m.group(1)) for m in (_SHARD_RE.match(n) for n in names)
         if m)
     out["has_merged_trace"] = "trace_merged.json" in names
+    if out["has_merged_trace"]:
+        try:
+            from bodo_tpu.analysis import critical_path
+            trace = _read_json(
+                os.path.join(bundle, "trace_merged.json"))
+            if trace:
+                out["critical_path"] = critical_path.analyze(trace)
+        except Exception:  # noqa: BLE001 - triage is best-effort
+            pass
     out["stack_dumps"] = [n for n in names
                           if n == "stacks.txt"
                           or n.startswith("stacks_")]
@@ -229,6 +313,36 @@ def render(t: dict) -> str:
         lines.append("lockstep: no side-channel logs in bundle "
                      "(run with BODO_TPU_LOCKSTEP=1 to fingerprint "
                      "collective dispatches)")
+    cm = t.get("comm")
+    if cm:
+        lines.append("comm skew:")
+        lates = ", ".join(f"rank {r}: {v:.3f}s"
+                          for r, v in cm["late_s_by_rank"].items())
+        lines.append(f"  arrival lateness by rank: {lates}")
+        verdict = "" if cm.get("confident") else " (low confidence)"
+        lines.append(
+            f"  STRAGGLER: rank {cm['straggler_rank']} arrived last "
+            f"at {cm['n_skewed_dispatches']} skewed dispatches, "
+            f"peers waited {cm['straggler_late_s']:.3f}s for it"
+            f"{verdict}")
+        if cm.get("dominant_site"):
+            lines.append(
+                f"  dominant collective: {cm['dominant_site']} "
+                f"({cm['dominant_site_skew_s']:.3f}s of skew)")
+    cp = (t.get("critical_path") or {}).get("overall")
+    if cp:
+        lines.append(
+            f"critical path: {len(cp['path'])} spans, "
+            f"wall={cp['wall_us'] / 1e6:.3f}s, "
+            f"comm={cp['comm_us'] / 1e6:.3f}s "
+            f"({cp['comm_frac']:.0%} of path)")
+        st = (t.get("critical_path") or {}).get("straggler")
+        if st:
+            lines.append(
+                f"  trace straggler: rank {st['straggler_rank']} "
+                f"(peer-wait skew {st['skew_s']:.3f}s"
+                + (f", dominated by {st['dominant_site']}"
+                   if st.get("dominant_site") else "") + ")")
     mem = t.get("memory")
     if mem:
         lines.append("memory:")

@@ -146,6 +146,7 @@ def shard_host_array(arr: np.ndarray, capacity_per_shard: Optional[int] = None):
     Each shard receives an equal padded chunk; returns
     (device_array [S*cap_per_shard], per-shard row counts [S]).
     """
+    from bodo_tpu.parallel import comm
     _inject("device_put")
     m = mesh_mod.get_mesh()
     s = mesh_mod.num_shards(m)
@@ -166,7 +167,10 @@ def shard_host_array(arr: np.ndarray, capacity_per_shard: Optional[int] = None):
     padded = np.zeros(padded_shape, dtype=arr.dtype)
     if n:
         padded[: min(n, s * cap)] = arr[: s * cap]
-    dev = jax.device_put(padded, NamedSharding(m, P(config.data_axis)))
+    with comm.collective_span("scatter_host",
+                              bytes_in=int(arr.nbytes)) as sp:
+        dev = jax.device_put(padded, NamedSharding(m, P(config.data_axis)))
+        sp["bytes_out"] = int(padded.nbytes)
     return dev, counts
 
 
@@ -174,11 +178,18 @@ def gather_host_rows(dev_arr, counts: np.ndarray) -> np.ndarray:
     """Gather a row-sharded device array back to a host array, trimming
     per-shard padding (MPI_Gatherv analogue, reference
     distributed_api.py:713)."""
+    from bodo_tpu.parallel import comm
     s = len(counts)
-    host = np.asarray(jax.device_get(dev_arr))
-    cap = host.shape[0] // s
-    pieces = [host[i * cap : i * cap + int(counts[i])] for i in range(s)]
-    return np.concatenate(pieces, axis=0) if pieces else host[:0]
+    with comm.collective_span(
+            "gather_host",
+            bytes_in=int(getattr(dev_arr, "nbytes", 0))) as sp:
+        host = np.asarray(jax.device_get(dev_arr))
+        cap = host.shape[0] // s
+        pieces = [host[i * cap: i * cap + int(counts[i])]
+                  for i in range(s)]
+        out = np.concatenate(pieces, axis=0) if pieces else host[:0]
+        sp["bytes_out"] = int(out.nbytes)
+    return out
 
 
 def _round_cap(n: int) -> int:

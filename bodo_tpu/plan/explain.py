@@ -86,7 +86,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
            bytes: Optional[int] = None, cached: bool = False,
            aqe: Optional[Dict[str, int]] = None,
            mem_peak: Optional[int] = None,
-           fusion: Optional[dict] = None) -> None:
+           fusion: Optional[dict] = None,
+           comm: Optional[dict] = None) -> None:
     """One node observation for the current query. Wall seconds are
     INCLUSIVE of the node's children (the executor recurses inside the
     node's span), matching Postgres' actual-time convention. A repeat
@@ -94,7 +95,10 @@ def record(node: L.Node, *, rows: int, wall_s: float,
     bumps its hit count (memoized subplan re-reached). `fusion` carries
     the whole-stage-fusion boundary annotation: for a group root, the
     member ops / compile seconds / cache hit / rows in+out; for an
-    interior member, the root path it fused into."""
+    interior member, the root path it fused into. `comm` carries the
+    comm-observatory delta across the node's execution
+    ({wall_s, wait_s, bytes} — inclusive, like wall_s), rendering the
+    per-node comm-wait vs compute split."""
     path = getattr(node, "_explain_path", None)
     if path is None:
         return
@@ -111,6 +115,10 @@ def record(node: L.Node, *, rows: int, wall_s: float,
         rec["aqe"] = dict(aqe)
     if fusion:
         rec["fusion"] = dict(fusion)
+    if comm:
+        rec["comm"] = {k: (round(float(v), 6)
+                           if k.endswith("_s") else int(v))
+                       for k, v in comm.items()}
     if getattr(node, "_explain_replanned", False):
         rec["replanned"] = True
     with _lock:
@@ -133,15 +141,53 @@ def record(node: L.Node, *, rows: int, wall_s: float,
         q["records"][path] = rec
 
 
+def _critical_paths(records: Dict[str, dict]) -> set:
+    """The dotted paths on the wall-dominant root-to-leaf chain: start
+    at the root and descend into the recorded child with the largest
+    inclusive wall at every level. With inclusive walls this IS the
+    chain that bounds query wall — shaving time anywhere off-chain
+    cannot shorten the query. Ties break toward the lowest path index
+    (deterministic goldens)."""
+    if not records:
+        return set()
+    root = min(records, key=_pathkey)
+    marked = set()
+    cur = root
+    while True:
+        marked.add(cur)
+        depth = cur.count(".") + 1
+        kids = [p for p in records
+                if p.startswith(cur + ".") and p.count(".") == depth]
+        if not kids:
+            return marked
+        cur = max(kids, key=lambda p: (
+            records[p]["wall_s"],
+            tuple(-x for x in _pathkey(p))))
+
+
+def critical_path(query_id: Optional[str] = None) -> List[str]:
+    """Dotted paths of the query's critical chain, root first."""
+    with _lock:
+        qid = query_id or _last_qid
+        q = _queries.get(qid) if qid else None
+        records = dict(q["records"]) if q else {}
+    return sorted(_critical_paths(records), key=_pathkey)
+
+
 def node_profiles(query_id: Optional[str] = None) -> List[dict]:
     """The recorded observations for one query (default: last), in
-    dotted-path order — the JSON-able form bench artifacts embed."""
+    dotted-path order — the JSON-able form bench artifacts embed. Nodes
+    on the wall-dominant chain carry ``critical: True``."""
     with _lock:
         qid = query_id or _last_qid
         q = _queries.get(qid) if qid else None
         if q is None:
             return []
         recs = [dict(r) for r in q["records"].values()]
+    crit = _critical_paths({r["path"]: r for r in recs})
+    for r in recs:
+        if r["path"] in crit:
+            r["critical"] = True
     recs.sort(key=lambda r: _pathkey(r["path"]))
     return recs
 
@@ -224,6 +270,16 @@ def _annotate(rec: Optional[dict]) -> str:
     if "mem_peak" in rec:
         parts.append(f"mem_peak={_fmt_bytes(rec['mem_peak'])}")
     parts.append(f"wall={rec['wall_s']:.3f}s")
+    c = rec.get("comm")
+    if c:
+        # comm-wait vs compute split: comm wall (transfer+wait) out of
+        # the node's inclusive wall, with the peer-wait share inside it
+        compute = max(rec["wall_s"] - c.get("wall_s", 0.0), 0.0)
+        bit = (f"comm={c.get('wall_s', 0.0):.3f}s"
+               f"/compute={compute:.3f}s")
+        if c.get("wait_s"):
+            bit += f" (wait={c['wait_s']:.3f}s)"
+        parts.append(bit)
     if rec.get("aqe"):
         decs = ",".join(f"{k}x{v}" if v > 1 else k
                         for k, v in sorted(rec["aqe"].items()))
@@ -246,6 +302,8 @@ def _annotate(rec: Optional[dict]) -> str:
         parts.append("cached")
     if rec.get("hits", 1) > 1:
         parts.append(f"hits={rec['hits']}")
+    if rec.get("critical"):
+        parts.append("on critical path")
     return "  ".join(parts)
 
 
@@ -258,10 +316,13 @@ def explain_analyze(query_id: Optional[str] = None) -> str:
         qid = query_id or _last_qid
         q = _queries.get(qid) if qid else None
         root = q["root"] if q else None
-        records = dict(q["records"]) if q else {}
+        records = {p: dict(r) for p, r in q["records"].items()} if q \
+            else {}
     if qid is None or q is None:
         return ("EXPLAIN ANALYZE: no recorded query "
                 "(run with tracing_level >= 1)")
+    for p in _critical_paths(records):
+        records[p]["critical"] = True
     lines = []
     wall = tracing.query_wall_s(qid)
     if wall is None and records:

@@ -97,7 +97,7 @@ def _exec(node: L.Node) -> Table:
         if traced:
             _record_node(node, hit, 0.0, cached=True)
         return hit
-    est_rows = aqe_before = None
+    est_rows = aqe_before = comm_before = None
     if traced:
         # pre-execution estimate + AQE decision snapshot, so the record
         # can show est-vs-actual and which adaptive decisions this node
@@ -107,6 +107,13 @@ def _exec(node: L.Node) -> Table:
             est_rows = stats.estimate(node)[0]
             aqe_before = dict(adaptive.stats().get("decisions", {}))
         except Exception:  # noqa: BLE001 - annotation is best-effort
+            pass
+        try:
+            # comm-observatory snapshot: the delta across the node's
+            # span is its inclusive comm-wait vs compute split
+            from bodo_tpu.parallel import comm
+            comm_before = comm.stats()
+        except Exception:  # noqa: BLE001
             pass
     span_args = {}
     path = getattr(node, "_explain_path", None)
@@ -119,7 +126,8 @@ def _exec(node: L.Node) -> Table:
             ev["rows"] = t.nrows
     if traced:
         _record_node(node, t, _time.perf_counter() - t0,
-                     est_rows=est_rows, aqe_before=aqe_before)
+                     est_rows=est_rows, aqe_before=aqe_before,
+                     comm_before=comm_before)
     node._cached = t
     # stage-boundary statistics feedback; a stage that came back from a
     # degraded replicated re-run is tainted (execution artifact, not a
@@ -137,11 +145,12 @@ def _exec(node: L.Node) -> Table:
 
 def _record_node(node: L.Node, t: Table, wall_s: float,
                  cached: bool = False, est_rows=None,
-                 aqe_before=None) -> None:
+                 aqe_before=None, comm_before=None) -> None:
     """EXPLAIN ANALYZE observation for one executed (or cache-hit) node:
-    rows, result device bytes, inclusive wall, and the delta of AQE
-    decision counters across the node's execution. Best-effort — an
-    annotation failure never fails the query."""
+    rows, result device bytes, inclusive wall, the delta of AQE
+    decision counters and of the comm-observatory totals across the
+    node's execution. Best-effort — an annotation failure never fails
+    the query."""
     try:
         from bodo_tpu.plan import explain
         aqe_delta = None
@@ -151,6 +160,23 @@ def _record_node(node: L.Node, t: Table, wall_s: float,
             aqe_delta = {k: v - aqe_before.get(k, 0)
                          for k, v in after.items()
                          if v - aqe_before.get(k, 0)}
+        comm_delta = None
+        if comm_before is not None:
+            try:
+                from bodo_tpu.parallel import comm
+                after_c = comm.stats()
+                d = {
+                    "wall_s": after_c["wall_s"] - comm_before["wall_s"],
+                    "wait_s": after_c["wait_s"] - comm_before["wait_s"],
+                    "bytes": (after_c["bytes_out"] + after_c["bytes_in"]
+                              - comm_before["bytes_out"]
+                              - comm_before["bytes_in"]),
+                }
+                if d["bytes"] or d["wall_s"] > 1e-9 \
+                        or d["wait_s"] > 1e-9:
+                    comm_delta = d
+            except Exception:  # noqa: BLE001
+                pass
         nbytes = None
         try:
             from bodo_tpu.runtime.memory_governor import \
@@ -160,7 +186,7 @@ def _record_node(node: L.Node, t: Table, wall_s: float,
             pass
         explain.record(node, rows=t.nrows, wall_s=wall_s,
                        est_rows=est_rows, bytes=nbytes, cached=cached,
-                       aqe=aqe_delta,
+                       aqe=aqe_delta, comm=comm_delta,
                        fusion=getattr(node, "_fusion_info", None))
     except Exception:  # noqa: BLE001 - observability must not break exec
         pass

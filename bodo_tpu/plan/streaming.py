@@ -710,6 +710,13 @@ class StreamJoin:
         self.null_equal = null_equal
         self._comp = default_comptroller()
         self._op = self._comp.register("stream_join_build")
+        if build.distribution != REP:
+            # count-only comm row naming the streaming stage boundary;
+            # the transfer wall/bytes land on the nested Table.gather
+            # span, so no wall here (it would double-count in totals)
+            from bodo_tpu.parallel import comm
+            comm.record("stream_build_gather",
+                        bytes_in=comm.table_bytes(build))
         b = build.gather() if build.distribution != REP else build
         self._grant = governor().admit("stream_join_build",
                                        want=table_device_bytes(b))

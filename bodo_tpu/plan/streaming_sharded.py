@@ -344,14 +344,31 @@ class ShardedGroupbyAccumulator:
             self._resolve_oldest()
 
     def _dispatch(self, inputs, bcap: int, bdicts) -> None:
+        from bodo_tpu.parallel import comm
         from bodo_tpu.utils import tracing
         arrays, counts = inputs
         pre_state = self._state
         step = _build_sharded_step(self._mk, len(self.keys), self.specs,
                                    self._bucket_cap, self._state_cap)
         (st, cnts) = pre_state
-        with tracing.event("stream1d_step"):
+        # per-batch lockstep sequence number (ROADMAP item 6: streaming
+        # collectives carry seq numbers like the host-level dispatchers).
+        # Overflow replays re-enter here too, but the ovf flags are SPMD-
+        # deterministic so every rank replays the same batches — the seq
+        # streams stay aligned.
+        wait = 0.0
+        if self.S > 1:
+            from bodo_tpu.analysis import lockstep
+            wait = lockstep.pre_collective("stream1d_step")
+        in_bytes = sum(int(getattr(leaf, "nbytes", 0))
+                       for leaf in jax.tree_util.tree_leaves(arrays))
+        with tracing.event("stream1d_step"), \
+                comm.collective_span("stream1d_step", bytes_in=in_bytes,
+                                     wait_s=wait) as sp:
             mkv, ng2, ovf = step(arrays, counts, st, cnts)
+            sp["bytes_out"] = sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(mkv))
         self._state = (mkv, ng2)
         self._queue.append({
             "pre_state": pre_state,

@@ -135,13 +135,24 @@ def _inject_collective(*tables: Table, op: str = "collective") -> None:
     (`op` + user call site + sequence number) and cross-checked against
     peer processes, so a rank that diverged into a different collective
     raises a structured LockstepError instead of wedging the gang
-    (analysis/lockstep.py)."""
+    (analysis/lockstep.py).
+
+    The comm observatory (parallel/comm.py) accounts the dispatch:
+    input bytes + the lockstep peer-wait (arrival skew). No wall span
+    here — the surrounding whole-op wall is compute-dominated and would
+    corrupt the comm share; true transfer walls come from the
+    shuffle_by_key / gather / scatter spans."""
     if any(isinstance(x, Table) and x.distribution == ONED
            and x.num_shards > 1 for x in tables):
         from bodo_tpu.runtime.resilience import maybe_inject
         maybe_inject("collective")
         from bodo_tpu.analysis import lockstep
-        lockstep.pre_collective(op)
+        wait = lockstep.pre_collective(op)
+        if config.comm_accounting:
+            from bodo_tpu.parallel import comm
+            comm.record(op, bytes_in=sum(
+                comm.table_bytes(x) for x in tables
+                if isinstance(x, Table)), wait_s=wait)
 
 
 @_traced
@@ -2529,13 +2540,18 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
     # fault point fires at the groupby/sort/join dispatchers above this
     # call, and adding a second firing site would shift chaos tests'
     # nth-call counting
+    wait = 0.0
     if t.num_shards > 1:
         from bodo_tpu.analysis import lockstep
-        lockstep.pre_collective("shuffle_by_key")
+        wait = lockstep.pre_collective("shuffle_by_key")
+    from bodo_tpu.parallel import comm
     from bodo_tpu.plan import adaptive
     from bodo_tpu.utils import tracing
     adaptive.observe_shuffle(t, key_cols)
-    with tracing.event("shuffle_by_key", keys=list(key_cols)) as ev:
+    with tracing.event("shuffle_by_key", keys=list(key_cols)) as ev, \
+            comm.collective_span("shuffle_by_key",
+                                 bytes_in=comm.table_bytes(t),
+                                 wait_s=wait) as sp:
         if ev is not None:
             ev["rows"] = t.nrows
         m = mesh_mod.get_mesh()
@@ -2566,7 +2582,9 @@ def shuffle_by_key(t: Table, key_cols: Sequence[str]) -> Table:
         tree = {n: out[i] for i, n in enumerate(korder)}
         res = t.with_device_data(tree, nrows=int(counts.sum()),
                                  counts=counts)
-        return _keep_vranges(shrink_to_fit(res.select(names)), t)
+        out_t = _keep_vranges(shrink_to_fit(res.select(names)), t)
+        sp["bytes_out"] = comm.table_bytes(out_t)
+        return out_t
 
 
 def shard_frames(t: Table) -> List:

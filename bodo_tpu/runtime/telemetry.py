@@ -160,6 +160,14 @@ def sample() -> dict:
             s["lockstep_seq"] = int(ls.sequence_head())
         except Exception:
             pass
+    cm = _mod("bodo_tpu.parallel.comm")
+    if cm is not None:
+        try:
+            sk = cm.skew_head()
+            if sk.get("dispatches"):
+                s["comm"] = sk
+        except Exception:
+            pass
     return s
 
 
@@ -324,8 +332,9 @@ def lockstep_log_tail(dirpath: str, rank: int) -> Optional[str]:
                     last = line.rstrip("\n")
             if last is None:
                 return None
-            seq, fp = last.split("\t", 1)
-            return f"#{seq} {fp}"
+            # seq \t fingerprint [\t arrival-ts]
+            parts = last.split("\t")
+            return f"#{parts[0]} {parts[1]}"
     except OSError:
         return None
 
@@ -363,6 +372,16 @@ def health() -> dict:
         except Exception as e:
             doc["status"] = "unknown"
             doc["gang_error"] = f"{type(e).__name__}: {e}"
+    cm = _mod("bodo_tpu.parallel.comm")
+    if cm is not None:
+        try:
+            sk = cm.skew_head()
+            if sk.get("dispatches"):
+                # arrival-skew head for /healthz consumers (the future
+                # scheduler's admission signal, ROADMAP item 2)
+                doc["comm"] = sk
+        except Exception:
+            pass
     with _lock:
         doc["telemetry"] = {
             "sampler_running": _sampler_thread is not None

@@ -386,6 +386,14 @@ class Table:
         (gatherv analogue, reference distributed_api.py:713)."""
         if self.distribution == REP:
             return self
+        from bodo_tpu.parallel import comm
+        with comm.collective_span("gather",
+                                  bytes_in=comm.table_bytes(self)) as _sp:
+            out = self._gather_inner()
+            _sp["bytes_out"] = comm.table_bytes(out)
+        return out
+
+    def _gather_inner(self) -> "Table":
         s = self.num_shards
         per = self.shard_capacity
         cap = round_capacity(max(self.nrows, 1))

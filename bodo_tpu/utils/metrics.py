@@ -728,6 +728,32 @@ def sync_engine_metrics() -> None:
             ls.get("max_wait_s", 0.0))
     except Exception:  # pragma: no cover
         pass
+    # -- communication observatory (parallel/comm.py is stdlib-safe) ---------
+    try:
+        from bodo_tpu.parallel import comm
+        g = gauge("bodo_tpu_comm_dispatches_total",
+                  "collective dispatches accounted per op", ("op",))
+        gb = gauge("bodo_tpu_comm_bytes_total",
+                   "bytes through collective dispatches",
+                   ("op", "direction"))
+        gw = gauge("bodo_tpu_comm_seconds_total",
+                   "cumulative collective host wall / peer-wait seconds",
+                   ("op", "kind"))
+        for op, r in comm.per_op().items():
+            g.labels(op=op).set(r["count"])
+            gb.labels(op=op, direction="in").set(r["bytes_in"])
+            gb.labels(op=op, direction="out").set(r["bytes_out"])
+            gw.labels(op=op, kind="wall").set(r["wall_s"])
+            gw.labels(op=op, kind="wait").set(r["wait_s"])
+        sk = comm.skew_head()
+        gauge("bodo_tpu_comm_max_wait_seconds",
+              "worst single collective peer-wait (arrival skew)").set(
+            sk.get("max_wait_s", 0.0))
+        gauge("bodo_tpu_comm_wait_frac",
+              "peer-wait share of total comm time").set(
+            sk.get("wait_frac", 0.0))
+    except Exception:  # pragma: no cover
+        pass
     # -- compile cache + pallas engagement -----------------------------------
     try:
         from bodo_tpu.utils import tracing

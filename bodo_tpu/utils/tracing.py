@@ -468,7 +468,9 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
     `lockstep:check` row (dispatches fingerprinted + peer-wait seconds)
     plus `lockstep:mismatches`/`lockstep:timeouts`; whole-stage fusion
     contributes `fusion:*` counter rows plus `fusion:cache`
-    (hit/miss) and a time-valued `fusion:compile` row. All counter
+    (hit/miss) and a time-valued `fusion:compile` row; the comm
+    observatory contributes per-collective `comm:<op>` rows carrying
+    bytes in/out and the host-wall vs peer-wait split. All counter
     rows are sourced from the unified metrics registry."""
     from bodo_tpu.utils import metrics
     out: Dict[str, dict] = {}
@@ -598,6 +600,20 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
             "max_s": series("bodo_tpu_lockstep_max_wait_seconds").get(
                 (), 0.0),
             "rows": 0}
+    # comm observatory: one row per collective op with the bytes moved
+    # and the wall/peer-wait split (parallel/comm.py accounting)
+    cd = series("bodo_tpu_comm_dispatches_total")
+    if cd:
+        cb = series("bodo_tpu_comm_bytes_total")
+        cw = series("bodo_tpu_comm_seconds_total")
+        for (op,), n in sorted(cd.items()):
+            out[f"comm:{op}"] = {
+                "count": int(n),
+                "total_s": cw.get((op, "wall"), 0.0),
+                "max_s": 0.0, "rows": 0,
+                "bytes_in": int(cb.get((op, "in"), 0)),
+                "bytes_out": int(cb.get((op, "out"), 0)),
+                "wait_s": round(cw.get((op, "wait"), 0.0), 6)}
     qn = series("bodo_tpu_aqe_q_error_count").get((), 0)
     if qn:
         qe = {k: series(f"bodo_tpu_aqe_q_error_{k}").get((), 0.0)

@@ -48,10 +48,13 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # process with modules that leave streams open. test_query_profiler.py
 # arms global tracing / resizes the event ring buffer / spawns a traced
 # gang, so it must not interleave with modules asserting on the same
-# globals.
+# globals. test_comm_observatory.py arms comm accounting / lockstep /
+# the telemetry server and spawns a latency-fault gang, for the same
+# reason.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
-             "test_telemetry.py", "test_device_decode.py")
+             "test_telemetry.py", "test_device_decode.py",
+             "test_comm_observatory.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs
@@ -95,6 +98,24 @@ def _run_lint() -> int:
     return r.returncode
 
 
+def _run_benchwatch() -> int:
+    """Bench-trajectory regression gate: validates every BENCH_r*.json
+    against the stable schema and fails on a direction-aware regression
+    of any tracked metric (bodo_tpu/benchwatch.py)."""
+    print("[benchwatch] python -m bodo_tpu.benchwatch --check ... ",
+          end="", flush=True)
+    t1 = time.time()
+    r = subprocess.run([sys.executable, "-m", "bodo_tpu.benchwatch",
+                        "--check"],
+                       cwd=_REPO, capture_output=True, text=True,
+                       timeout=120)
+    tail = (r.stdout.strip().splitlines() or [""])[-1]
+    print(f"{tail}  ({time.time() - t1:.0f}s)")
+    if r.returncode != 0:
+        sys.stdout.write(r.stdout[-4000:] + r.stderr[-2000:] + "\n")
+    return r.returncode
+
+
 def main(argv: list[str]) -> int:
     want_lint = "lint" in argv
     argv = [a for a in argv if a != "lint"]
@@ -116,6 +137,9 @@ def main(argv: list[str]) -> int:
     if full_suite or want_lint:
         if _run_lint() != 0:
             failed.append("lint")
+    if full_suite:
+        if _run_benchwatch() != 0:
+            failed.append("benchwatch")
     for i, group in enumerate(groups):
         names = " ".join(os.path.relpath(m, _REPO) for m in group)
         label = names if len(group) == 1 else \

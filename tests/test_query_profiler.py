@@ -279,12 +279,12 @@ MASK = re.compile(r"\b(wall|rows|est|bytes|mem_peak|hits)=[^\s\]]+")
 # at it with fused-> instead of per-node est/bytes.
 Q6_GOLDEN = """\
 EXPLAIN ANALYZE  query=#  wall=#
-Projection [0]  rows=#  est=#  bytes=#  wall=#
-└─ Reduce [0.0]  rows=#  est=#  bytes=#  wall=#
-   └─ Projection [0.0.0]  rows=#  est=#  bytes=#  wall=#  fused[#]
-      └─ Projection [0.0.0.0]  rows=#  wall=#  fused->0.0.0
-         └─ Filter [0.0.0.0.0]  rows=#  wall=#  fused->0.0.0
-            └─ FromPandas [0.0.0.0.0.0]  rows=#  est=#  bytes=#  wall=#"""
+Projection [0]  rows=#  est=#  bytes=#  wall=#  on critical path
+└─ Reduce [0.0]  rows=#  est=#  bytes=#  wall=#  on critical path
+   └─ Projection [0.0.0]  rows=#  est=#  bytes=#  wall=#  fused[#]  on critical path
+      └─ Projection [0.0.0.0]  rows=#  wall=#  fused->0.0.0  on critical path
+         └─ Filter [0.0.0.0.0]  rows=#  wall=#  fused->0.0.0  on critical path
+            └─ FromPandas [0.0.0.0.0.0]  rows=#  est=#  bytes=#  wall=#  on critical path"""
 
 
 def _mask(txt: str) -> str:

@@ -15,6 +15,11 @@ Suites:
     overhead (bar < 0.02), per-collective MB/s, and straggler
     attribution under an injected latency fault.
 
+  --suite compile: compile & device-memory observatory bill of health —
+    registry overhead on the warm taxi path (bar < 0.02), executable
+    census by subsystem, retrace rate, compile-share of the cold wall,
+    and the device-buffer ledger's leak check.
+
 Any suite accepts --compare to run the benchwatch trajectory check
 (python -m bodo_tpu.benchwatch) over the repo's BENCH_r*.json after
 the run.
@@ -984,6 +989,104 @@ def bench_telemetry(args, n_rows: int):
     return 0
 
 
+def bench_compile(args, n_rows: int):
+    """--suite compile: the compile & device-memory observatory's bill
+    of health (runtime/xla_observatory.py) on the taxi hot path. A cold
+    armed run captures the program registry's census — executables by
+    subsystem, retrace rate, compile-seconds share of the cold wall.
+    Hot-path overhead is then measured with observatory ON and OFF reps
+    interleaved (the hot path only pays registry touches + device-buffer
+    tracking; compiles are warm). The JSON metric is the fractional
+    slowdown — the acceptance bar for keeping the observatory always-on
+    is < 0.02. The detail block carries the census, the unified compile
+    budget, and the ledger's leak check after results are released."""
+    import jax
+
+    import bodo_tpu
+    from bodo_tpu.runtime import xla_observatory as obs
+    from bodo_tpu.workloads.taxi import bodo_tpu_pipeline, gen_taxi_data
+
+    data_dir = os.path.join(_REPO, ".bench_data")
+    os.makedirs(data_dir, exist_ok=True)
+    pq = os.path.join(data_dir, f"trips_{n_rows}.parquet")
+    csv = os.path.join(data_dir, f"weather_{n_rows}.csv")
+    if not (os.path.exists(pq) and os.path.exists(csv)):
+        print(f"generating {n_rows} rows ...", file=sys.stderr)
+        gen_taxi_data(n_rows, pq, csv)
+    devs = jax.devices()[:args.mesh]
+    args.mesh = len(devs)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+    reps = 3 if args.quick else 5
+
+    def pipeline():
+        return bodo_tpu_pipeline(pq, csv, shard=True).to_pandas()
+
+    # cold armed run: every compile registers, retraces are attributed
+    obs.reset()
+    obs.set_enabled(True)
+    t0 = time.perf_counter()
+    pipeline()
+    cold_s = time.perf_counter() - t0
+    st = obs.stats()
+    compiles = int(st["compiles"])
+    retraces = int(st["retraces_total"])
+    retrace_rate = retraces / compiles if compiles else 0.0
+    compile_share = st["compile_s"] / cold_s if cold_s > 0 else 0.0
+
+    # hot-path overhead: ON/OFF reps interleaved so clock drift and
+    # cache warming bias cancel instead of landing on one side
+    base_t = on_t = 0.0
+    try:
+        for _ in range(reps):
+            obs.set_enabled(False)
+            t0 = time.perf_counter()
+            pipeline()
+            base_t += time.perf_counter() - t0
+            obs.set_enabled(True)
+            t0 = time.perf_counter()
+            pipeline()
+            on_t += time.perf_counter() - t0
+    finally:
+        obs.set_enabled(True)
+    base_s, on_s = base_t / reps, on_t / reps
+    overhead = (on_s - base_s) / base_s if base_s > 0 else 0.0
+
+    leak = obs.leak_check()  # results released above; gc then census
+    budget = st["budget"]
+    print(f"compile: {st['executables']} executables "
+          f"({compiles} compiles, {retraces} retraces), "
+          f"base {base_s:.4f}s armed {on_s:.4f}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "compile_observatory_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "vs_baseline": round(1.0 + overhead, 4),
+        "detail": {"rows": n_rows, "reps": reps,
+                   "base_s": round(base_s, 4),
+                   "armed_s": round(on_s, 4),
+                   "cold_s": round(cold_s, 4),
+                   "executables": int(st["executables"]),
+                   "by_subsystem": {
+                       k: int(v["executables"])
+                       for k, v in st["by_subsystem"].items()},
+                   "compiles": compiles,
+                   "retraces": retraces,
+                   "retrace_rate": round(retrace_rate, 4),
+                   "compile_s": round(st["compile_s"], 4),
+                   "compile_share_of_cold": round(compile_share, 4),
+                   "budget_pool": budget["pool_cap"],
+                   "budget_spent": budget["spent"],
+                   "budget_remaining": budget["remaining"],
+                   "leak_live_bytes": int(leak["live_bytes"]),
+                   "leak_live_buffers": int(leak["live_buffers"]),
+                   "n_devices": args.mesh,
+                   "platform": devs[0].platform,
+                   "probe": getattr(args, "probe",
+                                    {"attempted": False})},
+    }))
+    return 0
+
+
 def _fusion_pallas_probe(quick: bool) -> dict:
     """Interpret-mode probe proving the Pallas dense-accumulate kernel
     sits INSIDE a fused program: runs a small filter->assign->groupby-sum
@@ -1279,7 +1382,8 @@ def main():
                          "as a collectives correctness probe)")
     ap.add_argument("--suite",
                     choices=["taxi", "tpch", "scan", "lockstep",
-                             "trace", "fusion", "telemetry", "comm"],
+                             "trace", "fusion", "telemetry", "comm",
+                             "compile"],
                     default="taxi")
     ap.add_argument("--compare", action="store_true",
                     help="after the suite, run the benchwatch "
@@ -1319,6 +1423,8 @@ def main():
         args.rows = 500_000  # fusion win shows per-stage, not per-scan
     if args.suite == "telemetry" and args.rows is None and not args.quick:
         args.rows = 500_000  # sampler cost, not scan cost
+    if args.suite == "compile" and args.rows is None and not args.quick:
+        args.rows = 500_000  # registry/ledger cost, not scan cost
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -1387,6 +1493,8 @@ def main():
         return _finish(args, bench_fusion(args, n_rows))
     if args.suite == "telemetry":
         return _finish(args, bench_telemetry(args, n_rows))
+    if args.suite == "compile":
+        return _finish(args, bench_compile(args, n_rows))
 
     import pandas as pd  # noqa: F401
 

@@ -49,6 +49,8 @@ def train(loss_fn: Callable, params, df, feature_cols: Sequence[str],
     batch_size = min(batch_size, max(n, 1))
     n_batches = max(1, n // batch_size)
 
+    # one jit per train() call, dies with the closure — nothing to
+    # register  # shardcheck: ignore[unregistered-jit]
     @jax.jit
     def epoch(params, opt_state, perm):
         def step(carry, idx):

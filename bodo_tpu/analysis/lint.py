@@ -77,6 +77,8 @@ RULES = {
         "host sync inside a @fusion_stage-decorated traced body",
     "swallowed-collective":
         "collective inside a try whose handler swallows divergence",
+    "unregistered-jit":
+        "jit/pallas_call site bypassing the program registry",
 }
 
 # names that identify process/shard identity in a branch condition
@@ -235,6 +237,50 @@ class _ModuleInfo(ast.NodeVisitor):
                 self.smap_fn_names.add(n.args[0].id)
 
 
+# a store like `cache[key] = fn` / `_programs[sig] = fn` / `_jit_cache
+# [key] = fn` marks the enclosing function as registering its compiled
+# programs with a kernel cache (which reports to the program registry)
+_CACHE_NAME_HINTS = ("cache", "program")
+
+
+def _stores_into_kernel_cache(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    name = (_terminal(t.value)
+                            if isinstance(t.value, ast.Attribute)
+                            else getattr(t.value, "id", ""))
+                    low = name.lower()
+                    if any(h in low for h in _CACHE_NAME_HINTS):
+                        return True
+    return False
+
+
+def _has_registering_decorator(fn: ast.AST) -> bool:
+    """@cached_builder("sub") / @bounded_jit memoize the function's
+    compiled programs in a registered KernelCache."""
+    for d in getattr(fn, "decorator_list", []):
+        t = _terminal(d.func) if isinstance(d, ast.Call) else _terminal(d)
+        if t in ("cached_builder", "bounded_jit"):
+            return True
+    return False
+
+
+def _is_jit_decorator(d: ast.AST) -> bool:
+    """@jax.jit, or @partial(jax.jit, ...) / @functools.partial(...)."""
+    if _dotted(d) == "jax.jit":
+        return True
+    if isinstance(d, ast.Call) and _terminal(d.func) == "jit" \
+            and _root(d.func) == "jax":
+        return True
+    if isinstance(d, ast.Call) and _terminal(d.func) == "partial":
+        for a in d.args:
+            if _dotted(a) == "jax.jit":
+                return True
+    return False
+
+
 def _contains_lax_collective(fn: ast.AST) -> bool:
     for n in ast.walk(fn):
         if isinstance(n, ast.Call) and \
@@ -255,6 +301,7 @@ class _Checker(ast.NodeVisitor):
         self._locks_held = 0             # `with <lock>:` nesting
         self._traced_depth = 0           # inside a jax-traced function
         self._fusion_depth = 0           # inside a @fusion_stage body
+        self._reg_depth = 0              # fn stores into a kernel cache
         self._local_defs: List[Dict[str, ast.AST]] = [{}]
 
     # -- helpers ----------------------------------------------------------
@@ -279,16 +326,34 @@ class _Checker(ast.NodeVisitor):
                   _contains_lax_collective(node))
         fused = any(_terminal(d) == "fusion_stage"
                     for d in node.decorator_list)
+        registers = (_stores_into_kernel_cache(node) or
+                     _has_registering_decorator(node))
+        for d in node.decorator_list:
+            # a @jax.jit on a local function whose enclosing scope
+            # stores it into a kernel cache IS registered
+            if not self._reg_depth and not registers \
+                    and _is_jit_decorator(d):
+                self._add(
+                    "unregistered-jit", d,
+                    "module-lifetime @jit decorator: pins one "
+                    "executable per signature forever, invisible to "
+                    "the program registry and its compile budget — "
+                    "route through bounded_jit or a registered "
+                    "KernelCache")
         self._func.append(node.name)
         self._local_defs.append({})
         if traced:
             self._traced_depth += 1
         if fused:
             self._fusion_depth += 1
+        if registers:
+            self._reg_depth += 1
         # a lock held at the call site does not cover the function body
         saved_locks, self._locks_held = self._locks_held, 0
         self.generic_visit(node)
         self._locks_held = saved_locks
+        if registers:
+            self._reg_depth -= 1
         if fused:
             self._fusion_depth -= 1
         if traced:
@@ -405,6 +470,16 @@ class _Checker(ast.NodeVisitor):
                 f"{t!r} inside a @fusion_stage body: fusion stages "
                 f"trace into ONE compiled program — a host sync here "
                 f"splits the fused pipeline (or fails to trace)")
+        if not self._reg_depth and \
+                ((t == "jit" and _root(node.func) == "jax")
+                 or t == "pallas_call"):
+            self._add(
+                "unregistered-jit", node,
+                f"direct {_dotted(node.func) or t!r} call outside a "
+                f"registering cache: the executable bypasses the "
+                f"program registry (no retrace attribution, no "
+                f"compile budget, unbounded pinning) — store it in a "
+                f"subsystem-tagged KernelCache or use bounded_jit")
         if t == "retry_call" and node.args:
             self._check_retry_target(node)
         # dict.setdefault-style mutations via call are handled in the

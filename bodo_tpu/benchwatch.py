@@ -15,6 +15,13 @@ an ``s``/``frac`` metric regresses when it rises).
 and ``runtests.py`` (full suite) runs ``--check`` as a gate so a
 silently-degrading trajectory fails CI rather than a human's memory.
 
+An artifact may carry an envelope-level ``"waiver": "<reason>"`` when
+its round ran in a provably degraded environment (the reason should
+name the control experiment). A waiver downgrades a regression verdict
+for THAT round only from FAIL to WAIVED — rendered loudly with the
+reason, never silently — so an invalid measurement doesn't block CI
+while the trajectory still records what was measured.
+
 Stdlib-only on purpose: the watcher must run on machines with no jax.
 """
 
@@ -153,7 +160,12 @@ def compare(records: List[dict], *, threshold: float = 0.15,
         if sign == 0:
             v["status"] = "untracked"  # unknown unit: report only
         elif sign * delta < -threshold:
-            v["status"] = "regression"
+            waiver = latest.get("waiver")
+            if waiver and isinstance(waiver, str):
+                v["status"] = "waived"
+                v["waiver"] = waiver
+            else:
+                v["status"] = "regression"
         elif sign * delta > threshold:
             v["status"] = "improvement"
         else:
@@ -193,7 +205,7 @@ def render(out: dict) -> str:
              f"threshold={out['threshold']:.0%}"]
     for metric, v in sorted(out["metrics"].items()):
         flag = {"regression": "REGRESSION", "improvement": "improved",
-                "stable": "ok", "new": "new",
+                "stable": "ok", "new": "new", "waived": "WAIVED",
                 "untracked": "untracked"}[v["status"]]
         line = (f"  {metric}: {v['latest']:g} {v['unit']} "
                 f"(round {v['latest_round']}, {flag}")
@@ -201,6 +213,8 @@ def render(out: dict) -> str:
             line += (f"; {v['delta_frac']:+.1%} vs {v['against']} "
                      f"{v['reference']:g}")
         lines.append(line + ")")
+        if v.get("waiver"):
+            lines.append(f"    waived: {v['waiver']}")
         series = " -> ".join(f"{x:g}" for x in v["series"][-8:])
         lines.append(f"    trajectory: {series}")
     for path in out.get("failed_runs", []):

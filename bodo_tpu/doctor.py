@@ -205,6 +205,49 @@ def _triage_memory(telemetry: Optional[dict]) -> Optional[dict]:
     return out
 
 
+def _triage_xla(bundle: str) -> Optional[dict]:
+    """Compile & device-memory triage from the bundle's registry dump:
+    name the storming signature, rank retrace causes, surface the
+    compile-cost hot list and the leaking creation site (if any)."""
+    reg = _read_json(os.path.join(bundle, "xla_registry.json"))
+    if not reg:
+        return None
+    summary = reg.get("summary") or {}
+    out: dict = {
+        "executables": summary.get("executables", 0),
+        "compiles": summary.get("compiles", 0),
+        "compile_s": summary.get("compile_s", 0.0),
+        "retraces": summary.get("retraces", {}),
+    }
+    st = summary.get("storm") or {}
+    if st.get("storming"):
+        out["storm"] = {"signature": st.get("signature"),
+                        "compiles_in_window": st.get(
+                            "compiles_in_window"),
+                        "window_s": st.get("window_s")}
+    progs = reg.get("programs") or []
+    hot = sorted(progs, key=lambda p: -float(p.get("compile_s", 0.0)))
+    out["top_compile_cost"] = [
+        {"subsystem": p.get("subsystem"), "base": p.get("base"),
+         "compile_s": p.get("compile_s", 0.0),
+         "dispatches": p.get("dispatches", 0),
+         "retrace_cause": p.get("retrace_cause")}
+        for p in hot[:5] if float(p.get("compile_s", 0.0)) > 0]
+    leaks = reg.get("leaks") or {}
+    if leaks.get("live_bytes"):
+        by_op = leaks.get("by_op") or {}
+        out["leak"] = {"live_bytes": leaks["live_bytes"],
+                       "live_buffers": leaks.get("live_buffers", 0),
+                       "by_op": by_op}
+        if by_op:
+            top = next(iter(by_op))
+            out["leak"]["dominant_site"] = top
+    led = summary.get("ledger") or {}
+    if led.get("donation"):
+        out["donation"] = led["donation"]
+    return out
+
+
 def triage(bundle: str) -> dict:
     """Machine-readable triage of one flight-recorder bundle."""
     if not os.path.isdir(bundle):
@@ -230,6 +273,7 @@ def triage(bundle: str) -> dict:
     out["comm"] = _triage_comm(logs, arrivals)
     out["memory"] = _triage_memory(
         _read_json(os.path.join(bundle, "telemetry.json")))
+    out["xla"] = _triage_xla(bundle)
     slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
     out["slow_queries"] = [{"query_id": q.get("query_id"),
                             "wall_s": q.get("wall_s")} for q in slow]
@@ -359,6 +403,47 @@ def render(t: dict) -> str:
                 f"{_fmt_bytes(mem.get('spilled_bytes', 0))} in "
                 f"{mem.get('n_spills', 0)} spills, "
                 f"{mem.get('oom_retries', 0)} OOM retries")
+    x = t.get("xla")
+    if x:
+        lines.append("xla observatory:")
+        lines.append(
+            f"  {x.get('executables', 0)} executables, "
+            f"{x.get('compiles', 0)} compiles "
+            f"({float(x.get('compile_s', 0.0)):.3f}s wall)")
+        st = x.get("storm")
+        if st:
+            lines.append(
+                f"  RECOMPILE STORM: {st['signature']} compiled "
+                f"{st['compiles_in_window']}x in the last "
+                f"{st['window_s']:.0f}s — every dispatch is paying "
+                f"trace+compile")
+        rt = x.get("retraces") or {}
+        if rt:
+            causes = ", ".join(
+                f"{c}: {n}" for c, n in
+                sorted(rt.items(), key=lambda kv: -kv[1]))
+            lines.append(f"  retrace causes: {causes}")
+        for p in x.get("top_compile_cost", [])[:3]:
+            bit = (f"  compile hot: {p['subsystem']}:{p['base']} "
+                   f"{float(p['compile_s']):.3f}s, "
+                   f"{p['dispatches']} dispatches")
+            if p.get("retrace_cause"):
+                bit += f" (retraced: {p['retrace_cause']})"
+            lines.append(bit)
+        leak = x.get("leak")
+        if leak:
+            lines.append(
+                f"  LIVE DEVICE BYTES: "
+                f"{_fmt_bytes(leak['live_bytes'])} in "
+                f"{leak['live_buffers']} buffers"
+                + (f", dominated by '{leak['dominant_site']}'"
+                   if leak.get("dominant_site") else ""))
+        don = x.get("donation")
+        if don and don.get("copied"):
+            lines.append(
+                f"  donation: {don.get('verified', 0)} verified, "
+                f"{don['copied']} dispatches COPIED instead of "
+                f"donating (double memory on those inputs)")
     if t.get("slow_queries"):
         lines.append("slow queries:")
         for q in t["slow_queries"]:

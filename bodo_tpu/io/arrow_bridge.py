@@ -163,7 +163,10 @@ def arrow_to_table(at: pa.Table, columns: Optional[Sequence[str]] = None,
     cols: Dict[str, Column] = {}
     for name in at.column_names:
         cols[name] = _arrow_column(at.column(name), cap)
-    return Table(cols, n, REP, None)
+    t = Table(cols, n, REP, None)
+    from bodo_tpu.runtime import xla_observatory as xobs
+    xobs.track_table(t, "arrow_ingest")
+    return t
 
 
 def table_to_arrow(t: Table) -> pa.Table:

@@ -410,9 +410,24 @@ def _pad_runs(rt: _RunTable, runs_bucket: int, sentinel: int) -> tuple:
 # jitted decode programs (cached per shape/encoding/dtype bucket)
 # ---------------------------------------------------------------------------
 
+from bodo_tpu.runtime import xla_observatory as xobs  # noqa: E402
 from bodo_tpu.utils.kernel_cache import DecodeProgramCache  # noqa: E402
 
-_programs = DecodeProgramCache()
+
+def _describe_spec(spec):
+    """Facet split of a _PageSpec for the program registry: shape
+    buckets are the churn-prone facet (a drifting page size shows up
+    as shape-bucket-churn in retrace attribution)."""
+    return f"device_decode:{spec.kind}", {
+        "dtype": spec.out_dtype,
+        "shape": (spec.byte_bucket, spec.n_bucket, spec.def_runs,
+                  spec.val_runs, spec.dict_bucket),
+        "static": (spec.itemsize, spec.bit_width, spec.has_defs,
+                   spec.masked, spec.scale)}
+
+
+_programs = DecodeProgramCache(subsystem="device_decode",
+                               describe=_describe_spec)
 _programs_lock = threading.Lock()
 
 # XLA:CPU's JIT crashes once a process pins thousands of distinct
@@ -429,8 +444,11 @@ _n_compiles = 0
 
 def decode_program_stats() -> dict:
     out = _programs.stats()
-    out["budget_left"] = (max(0, _max_compiles - _n_compiles)
-                          if _max_compiles >= 0 else -1)
+    left_local = (max(0, _max_compiles - _n_compiles)
+                  if _max_compiles >= 0 else -1)
+    left_pool = xobs.subsystem_budget_left("device_decode")
+    lefts = [x for x in (left_local, left_pool) if x >= 0]
+    out["budget_left"] = min(lefts) if lefts else -1
     return out
 
 
@@ -442,6 +460,7 @@ def clear_programs() -> None:
     with _programs_lock:
         _programs.clear()
         _n_compiles = 0
+    xobs.reset_budget("device_decode")
 
 
 @dataclass(frozen=True)
@@ -592,6 +611,8 @@ def _build_page_program(spec: _PageSpec):
         n_nulls = jnp.sum(in_rows & ~valid).astype(jnp.int32)
         return out, valid, n_nulls
 
+    # stored into _programs (DecodeProgramCache) by _page_program
+    # under its lock  # shardcheck: ignore[unregistered-jit]
     return jax.jit(_page_decode)
 
 
@@ -600,7 +621,8 @@ def _page_program(spec: _PageSpec):
     with _programs_lock:
         fn = _programs.lookup(spec)
         if fn is None:
-            if _n_compiles >= _max_compiles >= 0:
+            if _n_compiles >= _max_compiles >= 0 \
+                    or not xobs.try_spend("device_decode"):
                 raise Unsupported("decode compile budget spent")
             _n_compiles += 1
     if fn is not None:
@@ -652,7 +674,10 @@ def _run_page_program(spec: _PageSpec, page_bytes: bytes, n_values: int,
     if compiled:
         with _programs_lock:
             _programs.record_compile(f"device_decode:{spec.kind}",
-                                     time.perf_counter() - t0)
+                                     time.perf_counter() - t0,
+                                     handle=_programs.handle_for(spec))
+    xobs.track_buffer(out[0], "device_decode")
+    xobs.track_buffer(out[1], "device_decode")
     return out
 
 

@@ -153,6 +153,9 @@ def jit(fn: Optional[Callable] = None, *, distributed=None, replicated=None,
         if numeric_ok and _is_numeric_args(args, kwargs):
             try:
                 if jax_jitted is None:
+                    # one jit per user-@jit-decorated function,
+                    # bounded by the program text itself
+                    # shardcheck: ignore[unregistered-jit]
                     jax_jitted = jax.jit(fn)
                 out = jax_jitted(*args, **kwargs)
                 return jax.tree.map(

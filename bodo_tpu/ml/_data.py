@@ -54,6 +54,8 @@ def table_mask(t: Table):
 
     def body(c):
         return jnp.arange(per) < c[0]
+    # per-call mask helper; one signature per (mesh, capacity)
+    # shardcheck: ignore[unregistered-jit]
     fn = jax.jit(C.smap(body, in_specs=(P(ax),), out_specs=P(ax),
                         mesh=m))
     return fn(t.counts_device())

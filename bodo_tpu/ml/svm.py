@@ -18,6 +18,8 @@ import numpy as np
 from bodo_tpu.ml._data import _to_numpy_1d, to_device_xy
 
 
+# fixed per-estimator kernel set, bounded by construction
+# shardcheck: ignore[unregistered-jit]
 @partial(jax.jit, static_argnames=("iters",))
 def _svc_fit(X, y_pm, mask, C, iters: int):
     """Squared-hinge L2 LinearSVC (sklearn default loss), Nesterov GD."""

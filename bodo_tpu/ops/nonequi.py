@@ -38,7 +38,8 @@ _GRID_BUDGET = 1 << 22
 
 from bodo_tpu.utils.kernel_cache import KernelCache
 
-_jit_cache = KernelCache(maxsize=config.kernel_cache_size)
+_jit_cache = KernelCache(maxsize=config.kernel_cache_size,
+                         subsystem="nonequi")
 
 
 def _pow2(n: int) -> int:

@@ -68,6 +68,8 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+# one MXU kernel, few (n_slots, n_cols) signatures per query shape
+# shardcheck: ignore[unregistered-jit]
 @functools.partial(jax.jit,
                    static_argnames=("n_slots", "n_cols", "interpret"))
 def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
@@ -122,6 +124,8 @@ def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
         def _flush():
             out_ref[:] = acc_ref[:]
 
+    # traced inside the jitted matmul_groupby_sum above — cached by
+    # its jit signature  # shardcheck: ignore[unregistered-jit]
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // _BLK,),

@@ -11,7 +11,6 @@ destination assignment + fixed-capacity all_to_all (parallel/shuffle.py).
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -25,7 +24,7 @@ from bodo_tpu.ops import kernels as K
 from bodo_tpu.ops import sort_encoding as SE
 from bodo_tpu.parallel import collectives as C
 from bodo_tpu.parallel import mesh as mesh_mod
-from bodo_tpu.utils.kernel_cache import bounded_jit
+from bodo_tpu.utils.kernel_cache import bounded_jit, cached_builder
 
 # oversampling factor for splitter selection (samples per shard = OS * S)
 _OVERSAMPLE = 8
@@ -76,7 +75,7 @@ def _partition_key(keys: Sequence[Tuple], ascending: Sequence[bool],
     return jnp.where(padmask, pk, np.uint64(0xFFFFFFFFFFFFFFFF))
 
 
-@lru_cache(maxsize=256)
+@cached_builder("sort")
 def _build_sort_sharded(mesh_key, num_arrays: int, num_keys: int,
                         ascending: Tuple[bool, ...], na_last: bool,
                         bucket_cap: int):

@@ -13,7 +13,7 @@ re-splitting on memory pressure, streaming/_join.h:267).
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -32,6 +32,7 @@ from bodo_tpu.ops.hashing import dest_shard, hash_columns
 from bodo_tpu.parallel import collectives as C
 from bodo_tpu.parallel import mesh as mesh_mod
 from bodo_tpu.plan.fusion import fusion_stage
+from bodo_tpu.utils.kernel_cache import cached_builder
 
 
 # ---------------------------------------------------------------------------
@@ -156,7 +157,7 @@ def _finalize(op: str, cols, orig_dtype):
     return cols[0]
 
 
-@lru_cache(maxsize=256)
+@cached_builder("shuffle")
 def _build_groupby_partial(mesh_key, num_keys: int, specs: Tuple[str, ...],
                            method: str = "sort"):
     """Stage 1: per-shard partial aggregation (shrinks data before the
@@ -225,7 +226,7 @@ def shuffle_partials(pk, pv, num_keys: int, S: int, bucket_cap: int,
     return rk, tuple(rv), cnt, ovf
 
 
-@lru_cache(maxsize=256)
+@cached_builder("shuffle")
 def _build_groupby_combine(mesh_key, num_keys: int, specs: Tuple[str, ...],
                            value_dtypes: Tuple, bucket_cap: int,
                            final_cap: int):

@@ -87,7 +87,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
            aqe: Optional[Dict[str, int]] = None,
            mem_peak: Optional[int] = None,
            fusion: Optional[dict] = None,
-           comm: Optional[dict] = None) -> None:
+           comm: Optional[dict] = None,
+           xla: Optional[dict] = None) -> None:
     """One node observation for the current query. Wall seconds are
     INCLUSIVE of the node's children (the executor recurses inside the
     node's span), matching Postgres' actual-time convention. A repeat
@@ -98,7 +99,10 @@ def record(node: L.Node, *, rows: int, wall_s: float,
     interior member, the root path it fused into. `comm` carries the
     comm-observatory delta across the node's execution
     ({wall_s, wait_s, bytes} — inclusive, like wall_s), rendering the
-    per-node comm-wait vs compute split."""
+    per-node comm-wait vs compute split. `xla` carries the compile &
+    device-memory observatory's delta across the node ({compiles,
+    retraces, cause, dev_bytes}) rendered as
+    compiled|cached|retraced[cause] plus the node's net device bytes."""
     path = getattr(node, "_explain_path", None)
     if path is None:
         return
@@ -119,6 +123,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
         rec["comm"] = {k: (round(float(v), 6)
                            if k.endswith("_s") else int(v))
                        for k, v in comm.items()}
+    if xla:
+        rec["xla"] = dict(xla)
     if getattr(node, "_explain_replanned", False):
         rec["replanned"] = True
     with _lock:
@@ -135,6 +141,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
             # group annotation attached to the node)
             if fusion and "fusion" not in prev:
                 prev["fusion"] = dict(fusion)
+            if xla and "xla" not in prev:
+                prev["xla"] = dict(xla)
             return
         if prev is not None:
             rec["hits"] = prev["hits"] + 1
@@ -296,6 +304,19 @@ def _annotate(rec: Optional[dict]) -> str:
             if "rows_in" in f:
                 bits.append(f"rows_in={f['rows_in']}")
             parts.append(f"fused[{', '.join(bits)}]")
+    x = rec.get("xla")
+    if x:
+        if x.get("retraces"):
+            cause = x.get("cause") or "unknown"
+            parts.append(f"xla=retraced[{cause}]")
+        elif x.get("compiles"):
+            parts.append("xla=compiled")
+        elif x.get("dispatches"):
+            parts.append("xla=cached")
+        db = x.get("dev_bytes")
+        if db:
+            sign = "+" if db > 0 else "-"
+            parts.append(f"dev={sign}{_fmt_bytes(abs(int(db)))}")
     if rec.get("replanned"):
         parts.append("replanned")
     if rec.get("cached"):

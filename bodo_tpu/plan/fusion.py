@@ -107,6 +107,7 @@ from bodo_tpu.plan import expr as E
 from bodo_tpu.plan import logical as L
 from bodo_tpu.table import dtypes as dt
 from bodo_tpu.table.table import Column, ONED, REP, Table
+from bodo_tpu.runtime import xla_observatory as xobs
 from bodo_tpu.utils.kernel_cache import FusionProgramCache
 from bodo_tpu.utils.logging import log
 
@@ -114,7 +115,33 @@ from bodo_tpu.utils.logging import log
 # @fusion_stage), so relational/physical/shuffle may only be imported
 # INSIDE functions here — a module-level import would cycle.
 
-_programs = FusionProgramCache(maxsize=config.kernel_cache_size)
+def _describe_sig(key):
+    """Split a fusion-program signature into named facets so the
+    program registry can attribute a retrace to the facet that changed
+    (mesh vs schema vs plan steps vs donation flag)."""
+    base = key[0]
+    if base == "fusedchain" and len(key) == 6:
+        _, mesh, schema, steps, dist, donate = key
+        return base, {"mesh": xobs._short(mesh),
+                      "schema": xobs._short(schema),
+                      "dtype": tuple(c[1] for c in schema),
+                      "steps": xobs._short(steps), "dist": dist,
+                      "donate": bool(donate)}
+    if base == "fusedagg" and len(key) == 9:
+        (_, schema, steps, kn, aggs, sizes, los, use_mxu,
+         donate) = key
+        return base, {"schema": xobs._short(schema),
+                      "dtype": tuple(c[1] for c in schema),
+                      "steps": xobs._short((steps, kn, aggs)),
+                      "shape": tuple(sizes),
+                      "static": (xobs._short(los), bool(use_mxu)),
+                      "donate": bool(donate)}
+    return str(base), xobs.facets_from_sig(key)
+
+
+_programs = FusionProgramCache(maxsize=config.kernel_cache_size,
+                               subsystem="fusion",
+                               describe=_describe_sig)
 
 _stats = {"groups_planned": 0, "groups_executed": 0, "stream_chains": 0,
           "partial_agg": 0, "fallbacks": 0, "donated": 0,
@@ -145,7 +172,8 @@ def _budget_compile(sig) -> None:
     before the compile — the log survives an XLA compiler crash, which
     in-process stats do not."""
     global _n_compiles
-    if _n_compiles >= _max_compiles >= 0:
+    if _n_compiles >= _max_compiles >= 0 \
+            or not xobs.try_spend("fusion"):
         _stats["budget_spent"] += 1
         raise FusionFallback("fusion compile budget spent")
     _n_compiles += 1
@@ -176,6 +204,7 @@ def clear_programs() -> None:
     global _n_compiles
     _programs.clear()
     _n_compiles = 0
+    xobs.reset_budget("fusion")
 
 
 class FusionFallback(Exception):
@@ -906,12 +935,16 @@ def _finish_group(group: FusionGroup, t: Table, out: Table) -> None:
     annotations, stats."""
     from bodo_tpu.plan import physical
     _stats["groups_executed"] += 1
-    if getattr(out, "_fusion_donated", False):
+    donated = getattr(out, "_fusion_donated", False)
+    if donated:
         # the program consumed the input buffers: drop both caches so
         # an OOM retry recomputes the input from ITS children instead
-        # of touching dead memory
+        # of touching dead memory. The ledger confirms XLA actually
+        # freed the donated buffers (vs silently copying).
+        xobs.verify_donation(t)
         group.input._cached = None
         physical._result_cache.pop(group.input.key(), None)
+    xobs.track_table(out, "fused_stage")
     compiled = bool(getattr(out, "_fusion_compiled", False))
     info = {
         "members": group.member_ops(),

@@ -97,7 +97,7 @@ def _exec(node: L.Node) -> Table:
         if traced:
             _record_node(node, hit, 0.0, cached=True)
         return hit
-    est_rows = aqe_before = comm_before = None
+    est_rows = aqe_before = comm_before = xla_before = None
     if traced:
         # pre-execution estimate + AQE decision snapshot, so the record
         # can show est-vs-actual and which adaptive decisions this node
@@ -115,6 +115,13 @@ def _exec(node: L.Node) -> Table:
             comm_before = comm.stats()
         except Exception:  # noqa: BLE001
             pass
+        try:
+            # observatory snapshot: compiles/retraces/device bytes that
+            # land during this node's span are attributed to it
+            from bodo_tpu.runtime import xla_observatory
+            xla_before = xla_observatory.head()
+        except Exception:  # noqa: BLE001
+            pass
     span_args = {}
     path = getattr(node, "_explain_path", None)
     if path is not None:
@@ -127,7 +134,7 @@ def _exec(node: L.Node) -> Table:
     if traced:
         _record_node(node, t, _time.perf_counter() - t0,
                      est_rows=est_rows, aqe_before=aqe_before,
-                     comm_before=comm_before)
+                     comm_before=comm_before, xla_before=xla_before)
     node._cached = t
     # stage-boundary statistics feedback; a stage that came back from a
     # degraded replicated re-run is tainted (execution artifact, not a
@@ -145,7 +152,8 @@ def _exec(node: L.Node) -> Table:
 
 def _record_node(node: L.Node, t: Table, wall_s: float,
                  cached: bool = False, est_rows=None,
-                 aqe_before=None, comm_before=None) -> None:
+                 aqe_before=None, comm_before=None,
+                 xla_before=None) -> None:
     """EXPLAIN ANALYZE observation for one executed (or cache-hit) node:
     rows, result device bytes, inclusive wall, the delta of AQE
     decision counters and of the comm-observatory totals across the
@@ -177,6 +185,24 @@ def _record_node(node: L.Node, t: Table, wall_s: float,
                     comm_delta = d
             except Exception:  # noqa: BLE001
                 pass
+        xla_delta = None
+        if xla_before is not None:
+            try:
+                from bodo_tpu.runtime import xla_observatory
+                after_x = xla_observatory.head()
+                compiles = after_x["compiles"] - xla_before["compiles"]
+                retraces = after_x["retraces"] - xla_before["retraces"]
+                disp = after_x["dispatches"] - xla_before["dispatches"]
+                dev = after_x["live_bytes"] - xla_before["live_bytes"]
+                if compiles or retraces or disp or dev:
+                    xla_delta = {"compiles": compiles,
+                                 "retraces": retraces,
+                                 "dispatches": disp,
+                                 "dev_bytes": dev}
+                    if retraces:
+                        xla_delta["cause"] = after_x["last_cause"]
+            except Exception:  # noqa: BLE001
+                pass
         nbytes = None
         try:
             from bodo_tpu.runtime.memory_governor import \
@@ -187,7 +213,8 @@ def _record_node(node: L.Node, t: Table, wall_s: float,
         explain.record(node, rows=t.nrows, wall_s=wall_s,
                        est_rows=est_rows, bytes=nbytes, cached=cached,
                        aqe=aqe_delta, comm=comm_delta,
-                       fusion=getattr(node, "_fusion_info", None))
+                       fusion=getattr(node, "_fusion_info", None),
+                       xla=xla_delta)
     except Exception:  # noqa: BLE001 - observability must not break exec
         pass
 

@@ -26,7 +26,6 @@ terminates.
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,6 +46,7 @@ from bodo_tpu.parallel.shuffle import (_MESHES, _mesh_key,
 from bodo_tpu.plan.streaming import _bucket_cap as _pow2_cap
 from bodo_tpu.table import dtypes as dt
 from bodo_tpu.table.table import Column, ONED, REP, Table
+from bodo_tpu.utils.kernel_cache import cached_builder
 from bodo_tpu.utils.logging import log
 
 
@@ -54,7 +54,7 @@ from bodo_tpu.utils.logging import log
 # sharded re-capacity / slicing (shard_map helpers)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=256)
+@cached_builder("streaming")
 def _build_recap(mesh_key, old_per: int, new_per: int):
     mesh = _MESHES[mesh_key]
     axis = config.data_axis
@@ -87,7 +87,7 @@ def shard_recapacity(t: Table, new_per: int, mesh=None) -> Table:
     return t.with_device_data(tree, nrows=t.nrows, counts=t.counts)
 
 
-@lru_cache(maxsize=256)
+@cached_builder("streaming")
 def _build_slicer(mesh_key, per: int, bcap: int):
     mesh = _MESHES[mesh_key]
     axis = config.data_axis
@@ -184,7 +184,7 @@ def _shard_batches(src: Iterator[Table], batch_rows: int,
 # sharded streaming groupby
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=256)
+@cached_builder("streaming")
 def _build_sharded_step(mesh_key, num_keys: int, specs: Tuple[str, ...],
                         bucket_cap: int, state_cap: int):
     """One streamed-groupby step: partial-agg the batch, shuffle partial
@@ -769,7 +769,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
 # per-shard append (shared by streaming join build state and sort state)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=256)
+@cached_builder("streaming")
 def _build_append(mesh_key, state_cap: int, batch_cap: int, new_cap: int):
     """shard_map kernel: place a packed batch block after the packed
     state block inside a [new_cap] buffer (per shard, no host transit)."""

@@ -41,7 +41,10 @@ from bodo_tpu.table.table import Column, ONED, REP, Table, round_capacity
 
 from bodo_tpu.utils.kernel_cache import KernelCache
 
-_jit_cache = KernelCache(maxsize=config.kernel_cache_size)
+# relational cache keys are ("kind", schema/dist/mesh/static parts...):
+# the generic facet split in the observatory attributes retraces per kind
+_jit_cache = KernelCache(maxsize=config.kernel_cache_size,
+                         subsystem="relational")
 
 
 def _schema(t: Table) -> Dict[str, dt.DType]:

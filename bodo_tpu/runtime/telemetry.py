@@ -154,6 +154,23 @@ def sample() -> dict:
             }
         except Exception:
             pass
+    ob = _mod("bodo_tpu.runtime.xla_observatory")
+    if ob is not None:
+        try:
+            st = ob.storm()
+            led = ob.ledger_stats()
+            bud = ob.budget()
+            s["xla"] = {
+                "live_device_bytes": int(led["live_bytes"]),
+                "live_buffers": int(led["live_buffers"]),
+                "budget_remaining": int(bud["remaining"]),
+                "storming": bool(st["storming"]),
+            }
+            if st["storming"]:
+                s["xla"]["storm_signature"] = st["signature"]
+                s["xla"]["storm_compiles"] = st["compiles_in_window"]
+        except Exception:
+            pass
     ls = _mod("bodo_tpu.analysis.lockstep")
     if ls is not None:
         try:
@@ -382,6 +399,25 @@ def health() -> dict:
                 doc["comm"] = sk
         except Exception:
             pass
+    ob = _mod("bodo_tpu.runtime.xla_observatory")
+    if ob is not None:
+        try:
+            st = ob.storm()
+            if st["storming"]:
+                # a signature recompiling every dispatch burns wall on
+                # compiles — surfaced for admission to back the session
+                # off, but it does NOT flip "status": storms are normal
+                # during warm-up / test suites, and gang liveness (the
+                # thing "degraded" gates restarts on) is unaffected
+                doc["xla_recompile_storm"] = {
+                    "signature": st["signature"],
+                    "compiles_in_window": st["compiles_in_window"],
+                    "window_s": st["window_s"],
+                }
+            doc["xla_live_device_bytes"] = int(
+                ob.ledger_stats()["live_bytes"])
+        except Exception:
+            pass
     with _lock:
         doc["telemetry"] = {
             "sampler_running": _sampler_thread is not None
@@ -458,6 +494,7 @@ def dump_bundle(reason: str, *, gang_dir: Optional[str] = None,
         _write_manifest(d, reason, ranks)
         _write_telemetry(d)
         _write_metrics(d)
+        _write_xla(d)
         _write_slow_queries(d)
         _write_stacks(d)
         _write_traces(d, gang_dir)
@@ -525,6 +562,21 @@ def _write_metrics(d: str) -> None:
     try:
         with open(os.path.join(d, "metrics.prom"), "w") as f:
             f.write(metrics.expose_text())
+    except Exception:
+        pass
+
+
+def _write_xla(d: str) -> None:
+    """Embed the program registry + device-buffer ledger in the bundle
+    (doctor's storm/leak triage reads xla_registry.json)."""
+    ob = _mod("bodo_tpu.runtime.xla_observatory")
+    if ob is None:
+        return
+    try:
+        _write_json(os.path.join(d, "xla_registry.json"),
+                    {"summary": ob.stats(),
+                     "programs": ob.registry_dump(limit=200),
+                     "leaks": ob.leak_check(collect=False)})
     except Exception:
         pass
 

@@ -7,6 +7,12 @@ the same class of leak per test *module* by running each module in its
 own subprocess (bodo/runtests.py:58). Here the engine itself stays
 healthy: kernel caches evict least-recently-used entries so dropped
 executables are garbage-collected.
+
+Caches constructed with a `subsystem` tag additionally report every
+store/hit/eviction to the unified program registry
+(runtime/xla_observatory.py): the optional `describe(key)` callback
+maps a cache key to a (base_signature, facets) pair so the registry
+can attribute retraces to the facet that changed.
 """
 
 from __future__ import annotations
@@ -15,30 +21,57 @@ import functools
 import time
 from collections import OrderedDict
 
+from bodo_tpu.runtime import xla_observatory as _obs
+
 
 class KernelCache:
     """Dict-shaped LRU with the two operations the kernel caches use
     (`get` and item assignment)."""
 
-    def __init__(self, maxsize: int = 1024):
+    def __init__(self, maxsize: int = 1024, *, subsystem=None,
+                 describe=None):
         self.maxsize = maxsize
         self._d: OrderedDict = OrderedDict()
         self.evictions = 0
+        self.subsystem = subsystem
+        self.describe = describe
+        self._handles: dict = {}  # key -> observatory handle
+        self.last_handle = 0  # handle of the most recent store
 
     def get(self, key, default=None):
         try:
             self._d.move_to_end(key)
-            return self._d[key]
+            v = self._d[key]
         except KeyError:
             return default
+        if self.subsystem is not None:
+            _obs.touch(self._handles.get(key, 0))
+        return v
+
+    def _describe(self, key):
+        if self.describe is not None:
+            try:
+                return self.describe(key)
+            except Exception:
+                pass
+        base = key[0] if isinstance(key, tuple) and key \
+            and isinstance(key[0], str) else self.subsystem
+        return str(base), _obs.facets_from_sig(key)
 
     def __setitem__(self, key, value):
         if key in self._d:
             self._d.move_to_end(key)
+        elif self.subsystem is not None:
+            base, facets = self._describe(key)
+            h = _obs.register(self.subsystem, base, facets,
+                              donated=bool(facets.get("donate")))
+            self._handles[key] = h
+            self.last_handle = h
         self._d[key] = value
         while len(self._d) > self.maxsize:
-            self._d.popitem(last=False)
+            k, _ = self._d.popitem(last=False)
             self.evictions += 1
+            _obs.mark_evicted(self._handles.pop(k, 0))
 
     def __contains__(self, key):
         return key in self._d
@@ -46,10 +79,17 @@ class KernelCache:
     def __len__(self):
         return len(self._d)
 
+    def handle_for(self, key) -> int:
+        return self._handles.get(key, 0)
+
     def pop(self, key, default=None):
+        _obs.mark_evicted(self._handles.pop(key, 0))
         return self._d.pop(key, default)
 
     def clear(self):
+        for h in self._handles.values():
+            _obs.mark_evicted(h)
+        self._handles.clear()
         self._d.clear()
 
 
@@ -61,8 +101,10 @@ class FusionProgramCache(KernelCache):
     EXPLAIN ANALYZE, tracing.profile() and the metrics registry report
     per fusion boundary."""
 
-    def __init__(self, maxsize: int = 256):
-        super().__init__(maxsize=maxsize)
+    def __init__(self, maxsize: int = 256, *, subsystem=None,
+                 describe=None):
+        super().__init__(maxsize=maxsize, subsystem=subsystem,
+                         describe=describe)
         self.hits = 0
         self.misses = 0
         self.compiles = 0
@@ -78,11 +120,15 @@ class FusionProgramCache(KernelCache):
             self.hits += 1
         return fn
 
-    def record_compile(self, program: str, seconds: float) -> None:
+    def record_compile(self, program: str, seconds: float,
+                       handle: int = None) -> None:
         """Account one program compilation (feeds the shared
-        bodo_tpu_jit_compile_seconds histogram)."""
+        bodo_tpu_jit_compile_seconds histogram and the program
+        registry's per-executable compile wall)."""
         self.compiles += 1
         self.compile_s += float(seconds)
+        _obs.note_compile(self.last_handle if handle is None else handle,
+                          seconds)
         from bodo_tpu.utils import metrics
         metrics.record_compile(program, seconds)
 
@@ -106,12 +152,45 @@ class DecodeProgramCache(FusionProgramCache):
     fusion cache's hit/miss/compile accounting (EXPLAIN ANALYZE, the
     metrics registry, and tracing.profile() read the same shape)."""
 
-    def __init__(self, maxsize: int = 128):
-        super().__init__(maxsize=maxsize)
+    def __init__(self, maxsize: int = 128, *, subsystem=None,
+                 describe=None):
+        super().__init__(maxsize=maxsize, subsystem=subsystem,
+                         describe=describe)
 
     def clear(self):
         super().clear()
         self.reset_stats()
+
+
+def cached_builder(subsystem: str, maxsize: int = 256):
+    """Registered replacement for `@lru_cache` on program-builder
+    functions (hashable static config in, compiled program out): same
+    memoization, but entries live in a subsystem-tagged KernelCache so
+    every built program appears in the program registry with facet
+    attribution, and eviction actually frees the executable (lru_cache
+    would pin all 256 forever once warm)."""
+    def deco(fun):
+        def _describe(key):
+            args, kw = key
+            return fun.__name__, _obs.facets_from_sig(
+                (fun.__name__,) + tuple(args) + tuple(v for _, v in kw))
+
+        cache = KernelCache(maxsize=maxsize, subsystem=subsystem,
+                            describe=_describe)
+
+        @functools.wraps(fun)
+        def wrapper(*args, **kwargs):
+            key = (args, tuple(sorted(kwargs.items())))
+            fn = cache.get(key)
+            if fn is None:
+                fn = fun(*args, **kwargs)
+                cache[key] = fn
+            return fn
+
+        wrapper.cache = cache
+        wrapper.cache_clear = cache.clear
+        return wrapper
+    return deco
 
 
 def _leaf_key(x):
@@ -133,6 +212,12 @@ def bounded_jit(fun=None, *, static_argnames=(), maxsize=None):
     avals + non-array leaf values, so evicting an entry lets jax
     garbage-collect its executables. Works inside an outer trace too
     (leaves are tracers with shape/dtype; the inner jit inlines).
+
+    Every compiled variant registers with the program registry under
+    subsystem "bounded_jit", base = the wrapped function's name, with
+    shape/dtype/static facets from the cache key — so retraces are
+    attributed (shape-bucket churn vs dtype churn) like any other
+    subsystem's.
     """
     if fun is None:
         return functools.partial(bounded_jit,
@@ -141,7 +226,13 @@ def bounded_jit(fun=None, *, static_argnames=(), maxsize=None):
     if maxsize is None:
         from bodo_tpu.config import config
         maxsize = config.kernel_cache_size
-    cache = KernelCache(maxsize=maxsize)
+
+    def _describe(key):
+        struct, leaf_keys = key
+        return fun.__name__, _obs.facets_from_leaves(struct, leaf_keys)
+
+    cache = KernelCache(maxsize=maxsize, subsystem="bounded_jit",
+                        describe=_describe)
 
     @functools.wraps(fun)
     def wrapper(*args, **kwargs):
@@ -163,9 +254,10 @@ def bounded_jit(fun=None, *, static_argnames=(), maxsize=None):
             # this program's compile cost (bodo_tpu_jit_compile_seconds)
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            _obs.note_compile(cache.handle_for(key), dt)
             from bodo_tpu.utils import metrics
-            metrics.record_compile(fun.__name__,
-                                   time.perf_counter() - t0)
+            metrics.record_compile(fun.__name__, dt)
             return out
         return fn(*args, **kwargs)
 

@@ -791,6 +791,54 @@ def sync_engine_metrics() -> None:
                 fs.get("size", 0))
         except Exception:  # pragma: no cover
             pass
+    # -- compile & device-memory observatory (stdlib-only module, but
+    # the same lazy rule keeps a bare metrics scrape from loading it) --------
+    ob = sys.modules.get("bodo_tpu.runtime.xla_observatory")
+    if ob is not None:
+        try:
+            os_ = ob.stats()
+            g = gauge("bodo_tpu_xla_executables",
+                      "registered XLA executables", ("subsystem",))
+            gc_ = gauge("bodo_tpu_xla_compile_seconds",
+                        "cumulative compile wall seconds",
+                        ("subsystem",))
+            gd = gauge("bodo_tpu_xla_dispatches_total",
+                       "dispatches of registered executables",
+                       ("subsystem",))
+            for sub, sv in os_["by_subsystem"].items():
+                g.labels(subsystem=sub).set(sv["executables"])
+                gc_.labels(subsystem=sub).set(sv["compile_s"])
+                gd.labels(subsystem=sub).set(sv["dispatches"])
+            gauge("bodo_tpu_xla_budget_remaining",
+                  "unified compile-budget units left (-1 unlimited)"
+                  ).set(os_["budget"]["remaining"])
+            gr = gauge("bodo_tpu_xla_retraces_total",
+                       "retraces by attributed cause", ("cause",))
+            for cause, n in os_["retraces"].items():
+                gr.labels(cause=cause).set(n)
+            led = os_["ledger"]
+            gb = gauge("bodo_tpu_device_bytes_live",
+                       "live device bytes by creating operator",
+                       ("operator",))
+            for op, ov in led["by_op"].items():
+                gb.labels(operator=op).set(
+                    ov["created_bytes"] - ov["freed_bytes"])
+            gauge("bodo_tpu_device_bytes_created_total",
+                  "device bytes created (ledger)").set(
+                led["created_bytes"])
+            gauge("bodo_tpu_device_bytes_freed_total",
+                  "device bytes freed (ledger)").set(led["freed_bytes"])
+            gauge("bodo_tpu_device_buffers_live",
+                  "live tracked device buffers").set(
+                led["live_buffers"])
+            gdn = gauge("bodo_tpu_xla_donation_total",
+                        "donated dispatches by verification result",
+                        ("result",))
+            gdn.labels(result="verified").set(
+                led["donation"]["verified"])
+            gdn.labels(result="copied").set(led["donation"]["copied"])
+        except Exception:  # pragma: no cover
+            pass
     # -- telemetry sampler (same lazy-module rule) ---------------------------
     tl = sys.modules.get("bodo_tpu.runtime.telemetry")
     if tl is not None:

@@ -40,6 +40,7 @@ import contextvars
 import glob as _glob
 import json
 import os
+import sys
 import threading
 import time
 from collections import OrderedDict, deque
@@ -129,9 +130,23 @@ def query_span(query_id: Optional[str] = None, env_export: bool = True):
             else:
                 os.environ[_QID_ENV] = prev_env
         wall = time.perf_counter() - t0
+        # device-buffer leak check: the observatory closes this query's
+        # HBM ledger entry (created/freed/live bytes) at span exit
+        dev = None
+        ob = sys.modules.get("bodo_tpu.runtime.xla_observatory")
+        if ob is not None:
+            try:
+                dev = ob.finish_query(qid)
+            except Exception:
+                dev = None
         with _lock:
             meta = _query_meta.setdefault(qid, {"wall_s": 0.0})
             meta["wall_s"] += wall
+            if dev is not None and dev.get("buffers"):
+                meta["device_bytes"] = {
+                    "created": dev["created_bytes"],
+                    "freed": dev["freed_bytes"],
+                    "live": dev["live_bytes"]}
             while len(_query_meta) > _MAX_QUERY_META:
                 _query_meta.popitem(last=False)
 
@@ -590,6 +605,32 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
             "total_s": series("bodo_tpu_fusion_compile_seconds").get(
                 (), 0.0),
             "max_s": 0.0, "rows": 0}
+    # compile & device-memory observatory: per-subsystem executable
+    # populations with compile wall (time-valued), retrace causes, and
+    # the live device-byte ledger
+    xe = series("bodo_tpu_xla_executables")
+    xc = series("bodo_tpu_xla_compile_seconds")
+    xd = series("bodo_tpu_xla_dispatches_total")
+    for (sub,), n in xe.items():
+        if n:
+            out[f"xla:{sub}"] = {
+                "count": int(n), "total_s": xc.get((sub,), 0.0),
+                "max_s": 0.0, "rows": 0,
+                "dispatches": int(xd.get((sub,), 0))}
+    for (cause,), n in series("bodo_tpu_xla_retraces_total").items():
+        if n:
+            out[f"xla:retrace:{cause}"] = {
+                "count": int(n), "total_s": 0.0, "max_s": 0.0,
+                "rows": 0}
+    created = series("bodo_tpu_device_bytes_created_total").get((), 0)
+    if created:
+        freed = series("bodo_tpu_device_bytes_freed_total").get((), 0)
+        out["xla:device_bytes"] = {
+            "count": int(series("bodo_tpu_device_buffers_live")
+                         .get((), 0)),
+            "total_s": 0.0, "max_s": 0.0, "rows": 0,
+            "created_bytes": int(created), "freed_bytes": int(freed),
+            "live_bytes": int(created - freed)}
     # time-valued lockstep row: dispatches checked + peer-wait seconds
     lc = series("bodo_tpu_lockstep_collectives_total").get((), 0)
     if lc:

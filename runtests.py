@@ -153,6 +153,11 @@ def main(argv: list[str]) -> int:
                  "-o", f"faulthandler_timeout={_DUMP_S:.0f}",
                  *passthrough],
                 cwd=_REPO, capture_output=True, text=True,
+                # per-module program-registry teardown (tests/conftest.py):
+                # grouped modules share one process, so evicting each
+                # module's compiled programs keeps the live-executable
+                # census bounded and the observatory's numbers per-module
+                env={**os.environ, "BODO_TPU_XLA_TEARDOWN": "1"},
                 timeout=_WATCHDOG_S)
         except subprocess.TimeoutExpired as e:
             dt = time.time() - t1

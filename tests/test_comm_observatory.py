@@ -395,6 +395,34 @@ class TestBenchwatch:
         med = benchwatch.watch(d, against="median")
         assert med["metrics"]["speedup"]["reference"] == 3.0
 
+    def test_waiver_downgrades_regression_for_that_round(self,
+                                                         tmp_path):
+        from bodo_tpu import benchwatch
+        d = str(tmp_path / "tw")
+        _write_traj(d, [2.0, 2.5, 1.9])
+        # waive the regressing round with a documented reason
+        p = os.path.join(d, "BENCH_r03.json")
+        with open(p) as f:
+            rec = json.load(f)
+        rec["waiver"] = "degraded box: pristine HEAD control also slow"
+        with open(p, "w") as f:
+            json.dump(rec, f)
+        out = benchwatch.watch(d, threshold=0.15)
+        assert out["ok"]
+        assert out["regressions"] == []
+        v = out["metrics"]["speedup"]
+        assert v["status"] == "waived"
+        rendered = benchwatch.render(out)
+        assert "WAIVED" in rendered
+        assert "degraded box" in rendered
+        # the waiver covers ONLY its round: a later unwaived round
+        # still regresses against the pre-waiver high-water mark
+        with open(os.path.join(d, "BENCH_r04.json"), "w") as f:
+            json.dump(_bench_rec(4, 1.8), f)
+        out2 = benchwatch.watch(d, threshold=0.15)
+        assert out2["regressions"] == ["speedup"]
+        assert not out2["ok"]
+
     def test_schema_violations_fail_loudly(self, tmp_path):
         from bodo_tpu import benchwatch
         d = str(tmp_path / "t6")

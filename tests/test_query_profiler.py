@@ -291,6 +291,9 @@ def _mask(txt: str) -> str:
     txt = MASK.sub(lambda m: f"{m.group(1)}=#", txt)
     # fused[...] content varies per run (compile vs cache_hit, wall)
     txt = re.sub(r"fused\[[^\]]*\]", "fused[#]", txt)
+    # xla=/dev= observatory annotations depend on process-wide compile
+    # and ledger state (mid-suite vs isolated run) — drop them entirely
+    txt = re.sub(r"  (?:xla|dev)=\S+", "", txt)
     return re.sub(r"query=\S+", "query=#", txt)
 
 

@@ -363,6 +363,71 @@ class TestLint:
         """)
         assert [f.rule for f in got] == ["unlocked-shared-state"]
 
+    def test_unregistered_jit_direct_call(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import jax
+            def build(spec):
+                return jax.jit(lambda x: x + 1)
+        """)
+        assert [f.rule for f in got] == ["unregistered-jit"]
+        assert got[0].func == "build"
+
+    def test_unregistered_pallas_call(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def kernel(x):
+                return pl.pallas_call(body, grid=(4,))(x)
+        """)
+        assert [f.rule for f in got] == ["unregistered-jit"]
+
+    def test_unregistered_jit_decorator(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import jax
+            from functools import partial
+
+            @jax.jit
+            def f(x):
+                return x
+
+            @partial(jax.jit, static_argnames=("k",))
+            def g(x, k):
+                return x
+        """)
+        assert sorted(f.rule for f in got) == ["unregistered-jit"] * 2
+
+    def test_jit_registered_via_cache_store_ok(self, tmp_path):
+        # a function that stores its compiled program into a kernel
+        # cache (name contains 'cache'/'program') IS registered — the
+        # store reports to the program registry
+        got = _lint_src(tmp_path, """
+            import jax
+            _programs = {}
+            def build(key):
+                fn = jax.jit(lambda x: x)
+                _programs[key] = fn
+                return fn
+        """)
+        assert got == []
+
+    def test_jit_registered_via_cached_builder_ok(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import jax
+            from bodo_tpu.utils.kernel_cache import cached_builder
+
+            @cached_builder("streaming")
+            def build(key):
+                return jax.jit(lambda x: x)
+        """)
+        assert got == []
+
+    def test_unregistered_jit_suppression(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import jax
+            def build(spec):
+                # shardcheck: ignore[unregistered-jit]
+                return jax.jit(lambda x: x + 1)
+        """)
+        assert got == []
+
     def test_baseline_roundtrip(self, tmp_path, monkeypatch, capsys):
         mod = tmp_path / "legacy.py"
         mod.write_text(textwrap.dedent("""

@@ -1,0 +1,489 @@
+"""Compile & device-memory observatory (runtime/xla_observatory.py):
+program registry, retrace attribution, unified compile budget, storm
+detector, device-buffer ledger, donation verification, and the surfacing
+layers (metrics exposition, EXPLAIN ANALYZE, profile rows, doctor)."""
+
+import gc
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from bodo_tpu.config import set_config
+from bodo_tpu.runtime import xla_observatory as obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset()
+    obs.set_enabled(True)
+    yield
+    obs.reset()
+    obs.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# program registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_register_touch_evict(self):
+        h = obs.register("fusion", "stage", {"dtype": ("i64",)})
+        assert h > 0
+        obs.touch(h)
+        obs.touch(h)
+        obs.note_compile(h, 0.5)
+        st = obs.stats()
+        assert st["executables"] == 1
+        assert st["alive"] == 1
+        assert st["dispatches"] == 2
+        assert st["compile_s"] == pytest.approx(0.5)
+        assert st["by_subsystem"]["fusion"]["dispatches"] == 2
+        obs.mark_evicted(h)
+        st = obs.stats()
+        assert st["alive"] == 0
+        assert st["evicted"] == 1
+
+    def test_disabled_registers_nothing(self):
+        obs.set_enabled(False)
+        h = obs.register("fusion", "stage", {})
+        assert h == 0
+        obs.touch(h)  # must be a no-op, not a crash
+        assert obs.stats()["executables"] == 0
+
+    def test_records_trimmed_to_max(self, monkeypatch):
+        monkeypatch.setattr(obs, "_MAX_RECORDS", 8)
+        for i in range(20):
+            obs.register("fusion", f"b{i}", {})
+        assert obs.stats()["executables"] == 8
+
+    def test_registry_dump_most_recent_first(self):
+        obs.register("fusion", "a", {})
+        obs.register("decode", "b", {})
+        dump = obs.registry_dump()
+        assert [d["base"] for d in dump] == ["b", "a"]
+        assert obs.registry_dump(limit=1)[0]["base"] == "b"
+
+
+class TestRetraceAttribution:
+    def test_dtype_churn(self):
+        obs.register("relational", "filter", {"dtype": ("i64",)})
+        obs.register("relational", "filter", {"dtype": ("f64",)})
+        st = obs.stats()
+        assert st["retraces"] == {"dtype-churn": 1}
+        assert obs.head()["last_cause"] == "dtype-churn"
+
+    def test_shape_bucket_churn(self):
+        obs.register("bounded_jit", "step", {"shape": ((1024,),),
+                                             "dtype": ("i64",)})
+        obs.register("bounded_jit", "step", {"shape": ((2048,),),
+                                             "dtype": ("i64",)})
+        assert obs.stats()["retraces"] == {"shape-bucket-churn": 1}
+
+    def test_mesh_beats_dtype_in_priority(self):
+        obs.register("fusion", "stage", {"mesh": "aa", "dtype": ("i64",)})
+        obs.register("fusion", "stage", {"mesh": "bb", "dtype": ("f64",)})
+        assert obs.stats()["retraces"] == {"mesh-change": 1}
+
+    def test_donation_flag(self):
+        obs.register("fusion", "stage", {"donate": False})
+        obs.register("fusion", "stage", {"donate": True})
+        assert obs.stats()["retraces"] == {"donation-flag": 1}
+
+    def test_identical_facets_is_evicted_recompile(self):
+        obs.register("fusion", "stage", {"dtype": ("i64",)})
+        obs.register("fusion", "stage", {"dtype": ("i64",)})
+        assert obs.stats()["retraces"] == {"evicted-recompile": 1}
+
+    def test_distinct_bases_are_not_retraces(self):
+        obs.register("fusion", "a", {})
+        obs.register("fusion", "b", {})
+        assert obs.stats()["retraces_total"] == 0
+
+
+class TestStormDetector:
+    def test_storm_fires_above_threshold(self, monkeypatch):
+        monkeypatch.setattr(obs, "_STORM_THRESHOLD", 4)
+        for _ in range(4):
+            obs.register("fusion", "hot_stage", {})
+        st = obs.storm()
+        assert st["storming"]
+        assert st["signature"] == "fusion:hot_stage"
+        assert st["compiles_in_window"] >= 4
+
+    def test_quiet_below_threshold(self, monkeypatch):
+        monkeypatch.setattr(obs, "_STORM_THRESHOLD", 4)
+        obs.register("fusion", "a", {})
+        obs.register("fusion", "b", {})
+        assert not obs.storm()["storming"]
+
+    def test_storm_surfaces_in_health(self, monkeypatch):
+        from bodo_tpu.runtime import telemetry
+        monkeypatch.setattr(obs, "_STORM_THRESHOLD", 3)
+        for _ in range(3):
+            obs.register("device_decode", "page:plain", {})
+        h = telemetry.health()
+        storm = h.get("xla_recompile_storm")
+        assert storm and storm["signature"] == "device_decode:page:plain"
+
+
+# ---------------------------------------------------------------------------
+# unified compile budget
+# ---------------------------------------------------------------------------
+
+
+class TestUnifiedBudget:
+    def test_pool_exhaustion_denies(self, monkeypatch):
+        monkeypatch.setattr(obs, "_pool_cap", 2)
+        assert obs.try_spend("fusion")
+        assert obs.try_spend("device_decode")
+        assert not obs.try_spend("fusion")
+        b = obs.budget()
+        assert b["spent"] == 2
+        assert b["remaining"] == 0
+        assert b["denials"]["fusion"] == 1
+
+    def test_sub_cap_denies_before_pool(self, monkeypatch):
+        monkeypatch.setattr(obs, "_pool_cap", 100)
+        monkeypatch.setitem(obs._SUB_CAPS, "fusion", 1)
+        assert obs.try_spend("fusion")
+        assert not obs.try_spend("fusion")
+        # the other subsystem still has pool headroom
+        assert obs.try_spend("device_decode")
+
+    def test_reset_budget_returns_spend(self, monkeypatch):
+        monkeypatch.setattr(obs, "_pool_cap", 1)
+        assert obs.try_spend("fusion")
+        assert not obs.try_spend("device_decode")
+        obs.reset_budget("fusion")
+        assert obs.try_spend("device_decode")
+
+    def test_subsystem_budget_left(self, monkeypatch):
+        monkeypatch.setattr(obs, "_pool_cap", 10)
+        monkeypatch.setitem(obs._SUB_CAPS, "fusion", 3)
+        assert obs.subsystem_budget_left("fusion") == 3
+        obs.try_spend("fusion")
+        assert obs.subsystem_budget_left("fusion") == 2
+
+    def test_negative_pool_is_unlimited(self, monkeypatch):
+        monkeypatch.setattr(obs, "_pool_cap", -1)
+        monkeypatch.setitem(obs._SUB_CAPS, "fusion", -1)
+        for _ in range(300):
+            assert obs.try_spend("fusion")
+        assert obs.subsystem_budget_left("fusion") == -1
+
+    def test_env_override_and_legacy_aliases(self):
+        """BODO_TPU_XLA_MAX_EXECUTABLES overrides the pool; the legacy
+        per-subsystem knobs survive as sub-caps and default the pool to
+        their sum (default behavior unchanged)."""
+        import subprocess
+        import sys
+        code = (
+            "from bodo_tpu.runtime import xla_observatory as o;"
+            "b = o.budget();"
+            "print(b['pool_cap'], b['sub_caps']['fusion'],"
+            "      b['sub_caps']['device_decode'])")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, check=True)
+        assert out.stdout.split() == ["192", "128", "64"]
+        env2 = {**env, "BODO_TPU_XLA_MAX_EXECUTABLES": "7",
+                "BODO_TPU_FUSION_MAX_COMPILES": "5"}
+        out = subprocess.run([sys.executable, "-c", code], env=env2,
+                             capture_output=True, text=True, check=True)
+        assert out.stdout.split() == ["7", "5", "64"]
+
+    def test_fusion_budget_integrates_pool(self, monkeypatch):
+        """Exhausted unified pool -> fusion falls back unfused (same
+        fallback its legacy local cap triggers)."""
+        from bodo_tpu.plan import fusion
+        monkeypatch.setattr(obs, "_pool_cap", 0)
+        monkeypatch.setattr(fusion, "_n_compiles", 0)
+        with pytest.raises(fusion.FusionFallback):
+            fusion._budget_compile("sig:test-pool-exhausted")
+
+
+# ---------------------------------------------------------------------------
+# device-buffer ledger
+# ---------------------------------------------------------------------------
+
+
+class TestLedger:
+    def test_track_free_balances(self):
+        a = jnp.arange(1024, dtype=jnp.int64)
+        nbytes = a.nbytes
+        assert obs.track_buffer(a, "test_op", query_id="q1")
+        assert obs.live_bytes() == nbytes
+        del a
+        gc.collect()
+        st = obs.ledger_stats()
+        assert st["created_bytes"] == nbytes
+        assert st["freed_bytes"] == nbytes
+        assert st["live_bytes"] == 0
+        assert st["live_buffers"] == 0
+
+    def test_double_track_is_idempotent(self):
+        a = jnp.arange(16)
+        assert obs.track_buffer(a, "op")
+        assert not obs.track_buffer(a, "op")
+        assert obs.ledger_stats()["created_buffers"] == 1
+
+    def test_non_device_values_skipped(self):
+        assert not obs.track_buffer(np.arange(8), "op")
+        assert not obs.track_buffer(None, "op")
+        assert not obs.track_buffer(3, "op")
+
+    def test_per_query_attribution_balances_to_zero(self):
+        bufs = [jnp.arange(256) * i for i in range(4)]
+        for b in bufs:
+            obs.track_buffer(b, "fused_stage", query_id="q7")
+        created = sum(x.nbytes for x in bufs)
+        del bufs, b  # the loop variable still pins the last buffer
+        gc.collect()
+        rep = obs.finish_query("q7")
+        assert rep["created_bytes"] == created
+        assert rep["freed_bytes"] == created
+        assert rep["live_bytes"] == 0
+        assert rep["by_op"]["fused_stage"]["created"] == created
+
+    def test_leak_check_names_the_site(self):
+        keep = jnp.arange(512)
+        obs.track_buffer(keep, "leaky_op")
+        leak = obs.leak_check()
+        assert leak["live_bytes"] == keep.nbytes
+        assert next(iter(leak["by_op"])) == "leaky_op"
+
+    def test_mark_deleted_preempts_finalizer(self):
+        a = jnp.arange(64)
+        obs.track_buffer(a, "op")
+        obs.mark_deleted(a)
+        assert obs.live_bytes() == 0
+        del a
+        gc.collect()  # finalizer fires but must not double-free
+        assert obs.ledger_stats()["freed_buffers"] == 1
+
+
+class TestDonationChaos:
+    def test_donation_on_buffer_provably_freed(self, mesh8):
+        """With donate_argnums the CPU backend really consumes the input
+        buffer: verify_donation sees is_deleted() and releases it from
+        the ledger immediately (no gc needed)."""
+        from bodo_tpu.table.table import Column, REP, Table
+
+        data = jnp.arange(4096, dtype=jnp.int64)
+        t = Table({"x": Column("x", data, None)}, 4096, REP, None)
+        obs.track_buffer(data, "arrow_ingest")
+
+        step = jax.jit(lambda v: v * 2, donate_argnums=(0,))
+        out = step(data)
+        del data
+        assert obs.verify_donation(t)
+        st = obs.ledger_stats()
+        assert st["donation"]["verified"] == 1
+        assert st["donation"]["copied"] == 0
+        assert st["live_bytes"] == 0  # freed by donation, not gc
+        assert int(out[1]) == 2
+
+    def test_donation_off_ledger_shows_copy(self, mesh8):
+        from bodo_tpu.table.table import Column, REP, Table
+
+        data = jnp.arange(4096, dtype=jnp.int64)
+        t = Table({"x": Column("x", data, None)}, 4096, REP, None)
+        obs.track_buffer(data, "arrow_ingest")
+
+        out = jax.jit(lambda v: v * 2)(data)
+        assert not obs.verify_donation(t)  # input survived: a copy
+        st = obs.ledger_stats()
+        assert st["donation"]["copied"] == 1
+        assert st["live_bytes"] == data.nbytes
+        assert int(out[1]) == 2
+
+
+# ---------------------------------------------------------------------------
+# jit entry points register
+# ---------------------------------------------------------------------------
+
+
+class TestEntryPoints:
+    def test_bounded_jit_registers_and_attributes(self):
+        from bodo_tpu.utils.kernel_cache import bounded_jit
+
+        @bounded_jit
+        def double(x):
+            return x * 2
+
+        double(jnp.arange(8, dtype=jnp.int64))
+        double(jnp.arange(8, dtype=jnp.int64))   # cached
+        double(jnp.arange(16, dtype=jnp.int64))  # shape retrace
+        st = obs.stats()
+        sub = st["by_subsystem"]["bounded_jit"]
+        assert sub["executables"] == 2
+        assert st["retraces"] == {"shape-bucket-churn": 1}
+        assert sub["compile_s"] > 0  # first invocation wall attributed
+
+    def test_cached_builder_registers(self):
+        from bodo_tpu.utils.kernel_cache import cached_builder
+
+        calls = []
+
+        @cached_builder("streaming", maxsize=2)
+        def build(n):
+            calls.append(n)
+            return lambda: n
+
+        assert build(1)() == 1
+        assert build(1)() == 1
+        assert build(2)() == 2
+        assert calls == [1, 2]
+        st = obs.stats()["by_subsystem"]["streaming"]
+        assert st["executables"] == 2
+        build(3)  # evicts the LRU entry
+        assert obs.stats()["evicted"] == 1
+        build.cache_clear()
+        assert obs.stats()["alive"] == 0
+
+    def test_fusion_cache_is_tagged(self):
+        from bodo_tpu.plan.fusion import _programs
+        assert _programs.subsystem == "fusion"
+
+    def test_decode_cache_is_tagged(self):
+        from bodo_tpu.io.device_decode import _programs
+        assert _programs.subsystem == "device_decode"
+
+
+# ---------------------------------------------------------------------------
+# surfacing: metrics exposition, explain, profile, bundles, doctor
+# ---------------------------------------------------------------------------
+
+
+def _run_traced_pipeline(seed=3):
+    import bodo_tpu.pandas_api as bd
+    from bodo_tpu.plan import physical
+    from bodo_tpu.utils import tracing
+
+    r = np.random.default_rng(seed)
+    df = pd.DataFrame({"k": r.integers(0, 8, 2000),
+                       "v": r.normal(size=2000)})
+    physical._result_cache.clear()
+    with tracing.query_span() as qid:
+        bdf = bd.from_pandas(df)
+        bdf = bdf[bdf["k"] > 1]
+        bdf.groupby("k", as_index=False).agg(
+            s=("v", "sum")).to_pandas()
+    return qid
+
+
+class TestSurfacing:
+    def test_metrics_exposition(self, mesh8):
+        from bodo_tpu.utils import metrics
+
+        h = obs.register("fusion", "stage", {})
+        obs.note_compile(h, 0.25)
+        obs.touch(h)
+        a = jnp.arange(128)
+        obs.track_buffer(a, "fused_stage")
+        metrics.sync_engine_metrics()
+        text = metrics.expose_text()
+        for needle in ("bodo_tpu_xla_executables",
+                       "bodo_tpu_xla_compile_seconds",
+                       "bodo_tpu_xla_budget_remaining",
+                       "bodo_tpu_device_bytes_live",
+                       "bodo_tpu_device_buffers_live"):
+            assert needle in text, needle
+        assert 'subsystem="fusion"' in text
+
+    def test_explain_and_profile_rows(self, mesh8):
+        from bodo_tpu.plan import explain
+        from bodo_tpu.utils import tracing
+
+        set_config(tracing_level=1)
+        try:
+            qid = _run_traced_pipeline()
+            tree = explain.explain_analyze(qid)
+            assert "xla=" in tree
+            prof = tracing.profile()
+            assert any(k.startswith("xla:") for k in prof), \
+                sorted(prof)[:20]
+        finally:
+            set_config(tracing_level=0)
+
+    def test_query_span_attaches_device_bytes(self, mesh8):
+        from bodo_tpu.utils import tracing
+
+        set_config(tracing_level=1)
+        try:
+            with tracing.query_span() as qid:
+                a = jnp.arange(4096, dtype=jnp.int64)
+                obs.track_buffer(a, "fused_stage", query_id=qid)
+            meta = tracing._query_meta[qid]
+            dev = meta["device_bytes"]
+            assert dev["created"] == a.nbytes
+            assert dev["created"] - dev["freed"] == dev["live"]
+        finally:
+            set_config(tracing_level=0)
+
+    def test_bundle_embeds_registry(self, tmp_path, mesh8):
+        from bodo_tpu.runtime import telemetry
+
+        obs.register("fusion", "stage", {"dtype": ("i64",)})
+        d = telemetry.dump_bundle("test", out_dir=str(tmp_path))
+        reg = json.load(open(os.path.join(d, "xla_registry.json")))
+        assert reg["summary"]["executables"] == 1
+        assert reg["programs"][0]["base"] == "stage"
+        assert "leaks" in reg
+
+
+class TestDoctorGolden:
+    def _storm_bundle(self, tmp_path):
+        """Synthetic flight bundle whose registry dump shows a
+        device_decode recompile storm plus a leak."""
+        bundle = tmp_path / "bundle"
+        bundle.mkdir()
+        (bundle / "manifest.json").write_text(json.dumps(
+            {"reason": "hang", "created": 0}))
+        reg = {
+            "summary": {
+                "executables": 40, "compiles": 40, "compile_s": 12.5,
+                "retraces": {"shape-bucket-churn": 31, "dtype-churn": 2},
+                "storm": {"storming": True,
+                          "signature": "device_decode:page:plain",
+                          "compiles_in_window": 31, "window_s": 60.0,
+                          "threshold": 8},
+                "ledger": {"donation": {"verified": 3, "copied": 2}},
+            },
+            "programs": [
+                {"subsystem": "device_decode", "base": "page:plain",
+                 "compile_s": 0.4, "dispatches": 1,
+                 "retrace_cause": "shape-bucket-churn"},
+            ],
+            "leaks": {"live_bytes": 1 << 20, "live_buffers": 9,
+                      "by_op": {"fused_stage": 1 << 20}},
+        }
+        (bundle / "xla_registry.json").write_text(json.dumps(reg))
+        return str(bundle)
+
+    def test_triage_names_storming_signature(self, tmp_path):
+        from bodo_tpu import doctor
+
+        t = doctor.triage(self._storm_bundle(tmp_path))
+        x = t["xla"]
+        assert x["storm"]["signature"] == "device_decode:page:plain"
+        assert x["retraces"]["shape-bucket-churn"] == 31
+        assert x["leak"]["dominant_site"] == "fused_stage"
+        assert x["donation"]["copied"] == 2
+
+    def test_render_golden_lines(self, tmp_path):
+        from bodo_tpu import doctor
+
+        txt = doctor.render(doctor.triage(self._storm_bundle(tmp_path)))
+        assert "RECOMPILE STORM: device_decode:page:plain" in txt
+        assert "31x" in txt
+        assert "shape-bucket-churn: 31" in txt
+        assert "LIVE DEVICE BYTES" in txt
+        assert "fused_stage" in txt
+        assert "donation" in txt

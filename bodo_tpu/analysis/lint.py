@@ -41,6 +41,25 @@ Rules:
       unsharded boundary — the exact materialization fusion exists to
       eliminate.
 
+  rank-divergent-rng-seed
+      An RNG seeded from process/shard identity (np.random.seed /
+      default_rng / PRNGKey over rank, process_index,
+      BODO_TPU_PROC_ID, ...). Rank-variant seeds silently diverge
+      REPLICATED state: every rank holds "the same" table, fills nulls
+      or samples with "the same" RNG, and ends up with different
+      bytes — the gang then disagrees at the next content-keyed
+      collective or cache lookup. Shard-local sampling must derive
+      from a rank-INVARIANT seed plus an explicit fold
+      (jax.random.fold_in), never from seeding with the rank itself.
+
+  divergent-host-sync
+      A host sync (`jax.device_get` / `.block_until_ready()`) under
+      control flow conditioned on process/shard identity. Fetching a
+      SHARDED array is a cross-host transfer on multi-host backends —
+      ranks that skipped the branch never enter it, so the fetching
+      rank wedges exactly like a skipped collective (the
+      rank-divergent-collective rule's host-side twin).
+
 Suppressions: `# shardcheck: ignore[rule]` (or bare
 `# shardcheck: ignore` for all rules) on the finding's line or the
 line directly above. Grandfathered findings live in
@@ -79,6 +98,10 @@ RULES = {
         "collective inside a try whose handler swallows divergence",
     "unregistered-jit":
         "jit/pallas_call site bypassing the program registry",
+    "rank-divergent-rng-seed":
+        "RNG seeded from process/shard identity",
+    "divergent-host-sync":
+        "host sync of device arrays under rank-dependent control flow",
 }
 
 # names that identify process/shard identity in a branch condition
@@ -115,6 +138,13 @@ _NONIDEMPOTENT = {"write", "writelines", "write_table", "send",
 # fusion: the body runs inside ONE compiled program)
 _HOST_SYNC_NAMES = {"device_get", "to_pandas", "device_put",
                     "block_until_ready"}
+
+# host syncs that are cross-host transfers for sharded arrays — under
+# rank-divergent control flow they wedge like a skipped collective
+_DIVERGENT_SYNC_NAMES = {"device_get", "block_until_ready"}
+
+# RNG seeding entry points (numpy + jax.random)
+_RNG_SEED_NAMES = {"seed", "default_rng", "PRNGKey", "RandomState"}
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
                    "BoundedSemaphore"}
@@ -453,6 +483,23 @@ class _Checker(ast.NodeVisitor):
                 f"collective {t!r} dispatched under rank-dependent "
                 f"control flow: ranks taking the other branch never "
                 f"enter the collective and the gang hangs")
+        if self._div_depth and t in _DIVERGENT_SYNC_NAMES:
+            self._add(
+                "divergent-host-sync", node,
+                f"{t!r} under rank-dependent control flow: fetching a "
+                f"sharded array is a cross-host transfer — ranks that "
+                f"took the other branch never participate, wedging "
+                f"this rank like a skipped collective")
+        if t in _RNG_SEED_NAMES and (node.args or node.keywords) and \
+                any(_test_is_rank_divergent(a)
+                    for a in list(node.args) +
+                    [k.value for k in node.keywords]):
+            self._add(
+                "rank-divergent-rng-seed", node,
+                f"{t!r} seeded from process/shard identity: replicated "
+                f"state sampled from it silently diverges across "
+                f"ranks — derive shard-local streams from a "
+                f"rank-invariant seed via jax.random.fold_in instead")
         if self._traced_depth:
             dotted = _dotted(node.func)
             if (t in _SIDE_EFFECT_NAMES or

@@ -181,15 +181,24 @@ def pre_collective(op: str) -> float:
     return c.check(op, _call_site())
 
 
-def register_fusion_manifest(group_fp: str, ops, collectives: int) -> None:
+def register_fusion_manifest(group_fp: str, ops, collectives: int,
+                             in_program=()) -> None:
     """Register the collective manifest of one compiled fusion group:
-    the member-op fingerprints the fused program subsumes and how many
-    in-program collectives a dispatch implies. Called at group compile
-    time (once per distinct group signature); cheap enough to call
-    unconditionally so manifests exist when lockstep is enabled later."""
+    the member-op fingerprints the fused program subsumes, how many
+    host count syncs a dispatch implies, and — new with the fused-join
+    work — the NAMES of the collectives traced INSIDE the compiled body
+    (``in_program``, e.g. ``("all_to_all", "psum")``). Those collectives
+    never pass through the host dispatch hooks, so the manifest is the
+    only record of them: the comm observatory resolves it via
+    ``comm.record_in_program`` to attribute bytes/latency, and lockstep
+    divergence reports can name what a fused[...] fingerprint subsumes.
+    Called at group compile time (once per distinct group signature);
+    cheap enough to call unconditionally so manifests exist when
+    lockstep is enabled later."""
     with _lock:
         _manifests[group_fp] = {"ops": tuple(ops),
-                                "collectives": int(collectives)}
+                                "collectives": int(collectives),
+                                "in_program": tuple(in_program)}
 
 
 def fusion_manifest(group_fp: str) -> Optional[dict]:

@@ -97,6 +97,31 @@ def load_trajectory(bench_dir: str) -> dict:
     return {"records": records, "errors": errors}
 
 
+def _suite_summaries(rec: dict):
+    """Yield every parsed summary one artifact carries: the top-level
+    ``parsed`` line plus any per-suite summaries embedded under
+    ``parsed.detail.suites`` (a round that runs several suites records
+    each suite's own ``{"metric", "value", "unit"}`` there — r06
+    onward). Each embedded summary becomes its own metric series in the
+    trajectory, so a suite regression cannot hide behind a healthy
+    headline number. Embedded entries missing the summary keys are
+    skipped silently — ``detail`` is free-form; only well-formed suite
+    summaries are promoted to tracked metrics."""
+    parsed = rec.get("parsed")
+    if not parsed:
+        return
+    yield parsed
+    detail = parsed.get("detail")
+    suites = detail.get("suites") if isinstance(detail, dict) else None
+    if not isinstance(suites, dict):
+        return
+    for sub in suites.values():
+        if (isinstance(sub, dict) and "metric" in sub
+                and "unit" in sub
+                and isinstance(sub.get("value"), (int, float))):
+            yield sub
+
+
 def _direction(unit: str) -> int:
     """+1 when larger values are better, -1 when smaller are, 0 when
     the unit is unknown (compared informationally, never failed)."""
@@ -116,13 +141,18 @@ def compare(records: List[dict], *, threshold: float = 0.15,
     the metric's direction — catches decay from the high-water mark),
     "prev" (previous round only), or "median". A metric regresses when
     the latest value is worse than the reference by more than
-    ``threshold`` (relative). Metrics seen only once are "new"."""
+    ``threshold`` (relative). Metrics seen only once are "new".
+
+    Embedded per-suite summaries (``parsed.detail.suites``) are lifted
+    into their own metric series alongside the headline metric, sharing
+    the round's envelope (round number, waiver) — see
+    ``_suite_summaries``."""
     by_metric: Dict[str, List[dict]] = {}
     for rec in records:
-        parsed = rec.get("parsed")
-        if not parsed:
-            continue
-        by_metric.setdefault(parsed["metric"], []).append(rec)
+        for parsed in _suite_summaries(rec):
+            by_metric.setdefault(parsed["metric"], []).append(
+                {"parsed": parsed, "_round": rec["_round"],
+                 "_path": rec["_path"], "waiver": rec.get("waiver")})
 
     verdicts = {}
     for metric, recs in sorted(by_metric.items()):

@@ -57,6 +57,23 @@ class Config:
     fusion: bool = field(
         default_factory=lambda: _env_bool("BODO_TPU_FUSION", True)
     )
+    # Fused join groups (plan/fusion_join.py): extend whole-stage fusion
+    # across join-probe and shuffle boundaries — the probe (and any
+    # filter/project chain around it, plus an optional terminal dense
+    # aggregate) compiles into ONE jit/shard_map program over a
+    # device-resident build-side hash table, with the bucket shuffle's
+    # lax.all_to_all traced INSIDE the program. Off → joins dispatch
+    # per-operator (pre-PR-12 behavior); requires `fusion` too.
+    fusion_join: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_FUSION_JOIN", True)
+    )
+    # Device-resident build-side hash tables kept per process (LRU):
+    # each entry pins the build table's encoded key codes + slot-owner
+    # LUT on device so repeat probes (streaming batches, reused build
+    # subplans) skip the build entirely.
+    join_build_cache_size: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_JOIN_BUILD_CACHE", 32)
+    )
     # Pad table capacities up to a multiple of this (TPU lane friendliness).
     capacity_round: int = field(
         default_factory=lambda: _env_int("BODO_TPU_CAPACITY_ROUND", 128)

@@ -144,6 +144,101 @@ def matmul_groupby_sum(codes, vals, n_slots: int, n_cols: int,
     return out[:n_cols, :n_slots].T                   # [n_slots, n_cols]
 
 
+# one-hot gathers are exact in f32 only while the gathered values fit
+# the 24-bit mantissa; callers carry row indices, so this bounds nrows
+MAX_GATHER_VALUE = 1 << 24
+
+
+# shardcheck: ignore[unregistered-jit]
+@functools.partial(jax.jit, static_argnames=("n_slots", "interpret"))
+def _matmul_gather_kernel(codes, lut, n_slots: int,
+                          interpret: bool = False):
+    """lut[codes] by one-hot MXU contraction: a [BLK, K] one-hot of the
+    slot codes contracted against the f32 LUT column. codes: int32 [N]
+    in [0, n_slots); lut: int32 [n_slots] with values in
+    (-MAX_GATHER_VALUE, MAX_GATHER_VALUE) so the f32 pass is exact.
+    Returns int32 [N]."""
+    from jax.experimental import pallas as pl
+
+    n = codes.shape[0]
+    k_pad = _round_up(max(n_slots, 128), 128)
+    n_pad = _round_up(max(n, _BLK), _BLK)
+    if n_pad != n:
+        codes = jnp.concatenate(
+            [codes, jnp.zeros((n_pad - n,), codes.dtype)])
+    lutf = jnp.zeros((k_pad, 1), jnp.float32).at[:n_slots, 0].set(
+        lut.astype(jnp.float32))
+    codes2 = codes[:, None]                           # 2-D, see above
+
+    def kernel(codes_ref, lut_ref, out_ref):
+        codes_blk = codes_ref[:]                      # [BLK, 1]
+        onehot = (codes_blk ==
+                  jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+                  ).astype(jnp.float32)               # [BLK, K]
+        # [BLK, K] @ [K, 1] -> [BLK, 1]: exactly one lut row per code,
+        # so the f32 contraction reproduces the int32 value exactly
+        out_ref[:] = jax.lax.dot_general(
+            onehot, lut_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    # shardcheck: ignore[unregistered-jit]
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLK,),
+        in_specs=[
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((k_pad, 1), lambda i: (_I0, _I0)),
+        ],
+        out_specs=pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        interpret=interpret,
+    )(codes2, lutf)
+    return out[:n, 0].astype(jnp.int32)
+
+
+def matmul_gather(codes, lut, interpret: Optional[bool] = None):
+    """Gather ``lut[codes]`` (the dense-LUT hash-probe lookup step).
+
+    TPU (or interpret=True) with a LUT small enough for the one-hot
+    MXU pass: the pallas kernel above. Elsewhere: the plain XLA gather.
+    Callers must keep lut values within (-MAX_GATHER_VALUE,
+    MAX_GATHER_VALUE) — they are row indices plus the -1 empty marker,
+    so this caps the build side at 16M rows (checked by the caller's
+    gate, not here)."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    if (use_pallas() or interp) and lut.shape[0] <= MAX_MATMUL_SLOTS:
+        global trace_count
+        trace_count += 1
+        return _matmul_gather_kernel(codes, lut, lut.shape[0],
+                                     interpret=interp)
+    return lut[codes]
+
+
+def bucket_counts(dest, ok, num_buckets: int,
+                  interpret: Optional[bool] = None):
+    """Per-destination row histogram (the bucket-partition counting
+    step of the fixed-capacity shuffle): count rows with ok set per
+    dest shard. On TPU the scatter-add that XLA lowers segment_sum to
+    serializes on the VPU, so this routes through the same one-hot MXU
+    accumulate as the dense groupby. Exact while the per-bucket count
+    stays under MAX_GATHER_VALUE (f32 mantissa), which the row-count
+    gate guarantees. Returns int32 [num_buckets]."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    if ((use_pallas() or interp) and num_buckets <= MAX_MATMUL_SLOTS
+            and dest.shape[0] < MAX_GATHER_VALUE):
+        global trace_count
+        trace_count += 1
+        vals = ok.astype(jnp.float32)[:, None]
+        sums = matmul_groupby_sum(dest.astype(jnp.int32), vals,
+                                  num_buckets, 1, interpret=interp)
+        return sums[:, 0].astype(jnp.int32)
+    return jax.ops.segment_sum(ok.astype(jnp.int32),
+                               dest.astype(jnp.int32),
+                               num_segments=num_buckets)
+
+
 def dense_accumulate(codes, cols: Sequence, ok_masks: Sequence,
                      n_slots: int, interpret: Optional[bool] = None):
     """Sum each (column, mask) pair into dense slots.

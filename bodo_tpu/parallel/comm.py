@@ -107,6 +107,41 @@ def record(op: str, *, site: Optional[str] = None, bytes_in: int = 0,
         pass
 
 
+def record_in_program(group_fp: str, *, bytes_in: int = 0,
+                      bytes_out: int = 0, wall_s: float = 0.0,
+                      wait_s: float = 0.0) -> int:
+    """Attribute the in-program collectives of one fused-group dispatch.
+
+    Collectives traced INSIDE a compiled fusion body (all_to_all /
+    psum inside a shard_map program) never hit the per-op dispatch
+    hooks, so the usual ``record`` call sites cannot see them. The
+    fused dispatcher calls this instead: the group's lockstep manifest
+    (``register_fusion_manifest(..., in_program=...)``) declares which
+    collective ops the program subsumes, and one accounting row per
+    declared op is recorded at site ``fused[<fp>]`` — so ``doctor`` and
+    the bench comm suite still see an all_to_all row for a shuffle that
+    now lives inside a compiled stage. Group wall/wait is attributed to
+    the FIRST declared op (the program is one dispatch; splitting the
+    wall across members would double-count). Returns the number of
+    in-program collectives attributed (0 when the manifest declares
+    none or does not exist)."""
+    if not config.comm_accounting:
+        return 0
+    from bodo_tpu.analysis import lockstep
+    m = lockstep.fusion_manifest(group_fp)
+    ops = tuple(m.get("in_program", ())) if m else ()
+    if not ops:
+        return 0
+    site = f"fused[{group_fp}]"
+    for i, op in enumerate(ops):
+        record(op, site=site,
+               bytes_in=int(bytes_in) if i == 0 else 0,
+               bytes_out=int(bytes_out) if i == 0 else 0,
+               wall_s=float(wall_s) if i == 0 else 0.0,
+               wait_s=float(wait_s) if i == 0 else 0.0)
+    return len(ops)
+
+
 @contextlib.contextmanager
 def collective_span(op: str, *, bytes_in: int = 0, wait_s: float = 0.0,
                     site: Optional[str] = None):

@@ -29,6 +29,7 @@ from bodo_tpu.ops.groupby import (COMBINE_OF, DECOMPOSE, HASH_OPS,
                                   groupby_local_hashed_static,
                                   result_dtype)
 from bodo_tpu.ops.hashing import dest_shard, hash_columns
+from bodo_tpu.ops import pallas_kernels as PK
 from bodo_tpu.parallel import collectives as C
 from bodo_tpu.parallel import mesh as mesh_mod
 from bodo_tpu.plan.fusion import fusion_stage
@@ -70,9 +71,12 @@ def bucket_rows(dest, arrays: Sequence, count, num_shards: int,
             continue
         z = jnp.zeros((num_shards * bucket_cap,) + a.shape[1:], dtype=a.dtype)
         packed.append(z.at[scatter_idx].set(a[perm], mode="drop"))
-    send_counts = jax.ops.segment_sum(
-        padmask.astype(jnp.int64), jnp.minimum(d, num_shards),
-        num_segments=num_shards + 1)[:num_shards]
+    # bucket-partition counting: Pallas one-hot MXU histogram when the
+    # kernel gate is open (XLA lowers the segment_sum to a scatter-add
+    # that serializes on the VPU); plain segment_sum elsewhere
+    send_counts = PK.bucket_counts(
+        jnp.minimum(d, num_shards), padmask,
+        num_shards + 1)[:num_shards].astype(jnp.int64)
     send_counts = jnp.minimum(send_counts, bucket_cap)
     return packed, send_counts, overflow
 

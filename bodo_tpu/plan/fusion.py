@@ -79,6 +79,14 @@ errors propagate so `physical._exec_with_oom_retry` and
 stage; under a degraded (force-replicated) re-run the group gathers
 its 1D input and re-dispatches the REP program.
 
+Join-probe and shuffle boundaries fuse too: `plan_fusion_groups`
+tries `plan/fusion_join.try_join_group` first, so a
+[chain -> Join -> chain -> agg] region compiles into one program with
+the hash-probe (against a device-resident cached build table) and —
+for 1D probes with a terminal decomposable aggregate — the
+partial-agg hash shuffle (`lax.all_to_all`) traced INSIDE the
+shard_map body. See plan/fusion_join.py.
+
 Disable with `BODO_TPU_FUSION=0` / `set_config(fusion=False)`; the
 process-wide compile budget (`BODO_TPU_FUSION_MAX_COMPILES`) bounds
 how many distinct programs one process may pin before new signatures
@@ -344,7 +352,16 @@ def plan_fusion_groups(root: L.Node) -> List[FusionGroup]:
     for n in nodes:  # roots precede their descendants (DFS preorder)
         if id(n) in claimed:
             continue
-        g = _try_group(n, parents)
+        g = None
+        if config.fusion_join:
+            # join groups first: a [chain -> Join -> chain -> agg]
+            # region fuses across the join-probe boundary
+            # (plan/fusion_join.py); the plain chain grouper below
+            # would otherwise claim the above-join chain for itself
+            from bodo_tpu.plan import fusion_join
+            g = fusion_join.try_join_group(n, parents, claimed)
+        if g is None:
+            g = _try_group(n, parents)
         if g is None:
             continue
         for m in g.members:
@@ -440,7 +457,17 @@ def _chain_meta(t: Table, steps):
     schema = {n: c.dtype for n, c in t.columns.items()}
     dicts = {n: c.dictionary for n, c in t.columns.items()
              if c.dictionary is not None}
-    compose: Dict[str, E.Expr] = {n: E.ColRef(n) for n in t.names}
+    return _chain_meta_from(schema, dicts, steps)
+
+
+def _chain_meta_from(schema, dicts, steps):
+    """Schema-level `_chain_meta`: the fused-join planner
+    (plan/fusion_join.py) walks the ABOVE-join chain over the JOINED
+    schema, which exists only as names/dtypes/dictionaries at plan time
+    — there is no host Table to hand to `_chain_meta`."""
+    schema = dict(schema)
+    dicts = dict(dicts)
+    compose: Dict[str, E.Expr] = {n: E.ColRef(n) for n in schema}
     meta = []
     for s in steps:
         if isinstance(s, L.Filter):
@@ -501,6 +528,17 @@ def _chain_body(meta, in_names, tree, count):
     (zero compactions)."""
     cap = tree[in_names[0]][0].shape[0]
     mask = K.row_mask(count, cap)
+    return _chain_body_masked(meta, tree, mask)
+
+
+@fusion_stage
+def _chain_body_masked(meta, tree, mask):
+    """`_chain_body` with a caller-supplied initial mask: fused-join
+    programs thread the probe side's live-row mask (already ANDed with
+    the join hit mask for inner joins) into the above-join chain, so
+    the whole below-chain -> probe -> above-chain region shares ONE
+    lazy (tree, mask) carry and at most one compaction."""
+    cap = mask.shape[0]
     cur = dict(tree)
     for kind, payload, schema, dicts in meta:
         if kind == "filter":

@@ -330,7 +330,11 @@ def _exec_inner(node: L.Node) -> Table:
     group = getattr(node, "_fusion_group", None)
     if group is not None:
         from bodo_tpu.plan import fusion
-        out = fusion.execute_group(group, _exec)
+        if isinstance(group, fusion.FusionGroup):
+            out = fusion.execute_group(group, _exec)
+        else:
+            from bodo_tpu.plan import fusion_join
+            out = fusion_join.execute_join_group(group, _exec)
         if out is not None:
             return out
     if isinstance(node, L.ReadParquet):

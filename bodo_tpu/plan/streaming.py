@@ -729,6 +729,12 @@ class StreamJoin:
             self._build = self._off.restore()
             self._comp.unregister(self._op)
             self._grant.release()
+            # warm the device-resident build table once on restore so
+            # every probe batch (including the first) skips the build
+            # and its host dup-check sync (plan/fusion_join LRU)
+            from bodo_tpu.plan import fusion_join
+            fusion_join.prime_build(self._build, self.right_on,
+                                    self.null_equal)
         out = R.join_tables(batch, self._build, self.left_on, self.right_on,
                             self.how, self.suffixes,
                             null_equal=self.null_equal)

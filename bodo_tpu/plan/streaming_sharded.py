@@ -516,6 +516,11 @@ class ShardedStreamJoin:
         self.how, self.suffixes = how, suffixes
         self.null_equal = null_equal
         self.build = build.gather() if build.distribution != REP else build
+        # warm the device-resident build table at construction: every
+        # probe batch then hits the LRU entry (plan/fusion_join) instead
+        # of rebuilding the claim table per batch
+        from bodo_tpu.plan import fusion_join
+        fusion_join.prime_build(self.build, self.right_on, self.null_equal)
 
     def __call__(self, batch: Table) -> Table:
         out = R.join_tables(batch, self.build, self.left_on, self.right_on,

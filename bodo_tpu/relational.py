@@ -1397,8 +1397,15 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes,
     if bool(jax.device_get(dup)):
         return None  # duplicate build keys: not a perfect hash
 
+    # the LUT gather is the probe's hot lookup: small LUTs route through
+    # the Pallas one-hot MXU gather (values are row indices, bounded by
+    # MAX_GATHER_VALUE so the f32 contraction is exact)
+    from bodo_tpu.ops import pallas_kernels as PK
+    use_gather = ((PK.use_pallas() or PK.FORCE_INTERPRET)
+                  and n_slots <= PK.MAX_MATMUL_SLOTS
+                  and right.capacity < PK.MAX_GATHER_VALUE)
     pkey = ("densejoin_probe", _sig(left.select(lorder)),
-            _sig(right.select(rorder)), sizes, los, nk, how)
+            _sig(right.select(rorder)), sizes, los, nk, how, use_gather)
     pfn = _jit_cache.get(pkey)
     if pfn is None:
         def pbody(p_arrays, b_arrays, lut, pcount):
@@ -1406,7 +1413,8 @@ def _join_dense_try(left, right, left_on, right_on, how, suffixes,
             slot, live = _dense_slots(p_arrays[:nk], los, sizes,
                                       K.row_mask(pcount, cap),
                                       strict_range=True)
-            idx = jnp.where(live, lut[slot], -1)
+            g = PK.matmul_gather(slot, lut) if use_gather else lut[slot]
+            idx = jnp.where(live, g, -1)
             hit = idx >= 0
             safe = jnp.maximum(idx, 0)
             out_b = []
@@ -1454,36 +1462,44 @@ def _join_hash_try(left, right, left_on, right_on, how, suffixes,
                                                  right_on)
     nk = len(left_on)
     T = HT.table_size(right.capacity)
-    # per-key null-column layout must match across both sides' encodings
-    # (one side nullable, the other not, is the normal case)
-    def _nullable(c):
-        return c.valid is not None or             np.issubdtype(np.dtype(c.dtype.numpy), np.floating)
-    null_cols = tuple(_nullable(left.column(lk)) or _nullable(right.column(rk))
-                      for lk, rk in zip(left_on, right_on))
+    # probe-independent null-column layout: an all-True layout is always
+    # legal (encode_columns_aligned zero-fills the null code column when
+    # a side can't produce nulls), and making the layout independent of
+    # the probe side lets this per-node path share the device-resident
+    # build cache with fused join groups and streaming probes
+    null_cols = (True,) * nk
 
-    bkey = ("hashjoin_build", _sig(right.select(rorder)), nk, null_equal, T,
-            null_cols)
-    bfn = _jit_cache.get(bkey)
-    if bfn is None:
-        def bbody(arrays, count):
-            cap = arrays[0][0].shape[0]
-            codes, null_ok = HT.encode_columns_aligned(
-                arrays[:nk], null_cols, null_equal)
-            ok = K.row_mask(count, cap)
-            if null_ok is not None:
-                ok = ok & null_ok
-            slot, owner, _r, unresolved = HT.claim_slots(codes, ok, T)
-            cnt = jnp.zeros(T, jnp.int32).at[
-                jnp.where(slot >= 0, slot, T)].add(1, mode="drop")
-            dup = jnp.any(cnt > 1)
-            return codes, owner, dup | unresolved
+    if config.fusion_join:
+        from bodo_tpu.plan import fusion_join
+        built = fusion_join.build_hash_table(right, right_on, null_cols,
+                                             null_equal)
+        if built is None:
+            return None  # duplicate build keys (or pathological probing)
+        bcodes, owner = built
+    else:
+        bkey = ("hashjoin_build", _sig(right.select(rorder)), nk,
+                null_equal, T, null_cols)
+        bfn = _jit_cache.get(bkey)
+        if bfn is None:
+            def bbody(arrays, count):
+                cap = arrays[0][0].shape[0]
+                codes, null_ok = HT.encode_columns_aligned(
+                    arrays[:nk], null_cols, null_equal)
+                ok = K.row_mask(count, cap)
+                if null_ok is not None:
+                    ok = ok & null_ok
+                slot, owner, _r, unresolved = HT.claim_slots(codes, ok, T)
+                cnt = jnp.zeros(T, jnp.int32).at[
+                    jnp.where(slot >= 0, slot, T)].add(1, mode="drop")
+                dup = jnp.any(cnt > 1)
+                return codes, owner, dup | unresolved
 
-        bfn = jax.jit(bbody)
-        _jit_cache[bkey] = bfn
+            bfn = jax.jit(bbody)
+            _jit_cache[bkey] = bfn
 
-    bcodes, owner, bad = bfn(ba, jnp.asarray(right.nrows))
-    if bool(jax.device_get(bad)):
-        return None  # duplicate build keys (or pathological probing)
+        bcodes, owner, bad = bfn(ba, jnp.asarray(right.nrows))
+        if bool(jax.device_get(bad)):
+            return None  # duplicate build keys (or pathological probing)
 
     pkey = ("hashjoin_probe", _sig(left.select(lorder)),
             _sig(right.select(rorder)), nk, null_equal, T, how, null_cols)

@@ -50,11 +50,13 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # gang, so it must not interleave with modules asserting on the same
 # globals. test_comm_observatory.py arms comm accounting / lockstep /
 # the telemetry server and spawns a latency-fault gang, for the same
-# reason.
+# reason. test_fused_join.py compiles a wide set of fused join/shuffle
+# programs and asserts on process-wide lockstep manifests, comm sites
+# and the build cache, so it runs alone like test_fusion.py.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
              "test_telemetry.py", "test_device_decode.py",
-             "test_comm_observatory.py")
+             "test_comm_observatory.py", "test_fused_join.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

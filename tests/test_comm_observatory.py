@@ -423,6 +423,46 @@ class TestBenchwatch:
         assert out2["regressions"] == ["speedup"]
         assert not out2["ok"]
 
+    def test_embedded_suite_metrics_are_tracked(self, tmp_path):
+        from bodo_tpu import benchwatch
+        d = str(tmp_path / "ts")
+        _write_traj(d, [2.0, 2.1])
+        # round 3 embeds per-suite summaries under parsed.detail.suites;
+        # each becomes its own tracked series alongside the headline
+        rec = _bench_rec(3, 2.2)
+        rec["parsed"]["detail"]["suites"] = {
+            "join": {"metric": "join_mrows_per_s", "value": 1.1,
+                     "unit": "Mrows/s", "detail": {}},
+            "fusion": {"metric": "fusion_speedup_ratio", "value": 0.7,
+                       "unit": "frac"},
+            "broken": {"no": "summary keys"},  # skipped, not fatal
+        }
+        with open(os.path.join(d, "BENCH_r03.json"), "w") as f:
+            json.dump(rec, f)
+        out = benchwatch.watch(d, threshold=0.15)
+        assert out["ok"]
+        assert out["metrics"]["join_mrows_per_s"]["status"] == "new"
+        assert out["metrics"]["fusion_speedup_ratio"]["status"] == "new"
+        assert all("broken" not in m for m in out["metrics"])
+        # a later round regressing an embedded metric fails the watch
+        # (Mrows/s is higher-better: 0.5 vs best 1.1 regresses) ...
+        rec4 = _bench_rec(4, 2.2)
+        rec4["parsed"]["detail"]["suites"] = {
+            "join": {"metric": "join_mrows_per_s", "value": 0.5,
+                     "unit": "Mrows/s"}}
+        with open(os.path.join(d, "BENCH_r04.json"), "w") as f:
+            json.dump(rec4, f)
+        out2 = benchwatch.watch(d, threshold=0.15)
+        assert out2["regressions"] == ["join_mrows_per_s"]
+        assert not out2["ok"]
+        # ... and the round's waiver covers its embedded metrics too
+        rec4["waiver"] = "degraded box: control run also slow"
+        with open(os.path.join(d, "BENCH_r04.json"), "w") as f:
+            json.dump(rec4, f)
+        out3 = benchwatch.watch(d, threshold=0.15)
+        assert out3["ok"]
+        assert out3["metrics"]["join_mrows_per_s"]["status"] == "waived"
+
     def test_schema_violations_fail_loudly(self, tmp_path):
         from bodo_tpu import benchwatch
         d = str(tmp_path / "t6")

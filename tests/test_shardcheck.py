@@ -428,6 +428,62 @@ class TestLint:
         """)
         assert got == []
 
+    def test_rank_divergent_rng_seed(self, tmp_path):
+        # seeding an RNG from rank identity silently diverges
+        # replicated state across the gang
+        got = _lint_src(tmp_path, """
+            import os
+            import numpy as np
+            import jax
+
+            def f(rank):
+                rng = np.random.default_rng(rank)
+                key = jax.random.PRNGKey(jax.process_index())
+                np.random.seed(int(os.environ["BODO_TPU_PROC_ID"]))
+                return rng, key
+        """)
+        assert sorted(f.rule for f in got) == \
+            ["rank-divergent-rng-seed"] * 3
+        assert all(f.func == "f" for f in got)
+
+    def test_rank_invariant_seed_ok(self, tmp_path):
+        # the sanctioned pattern: rank-invariant seed, explicit fold
+        got = _lint_src(tmp_path, """
+            import numpy as np
+            import jax
+
+            def f(seed, rank):
+                rng = np.random.default_rng(seed)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), rank)
+                return rng, key
+        """)
+        assert got == []
+
+    def test_divergent_host_sync(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            import jax
+
+            def f(x, rank):
+                if rank == 0:
+                    return jax.device_get(x)
+                x.block_until_ready()
+                return None
+        """)
+        assert [f.rule for f in got] == ["divergent-host-sync"]
+        assert got[0].func == "f"
+
+    def test_host_sync_outside_divergence_ok(self, tmp_path):
+        # data-dependent control flow is every rank's same decision
+        got = _lint_src(tmp_path, """
+            import jax
+
+            def f(x, n):
+                if n > 0:
+                    return jax.device_get(x)
+                return None
+        """)
+        assert got == []
+
     def test_baseline_roundtrip(self, tmp_path, monkeypatch, capsys):
         mod = tmp_path / "legacy.py"
         mod.write_text(textwrap.dedent("""

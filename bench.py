@@ -27,6 +27,12 @@ Suites:
     proof that the Pallas matmul_gather kernel sits in the dense-join
     probe body.
 
+  --suite serve: semantic result cache under repeat traffic — 90%
+    repeat / 10% novel request mix with ~1% appends between rounds;
+    headline is the repeat speedup over the cold wall (bar >= 20x),
+    with hit rate, repeat p50 and the incremental-refresh ratio after
+    an append (bar <= 0.10) as independently-watched series.
+
 Any suite accepts --compare to run the benchwatch trajectory check
 (python -m bodo_tpu.benchwatch) over the repo's BENCH_r*.json after
 the run.
@@ -1493,6 +1499,195 @@ def bench_join(args, n_rows: int):
     return 0
 
 
+def bench_serve(args, n_rows: int):
+    """--suite serve: semantic result cache under repeat traffic
+    (runtime/result_cache.py). A dashboard-shaped request mix — 90%
+    repeats of three fixed query templates (groupby sum/mean/count,
+    filter+groupby, whole-column reduce; each request rebuilds its plan
+    from scratch, so hits are purely semantic) and 10% novel one-off
+    filters — runs against a multi-file parquet dataset that gains a
+    ~1% append between rounds. The headline is the repeat speedup: p50
+    of the templates' cold (first-execution) walls over p50 of every
+    later repeat request (acceptance bar >= 20x on CPU). detail.suites
+    carries three independently-watched series: the served hit rate
+    (hitrate, regresses down), repeat p50 (s, regresses up), and the
+    incremental-refresh ratio (frac, regresses up) — the wall to
+    refresh a cached groupby after a fresh 1% append vs the
+    cleared-cache full recompute of the same plan (bar <= 0.10), with
+    the refreshed frame asserted bit-identical to the recompute."""
+    import shutil
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    import bodo_tpu
+    from bodo_tpu import pandas_api as bpd
+    from bodo_tpu.plan.physical import _result_cache
+    from bodo_tpu.runtime import result_cache as rcache
+
+    devs = jax.devices()[:args.mesh]
+    args.mesh = len(devs)
+    bodo_tpu.set_mesh(bodo_tpu.make_mesh(devs))
+
+    data_dir = os.path.join(_REPO, ".bench_data", f"serve_{n_rows}")
+    shutil.rmtree(data_dir, ignore_errors=True)
+    os.makedirs(data_dir)
+    n_parts = 8
+    rng = np.random.default_rng(7)
+    part_idx = 0
+
+    def write_part(n: int) -> None:
+        nonlocal part_idx
+        pd.DataFrame({
+            "k": rng.integers(0, 64, n).astype(np.int64),
+            "v": rng.integers(0, 1_000_000, n).astype(np.int64),
+            "w": rng.integers(0, 1000, n).astype(np.int64),
+        }).to_parquet(os.path.join(data_dir,
+                                   f"part-{part_idx:05d}.parquet"))
+        part_idx += 1
+
+    for _ in range(n_parts):
+        write_part(max(1000, n_rows // n_parts))
+    append_rows = max(200, n_rows // 100)  # the ~1% delta per append
+
+    # the repeat templates a dashboard would re-issue verbatim; every
+    # call builds a FRESH plan over the directory so a hit proves the
+    # semantic (fingerprint+signature) key, not object identity
+    def t_groupby():
+        df = bpd.read_parquet(data_dir)
+        return df.groupby("k", as_index=False).agg(
+            s=("v", "sum"), m=("v", "mean"),
+            c=("v", "count")).to_pandas()
+
+    def t_filter():
+        df = bpd.read_parquet(data_dir)
+        return df[df["w"] < 500].groupby("k", as_index=False).agg(
+            s=("v", "sum"), mx=("v", "max")).to_pandas()
+
+    def t_reduce():
+        df = bpd.read_parquet(data_dir)
+        return float(df["v"].sum())
+
+    templates = [t_groupby, t_filter, t_reduce]
+
+    def novel(i: int):
+        # a distinct filter constant per request -> distinct plan
+        # fingerprint: guaranteed cache miss, full execution
+        df = bpd.read_parquet(data_dir)
+        return df[df["w"] % 997 == (i * 131) % 997].groupby(
+            "k", as_index=False).agg(s=("v", "sum")).to_pandas()
+
+    _result_cache.clear()
+    rcache.reset_stats()
+
+    cold = []
+    for fn in templates:
+        t0 = time.perf_counter()
+        fn()
+        cold.append(time.perf_counter() - t0)
+    cold_p50 = sorted(cold)[len(cold) // 2]
+    rcache.reset_stats()  # hit rate covers the serve mix, not warm-up
+
+    rounds = 2 if args.quick else 3
+    per_round = 20 if args.quick else 40
+    repeat_lat, novel_lat = [], []
+    novel_i = 0
+    for r in range(rounds):
+        if r:
+            write_part(append_rows)
+        for j in range(per_round):
+            t0 = time.perf_counter()
+            if j % 10 == 9:
+                novel(novel_i)
+                novel_i += 1
+                novel_lat.append(time.perf_counter() - t0)
+            else:
+                templates[j % len(templates)]()
+                repeat_lat.append(time.perf_counter() - t0)
+    st = rcache.stats()
+    served = st["q_hits"] + st["q_misses"]
+    hit_rate = st["q_hits"] / served if served else 0.0
+    repeat_p50 = sorted(repeat_lat)[len(repeat_lat) // 2]
+    speedup = cold_p50 / repeat_p50 if repeat_p50 > 0 else 0.0
+
+    # incremental-refresh ratio on a fresh append: the cached groupby
+    # splices the delta scan; the cleared-cache run re-reads everything
+    write_part(append_rows)
+    incr_before = rcache.stats()["q_incremental"]
+    t0 = time.perf_counter()
+    incr_df = t_groupby()
+    incr_s = time.perf_counter() - t0
+    refreshed_incrementally = \
+        rcache.stats()["q_incremental"] > incr_before
+    _result_cache.clear()
+    t0 = time.perf_counter()
+    full_df = t_groupby()
+    full_s = time.perf_counter() - t0
+    ratio = incr_s / full_s if full_s > 0 else 1.0
+    # integer-valued data: the spliced aggregate must be bit-identical
+    # to the full recompute (row order may differ across merge paths)
+    pd.testing.assert_frame_equal(
+        incr_df.sort_values("k").reset_index(drop=True),
+        full_df.sort_values("k").reset_index(drop=True),
+        check_exact=True)
+
+    st = rcache.stats()
+    detail = {
+        "rows": n_rows, "parts_written": part_idx,
+        "append_rows": append_rows, "rounds": rounds,
+        "requests": rounds * per_round,
+        "n_devices": args.mesh, "platform": devs[0].platform,
+        "cold_p50_s": round(cold_p50, 4),
+        "repeat_p50_s": round(repeat_p50, 5),
+        "novel_p50_s": round(
+            sorted(novel_lat)[len(novel_lat) // 2], 4)
+        if novel_lat else None,
+        "repeat_speedup": round(speedup, 2),
+        "hit_rate": round(hit_rate, 4),
+        "incremental_refresh_s": round(incr_s, 4),
+        "full_recompute_s": round(full_s, 4),
+        "incremental_ratio": round(ratio, 4),
+        "refreshed_incrementally": bool(refreshed_incrementally),
+        "refresh_bit_identical": True,
+        "cache": {k: st[k] for k in
+                  ("q_hits", "q_misses", "q_incremental",
+                   "invalidations", "incremental_fallbacks",
+                   "evictions", "spills", "entries", "device_bytes",
+                   "host_bytes", "budget_bytes")},
+        "saved_wall_s": round(st["saved_wall_s"], 3),
+        "probe": getattr(args, "probe", {"attempted": False}),
+        # independently-watched series (benchwatch lifts these into
+        # their own direction-aware trajectories)
+        "suites": {
+            "serve_hit_rate": {
+                "metric": "serve_hit_rate",
+                "value": round(hit_rate, 4), "unit": "hitrate"},
+            "serve_repeat_p50": {
+                "metric": "serve_repeat_p50_s",
+                "value": round(repeat_p50, 5), "unit": "s"},
+            "serve_incremental_ratio": {
+                "metric": "serve_incremental_ratio",
+                "value": round(ratio, 4), "unit": "frac"},
+        },
+    }
+    print(f"serve: cold p50 {cold_p50:.4f}s repeat p50 "
+          f"{repeat_p50:.5f}s speedup {speedup:.1f}x hit rate "
+          f"{hit_rate:.2f} ({st['q_hits']}/{served}); refresh after "
+          f"1% append {incr_s:.4f}s vs full {full_s:.4f}s "
+          f"(ratio {ratio:.3f}, incremental="
+          f"{refreshed_incrementally})", file=sys.stderr)
+    print(json.dumps({
+        "metric": "serve_repeat_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # normalized against the acceptance bar (>= 20x repeat speedup)
+        "vs_baseline": round(speedup / 20.0, 4),
+        "detail": detail,
+    }))
+    return 0
+
+
 def _gang_taxi_worker(pq: str, csv: str):
     """Worker fn for the --explain gang: each rank runs the plan-based
     taxi pipeline on its LOCAL mesh (the CPU backend cannot execute
@@ -1597,7 +1792,7 @@ def main():
     ap.add_argument("--suite",
                     choices=["taxi", "tpch", "scan", "lockstep",
                              "trace", "fusion", "telemetry", "comm",
-                             "compile", "join"],
+                             "compile", "join", "serve"],
                     default="taxi")
     ap.add_argument("--compare", action="store_true",
                     help="after the suite, run the benchwatch "
@@ -1641,6 +1836,8 @@ def main():
         args.rows = 500_000  # registry/ledger cost, not scan cost
     if args.suite == "join" and args.rows is None and not args.quick:
         args.rows = 2_000_000  # probe-side rows; join cost, not scan cost
+    if args.suite == "serve" and args.rows is None and not args.quick:
+        args.rows = 2_000_000  # repeat wins show against a real cold scan
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -1713,6 +1910,8 @@ def main():
         return _finish(args, bench_compile(args, n_rows))
     if args.suite == "join":
         return _finish(args, bench_join(args, n_rows))
+    if args.suite == "serve":
+        return _finish(args, bench_serve(args, n_rows))
 
     import pandas as pd  # noqa: F401
 

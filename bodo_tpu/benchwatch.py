@@ -38,7 +38,8 @@ from typing import Dict, List, Optional
 _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 # direction-aware threshold semantics keyed by the metric's unit
-_HIGHER_BETTER = {"x", "mb/s", "gb/s", "mrows/s", "rows/s", "qps"}
+_HIGHER_BETTER = {"x", "mb/s", "gb/s", "mrows/s", "rows/s", "qps",
+                  "hitrate"}
 _LOWER_BETTER = {"s", "ms", "us", "frac", "%", "ratio"}
 
 _ENVELOPE_KEYS = ("n", "cmd", "rc", "parsed")

@@ -340,6 +340,30 @@ class Config:
     sql_plan_cache_dir: str = field(
         default_factory=lambda: _env_str("BODO_TPU_SQL_PLAN_CACHE_DIR", "")
     )
+    # -- semantic result cache (runtime/result_cache.py) ---------------------
+    # Cache executed results keyed by (structural plan fingerprint,
+    # dataset signature) and maintain them incrementally under
+    # append-only dataset growth. Off -> no cross-query result reuse at
+    # all (per-plan node memoization still applies).
+    result_cache: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_RESULT_CACHE", True)
+    )
+    # Device-byte budget for cached results. 0 = auto: a fraction of
+    # the memory governor's derived device budget (floor 64 MiB).
+    result_cache_bytes: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_RESULT_CACHE_BYTES", 0)
+    )
+    # Host-side spill tier: entries evicted under device pressure move
+    # to host pandas instead of being dropped, and rehydrate on hit.
+    result_cache_host_spill: bool = field(
+        default_factory=lambda: _env_bool(
+            "BODO_TPU_RESULT_CACHE_HOST_SPILL", True)
+    )
+    # Byte cap of the host spill tier (0 disables the tier outright).
+    result_cache_host_bytes: int = field(
+        default_factory=lambda: _env_int(
+            "BODO_TPU_RESULT_CACHE_HOST_BYTES", 1 << 28)
+    )
     # -- resilience (runtime/resilience.py) ----------------------------------
     # Armed fault-injection spec (see resilience module docstring for the
     # grammar, e.g. "io.read=raise:OSError,collective=raise:Internal:1:0").
@@ -455,6 +479,14 @@ def set_config(**kwargs) -> None:
             # the new width
             from bodo_tpu.runtime import io_pool
             io_pool.reset_pool()
+        if k in ("result_cache", "result_cache_bytes",
+                 "result_cache_host_spill", "result_cache_host_bytes"):
+            # re-apply budgets to a live cache (lazy: never imports the
+            # module just to reconfigure it); disabling drops entries
+            import sys as _sys
+            rc = _sys.modules.get("bodo_tpu.runtime.result_cache")
+            if rc is not None:
+                rc.reconfigure()
         if k == "stats_store_dir":
             # flush + drop the open store so the next lookup re-binds to
             # the new directory

@@ -172,6 +172,30 @@ def dataset_signature(path):
     return tuple(file_signature(f) for f in _dataset_files(path))
 
 
+def classify_change(old_sigs, new_sigs):
+    """Classify the delta between two ``dataset_signature()`` results:
+
+        ("same", ())       — byte-identical signatures
+        ("append", files)  — every old file's (path, mtime, size) is
+                             unchanged, only new files appeared; `files`
+                             are the added paths in the NEW scan order
+        ("mutate", ())     — anything else (rewrite, delete, touch)
+
+    Drives the result cache's incremental append maintenance
+    (runtime/result_cache.py): "append" means the cached result is still
+    a correct partial and only the delta files need scanning."""
+    old_by = {s[0]: s for s in old_sigs}
+    for s in new_sigs:
+        prev = old_by.get(s[0])
+        if prev is not None and prev != s:
+            return ("mutate", ())
+    new_paths = {s[0] for s in new_sigs}
+    if any(p not in new_paths for p in old_by):
+        return ("mutate", ())
+    added = tuple(s[0] for s in new_sigs if s[0] not in old_by)
+    return ("append", added) if added else ("same", ())
+
+
 def dataset_nbytes(path) -> int:
     """Total on-disk bytes of a dataset (0 when unknown) — sizes the
     read_parquet admission reservation in plan/physical.py."""

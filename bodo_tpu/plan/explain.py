@@ -88,7 +88,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
            mem_peak: Optional[int] = None,
            fusion: Optional[dict] = None,
            comm: Optional[dict] = None,
-           xla: Optional[dict] = None) -> None:
+           xla: Optional[dict] = None,
+           rcache: Optional[dict] = None) -> None:
     """One node observation for the current query. Wall seconds are
     INCLUSIVE of the node's children (the executor recurses inside the
     node's span), matching Postgres' actual-time convention. A repeat
@@ -125,6 +126,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
                        for k, v in comm.items()}
     if xla:
         rec["xla"] = dict(xla)
+    if rcache:
+        rec["rcache"] = dict(rcache)
     if getattr(node, "_explain_replanned", False):
         rec["replanned"] = True
     with _lock:
@@ -143,6 +146,8 @@ def record(node: L.Node, *, rows: int, wall_s: float,
                 prev["fusion"] = dict(fusion)
             if xla and "xla" not in prev:
                 prev["xla"] = dict(xla)
+            if rcache and "rcache" not in prev:
+                prev["rcache"] = dict(rcache)
             return
         if prev is not None:
             rec["hits"] = prev["hits"] + 1
@@ -317,6 +322,14 @@ def _annotate(rec: Optional[dict]) -> str:
         if db:
             sign = "+" if db > 0 else "-"
             parts.append(f"dev={sign}{_fmt_bytes(abs(int(db)))}")
+    rc = rec.get("rcache")
+    if rc:
+        bits = [rc.get("event", "hit")]
+        if rc.get("delta_files"):
+            bits.append(f"delta_files={rc['delta_files']}")
+        if rc.get("saved_s"):
+            bits.append(f"saved={rc['saved_s']:.3f}s")
+        parts.append(f"result_cache[{', '.join(bits)}]")
     if rec.get("replanned"):
         parts.append("replanned")
     if rec.get("cached"):

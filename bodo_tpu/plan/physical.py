@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import threading
 import time as _time
-from typing import Dict
 
 import numpy as np
 
@@ -20,13 +19,16 @@ from bodo_tpu.config import config
 from bodo_tpu.parallel import mesh as mesh_mod
 from bodo_tpu.plan import logical as L
 from bodo_tpu.plan.optimizer import optimize
-from bodo_tpu.runtime import resilience
+from bodo_tpu.runtime import resilience, result_cache as _rcache
 from bodo_tpu.table.table import ONED, REP, Table
 from bodo_tpu.utils.logging import log
 
-# session-level result cache: plan key -> Table
-_result_cache: Dict = {}
-_result_cache_limit = 64
+# session-level semantic result cache (runtime/result_cache.py): entries
+# key on (plan fingerprint, environment, dataset signatures) so a
+# changed source file never serves a stale result. The old name stays
+# bound for its dict-shaped call sites (.clear() in tests/benches,
+# .pop(raw_key) after fusion's buffer donation).
+_result_cache = _rcache.cache()
 
 # graceful-degradation state for the executing thread: while a stage is
 # being re-run replicated, _maybe_shard must not re-shard its sources
@@ -56,7 +58,7 @@ def execute(node: L.Node, optimize_first: bool = True) -> Table:
         log(1, f"fusion planning failed, executing unfused: {e}")
     from bodo_tpu.utils import tracing
     if not tracing.is_tracing():
-        return _exec(node)
+        return _rcache.cached_execute(node, _exec)
     # every traced execution belongs to a query: adopt the caller's
     # span if one is active, otherwise open one for this plan so all
     # events/records below carry a query id
@@ -64,10 +66,10 @@ def execute(node: L.Node, optimize_first: bool = True) -> Table:
     qid = tracing.current_query_id()
     if qid is not None:
         explain.begin_query(node, qid)
-        return _exec(node)
+        return _rcache.cached_execute(node, _exec)
     with tracing.query_span() as qid:
         explain.begin_query(node, qid)
-        return _exec(node)
+        return _rcache.cached_execute(node, _exec)
 
 
 def _maybe_shard(t: Table) -> Table:
@@ -90,8 +92,8 @@ def _exec(node: L.Node) -> Table:
         if traced:
             _record_node(node, node._cached, 0.0, cached=True)
         return node._cached
-    key = node.key()
-    hit = _result_cache.get(key)
+    key = _rcache.node_key(node)
+    hit = _rcache.lookup(key)
     if hit is not None:
         node._cached = hit
         if traced:
@@ -131,8 +133,9 @@ def _exec(node: L.Node) -> Table:
         t = _exec_with_oom_retry(node)
         if ev is not None:
             ev["rows"] = t.nrows
+    wall_s = _time.perf_counter() - t0
     if traced:
-        _record_node(node, t, _time.perf_counter() - t0,
+        _record_node(node, t, wall_s,
                      est_rows=est_rows, aqe_before=aqe_before,
                      comm_before=comm_before, xla_before=xla_before)
     node._cached = t
@@ -144,9 +147,7 @@ def _exec(node: L.Node) -> Table:
     else:
         from bodo_tpu.plan import adaptive
         adaptive.observe_stage(node, t)
-    if len(_result_cache) >= _result_cache_limit:
-        _result_cache.pop(next(iter(_result_cache)))
-    _result_cache[key] = t
+    _rcache.record(key, node.key(), t, wall_s)
     return t
 
 

@@ -267,6 +267,17 @@ class MemoryGovernor:
             self._grants.append(g)
         return g
 
+    def resize_grant(self, grant: OperatorGrant, nbytes: int) -> None:
+        """Retarget a persistent grant to its holder's current footprint
+        (the result cache holds one long-lived grant sized to its device
+        tier). Shrinking wakes queued admits — freed budget is real."""
+        with self._mu:
+            grant.budget = int(nbytes)
+            if grant.budget > grant.granted:
+                grant.granted = grant.budget
+            grant.update(int(nbytes))
+            self._mu.notify_all()
+
     def _release(self, grant: OperatorGrant) -> None:
         with self._mu:
             if grant in self._grants:
@@ -305,6 +316,16 @@ class MemoryGovernor:
             log(1, f"memory governor: OOM — {victim.name} grant "
                    f"{old >> 20} -> {new >> 20} MiB")
             progress = True
+        # shed the result cache's device tier (outside _mu — the cache
+        # takes its own lock, then calls back into resize_grant): cached
+        # results must never OOM a live query
+        try:
+            import sys as _sys
+            rc = _sys.modules.get("bodo_tpu.runtime.result_cache")
+            if rc is not None and rc.shed_for_pressure() > 0:
+                progress = True
+        except Exception:  # noqa: BLE001 - shedding is best-effort
+            pass
         from bodo_tpu.runtime.comptroller import default_comptroller
         comp = default_comptroller()
         before = comp.n_spills

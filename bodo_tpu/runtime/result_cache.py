@@ -1,0 +1,995 @@
+"""Semantic result cache + incremental append maintenance.
+
+Replaces the ad-hoc session dict in plan/physical.py (which keyed on the
+raw structural ``node.key()`` — no dataset signature, so an overwritten
+parquet file kept serving the stale result, and evicted in insertion
+order regardless of how hot an entry was). The cache here keys every
+entry on
+
+    (plan fingerprint, environment key, dataset-signature digest)
+
+where the fingerprint is the sha256 of the structural plan key, the
+environment key pins the execution geometry (mesh width, shard policy,
+precision mode) so mode sweeps never cross-serve, and the signature
+digest covers the per-file (path, mtime, size) signatures of every
+source the plan reads. A file overwrite changes the digest → natural
+invalidation; an identical re-read hits.
+
+Two entry tiers share one store:
+
+  * node entries ("n", …) — per-plan-node memoization across queries,
+    the successor of the old session dict;
+  * query entries ("q", …) — whole-query results recorded at the
+    execute() boundary, carrying everything incremental maintenance
+    needs (the rebuildable plan template, per-source signatures, hidden
+    aggregation partials).
+
+INCREMENTAL MAINTENANCE: when a parquet dataset's signature changes by
+*appended files only* (old signatures byte-identical, new files added —
+``io.parquet.classify_change``), and the cached plan is a
+concat-safe tree (ReadParquet/Filter/Projection/Union) optionally under
+one terminal Aggregate/Reduce whose ops are distributive or algebraic
+(sum/count/min/max, mean via hidden sum+count partials), the delta files
+are scanned with a rebuilt template plan and spliced into the cached
+result through the engine's own kernels:
+
+    concat   : cached ++ delta                     (tail-append only)
+    agg      : groupby(concat(cached, delta)) with sum→sum, count→sum,
+               min→min, max→max; mean re-finalized from hidden partials
+    reduce   : reduce(concat(cached_row, delta_row)), same merge ops
+
+Any non-append change, non-incrementalizable plan, or mid-splice failure
+invalidates cleanly to a full run — never a spliced partial.
+
+MEMORY: cached results are device memory the governor must account for.
+The cache holds one persistent "result_cache" grant resized to its
+device footprint; admission rejects entries larger than the budget;
+eviction is by benefit score (saved_wall × hit recency — an entry that
+keeps getting hit and saved real wall survives pressure). Query entries
+evicted under pressure spill to a host pandas tier (rehydrated — and
+re-sharded — on the next hit); ``shed_for_pressure()`` lets the
+governor's OOM handler drop the whole device tier rather than OOM a
+query to keep a cache entry.
+
+Everything is best-effort: a cache failure must cost a recompute, never
+the query.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+from bodo_tpu.config import config
+from bodo_tpu.utils.logging import log
+
+_HIDDEN_SUM = "__rc_s__"   # hidden mean partials: sum / count per out col
+_HIDDEN_CNT = "__rc_c__"
+_INCR_AGG_OPS = {"sum", "count", "min", "max", "mean"}
+_MERGE_OP = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+_MAX_ENTRIES = 512         # entry-count backstop on top of the byte budget
+_AUTO_FRACTION = 0.125     # auto byte budget: slice of the derived budget
+_AUTO_FLOOR = 64 << 20
+_AUTO_DEFAULT = 256 << 20  # when no governor budget can be derived
+
+
+# --------------------------------------------------------------------------
+# keying: plan fingerprint + source signatures + environment
+# --------------------------------------------------------------------------
+
+_epoch = threading.local()
+
+
+@contextlib.contextmanager
+def signature_epoch():
+    """One stat() per source per execute: signatures computed inside the
+    epoch are snapshotted, so the per-node lookups of a single execute
+    all see (and pay for) one consistent view of the filesystem."""
+    depth = getattr(_epoch, "depth", 0)
+    if depth == 0:
+        _epoch.sigs = {}
+    _epoch.depth = depth + 1
+    try:
+        yield
+    finally:
+        _epoch.depth -= 1
+        if _epoch.depth == 0:
+            _epoch.sigs = None
+
+
+def _sources_of(node):
+    """Structural source list of a subplan: tuple of ("pq", path) /
+    ("csv", path) / ("mem", id), or None when the plan reads something
+    the cache cannot sign. Memoized on the node (structure is
+    immutable)."""
+    s = getattr(node, "_rc_srcs", False)
+    if s is not False:
+        return s
+    from bodo_tpu.plan import logical as L
+    if not node.children:
+        if isinstance(node, L.ReadParquet):
+            s = (("pq", node.path),)
+        elif isinstance(node, L.ReadCsv):
+            s = (("csv", node.path),)
+        elif isinstance(node, L.FromPandas):
+            s = (("mem", node._id),)
+        else:
+            s = None
+    else:
+        acc = []
+        s = ()
+        for c in node.children:
+            cs = _sources_of(c)
+            if cs is None:
+                s = None
+                break
+            acc.extend(cs)
+        if s is not None:
+            seen: Set = set()
+            out = []
+            for x in acc:
+                if x not in seen:
+                    seen.add(x)
+                    out.append(x)
+            s = tuple(out)
+    node._rc_srcs = s
+    return s
+
+
+def _source_sig(kind: str, ident):
+    """Content signature for one source, or None (uncacheable). Failures
+    are loud-once via the stats store's degraded-signature channel —
+    a signature that silently collapses would alias two datasets."""
+    cache_d = getattr(_epoch, "sigs", None)
+    k = (kind, ident)
+    if cache_d is not None and k in cache_d:
+        return cache_d[k]
+    try:
+        if kind == "pq":
+            from bodo_tpu.io.parquet import dataset_signature
+            sig = dataset_signature(ident)
+        elif kind == "csv":
+            import os
+            st = os.stat(ident)
+            sig = ((str(ident), st.st_mtime_ns, st.st_size),)
+        else:  # "mem": identity lives in the fingerprint's counter id
+            sig = ()
+    except Exception as e:  # noqa: BLE001 - uncacheable, not fatal
+        from bodo_tpu.runtime import stats_store
+        stats_store.note_signature_failure(ident, e)
+        sig = None
+    if cache_d is not None:
+        cache_d[k] = sig
+    return sig
+
+
+def _plan_fp(node) -> str:
+    fp = getattr(node, "_rc_fp", None)
+    if fp is None:
+        fp = hashlib.sha256(repr(node.key()).encode()).hexdigest()[:24]
+        node._rc_fp = fp
+    return fp
+
+
+def _env_key() -> tuple:
+    """Execution geometry baked into every key: a result computed on one
+    mesh/shard policy must not serve a query running under another."""
+    from bodo_tpu.parallel import mesh as mesh_mod
+    return (mesh_mod.num_shards(), int(config.shard_min_rows),
+            bool(getattr(config, "low_precision_agg", False)))
+
+
+def _sig_digest(sigs) -> str:
+    return hashlib.sha256(repr(sigs).encode()).hexdigest()[:24]
+
+
+class _QueryInfo:
+    __slots__ = ("fp", "env", "sigs", "key", "raw")
+
+    def __init__(self, fp, env, sigs, key, raw):
+        self.fp, self.env, self.sigs, self.key, self.raw = \
+            fp, env, sigs, key, raw
+
+
+# --------------------------------------------------------------------------
+# incremental-maintenance plan analysis
+# --------------------------------------------------------------------------
+
+def _concat_safe(node) -> bool:
+    """True when executing the plan over D++Δ equals (plan over D) ++
+    (plan over Δ) as a row multiset: per-row operators over scans."""
+    from bodo_tpu.plan import logical as L
+    if isinstance(node, L.ReadParquet):
+        return True
+    if isinstance(node, (L.Filter, L.Projection)):
+        return _concat_safe(node.child)
+    if isinstance(node, L.Union):
+        return all(_concat_safe(c) for c in node.children)
+    return False
+
+
+def _parquet_scans(node, out=None):
+    from bodo_tpu.plan import logical as L
+    if out is None:
+        out = []
+    if isinstance(node, L.ReadParquet):
+        out.append(node)
+    for c in node.children:
+        _parquet_scans(c, out)
+    return out
+
+
+def _rebuild(node, scan_files=None):
+    """Fresh structural clone of an incrementally-maintainable plan (no
+    memoized ``_cached`` tables pinned); ``scan_files`` swaps every
+    parquet scan's file list — that is the delta plan."""
+    from bodo_tpu.plan import logical as L
+    if isinstance(node, L.ReadParquet):
+        path = node.path if scan_files is None else tuple(scan_files)
+        return L.ReadParquet(path, columns=list(node.columns))
+    if isinstance(node, L.Filter):
+        return L.Filter(_rebuild(node.child, scan_files), node.predicate)
+    if isinstance(node, L.Projection):
+        return L.Projection(_rebuild(node.child, scan_files), node.exprs)
+    if isinstance(node, L.Union):
+        return L.Union([_rebuild(c, scan_files) for c in node.children])
+    if isinstance(node, L.Aggregate):
+        return L.Aggregate(_rebuild(node.child, scan_files), node.keys,
+                           node.aggs)
+    if isinstance(node, L.Reduce):
+        return L.Reduce(_rebuild(node.child, scan_files), node.aggs)
+    raise TypeError(f"not incrementally maintainable: "
+                    f"{type(node).__name__}")
+
+
+def _analyze_incremental(root) -> Optional[dict]:
+    """Decide whether a plan supports append splicing; when it does,
+    return the execution recipe: possibly-augmented exec root (hidden
+    sum/count partials for mean re-finalize), the visible column list,
+    and a rebuildable template. None → plain full runs only."""
+    from bodo_tpu.plan import logical as L
+    from bodo_tpu.table import dtypes as dt
+    shape = None
+    if isinstance(root, (L.Aggregate, L.Reduce)):
+        child = root.child
+        aggs = root.aggs
+        if not _concat_safe(child) or not aggs:
+            return None
+        for col, op, _out in aggs:
+            if op not in _INCR_AGG_OPS:
+                return None
+            if op == "mean" and not dt.is_numeric(child.schema[col]):
+                return None
+        shape = "agg" if isinstance(root, L.Aggregate) else "reduce"
+    elif _concat_safe(root):
+        shape = "concat"
+        child = root
+    else:
+        return None
+    scans = _parquet_scans(root)
+    if not scans or len({s.path for s in scans}) != 1:
+        return None  # exactly one dataset: the delta plan swaps its files
+    if shape == "concat" and len(scans) > 1:
+        return None  # multi-scan concat would reorder rows on splice
+    path = scans[0].path
+    import os
+    if not os.path.isdir(path):
+        # a single-file scan cannot grow by appended files — any change
+        # is a mutation, so augmenting (and recompiling) for a future
+        # splice would be pure overhead on the hot single-file path
+        return None
+    keys = list(getattr(root, "keys", []))
+    means = []
+    exec_root, visible = root, None
+    if shape in ("agg", "reduce"):
+        exec_aggs = list(aggs)
+        taken = set(child.schema) | set(keys) | {o for _c, _o2, o in aggs}
+        for col, op, out in aggs:
+            if op != "mean":
+                continue
+            s_name, c_name = _HIDDEN_SUM + out, _HIDDEN_CNT + out
+            if s_name in taken or c_name in taken:
+                return None  # hidden-name collision: bail out entirely
+            taken |= {s_name, c_name}
+            exec_aggs.append((col, "sum", s_name))
+            exec_aggs.append((col, "count", c_name))
+            means.append((out, s_name, c_name))
+        if means:
+            exec_root = (L.Aggregate(child, keys, exec_aggs)
+                         if shape == "agg" else L.Reduce(child, exec_aggs))
+            visible = list(root.schema)
+        aggs = exec_aggs
+    else:
+        aggs = []
+    return {"shape": shape, "keys": keys, "aggs": aggs, "means": means,
+            "order": list(exec_root.schema), "path": path,
+            "exec_root": exec_root, "visible": visible,
+            "template": _rebuild(exec_root)}
+
+
+def _refinalize_means(merged, incr, proto):
+    """mean = hidden_sum / hidden_count, mirroring the groupby kernel's
+    finalize (s / max(cnt, 1), NaN where the group is empty) in the
+    result dtype the original plan produced."""
+    import jax.numpy as jnp
+
+    from bodo_tpu.table.table import Column
+    cols = dict(merged.columns)
+    for out, s_name, c_name in incr["means"]:
+        rdt = proto.columns[out].dtype
+        sv = cols[s_name].data.astype(rdt.numpy)
+        cv = cols[c_name].data
+        m = sv / jnp.maximum(cv, 1)
+        m = jnp.where(cv > 0, m, jnp.nan).astype(rdt.numpy)
+        cols[out] = Column(m, None, rdt)
+    return merged.with_columns(cols)
+
+
+def _splice(old_t, delta_t, incr):
+    """Merge a delta-plan result into the cached result through the
+    engine's own kernels — same code paths, same dtypes, same
+    distribution policy as a full run."""
+    from bodo_tpu import relational as R
+    if list(delta_t.names) != list(old_t.names):
+        delta_t = delta_t.select(old_t.names)
+    shape = incr["shape"]
+    if shape == "concat":
+        from bodo_tpu.plan import physical
+        return physical._maybe_shard(R.concat_tables([old_t, delta_t]))
+    merge = [(out, _MERGE_OP[op], out)
+             for _c, op, out in incr["aggs"] if op != "mean"]
+    both = R.concat_tables([old_t, delta_t])
+    if shape == "agg":
+        merged = R.groupby_agg(both, incr["keys"], merge)
+        if incr["means"]:
+            merged = _refinalize_means(merged, incr, old_t)
+        return merged.select(incr["order"])
+    # reduce: merge the two 1-row partial tables, re-finalize means the
+    # same way reduce_table's host finalize does (sum / count, NaN empty)
+    import pandas as pd
+
+    from bodo_tpu.table.table import Table
+    scalars = R.reduce_table(both, merge)
+    for out, s_name, c_name in incr["means"]:
+        cnt = int(scalars[c_name])
+        scalars[out] = float(scalars[s_name]) / cnt if cnt \
+            else float("nan")
+    df = pd.DataFrame({k: [scalars[k]] for k in incr["order"]})
+    return Table.from_pandas(df)
+
+
+def _classify_append(old_sigs, new_sigs):
+    """(delta_files, tail_only) when every source change is append-only;
+    None on any mutate/mixed change. ``tail_only`` is True when the
+    delta files strictly follow the old files in scan order — required
+    for concat-shape splices, which must preserve row order."""
+    if len(old_sigs) != len(new_sigs):
+        return None
+    from bodo_tpu.io.parquet import classify_change
+    delta = []
+    tail_only = True
+    changed = False
+    for (ok_, oid, osig), (nk, nid, nsig) in zip(old_sigs, new_sigs):
+        if ok_ != nk or oid != nid:
+            return None
+        if osig == nsig:
+            continue
+        if ok_ != "pq":
+            return None
+        verdict, files = classify_change(osig, nsig)
+        if verdict != "append":
+            return None
+        changed = True
+        delta.extend(files)
+        if tuple(nsig[:len(osig)]) != tuple(osig):
+            tail_only = False
+    if not changed or not delta:
+        return None
+    return tuple(delta), tail_only
+
+
+# --------------------------------------------------------------------------
+# the cache
+# --------------------------------------------------------------------------
+
+class _Entry:
+    __slots__ = ("key", "raw", "kind", "table", "host", "dist", "nbytes",
+                 "host_nbytes", "saved_wall_s", "hits", "last_use",
+                 "sources", "visible", "incr")
+
+    def __init__(self, key, raw, kind):
+        self.key, self.raw, self.kind = key, raw, kind
+        self.table = None
+        self.host = None
+        self.dist = None
+        self.nbytes = 0
+        self.host_nbytes = 0
+        self.saved_wall_s = 0.0
+        self.hits = 0
+        self.last_use = 0.0
+        self.sources = None
+        self.visible = None
+        self.incr = None
+
+
+class ResultCache:
+    """Two-tier (device Table / host pandas) semantic result store with
+    benefit-scored eviction and governor-charged admission."""
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._entries: Dict[tuple, _Entry] = {}
+        self._by_fp: Dict[tuple, tuple] = {}    # (fp, env) -> query key
+        self._by_raw: Dict[tuple, Set[tuple]] = {}
+        self._refs: Dict[int, list] = {}        # id(table) -> [refs, bytes]
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.saved_wall_s = 0.0
+        self._grant = None
+        self._grant_bytes = 0
+        self._budget_cache: Optional[int] = None
+        self._budget_at = 0.0
+        self._c: Dict[str, int] = {}
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _now(self) -> float:
+        return time.monotonic()
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._mu:
+            self._c[name] = self._c.get(name, 0) + n
+
+    def _device_budget(self) -> int:
+        b = int(config.result_cache_bytes)
+        if b > 0:
+            return b
+        # auto mode re-probes the governor's derived budget at most
+        # once a second: this sits on the per-node record path
+        now = self._now()
+        if self._budget_cache is not None \
+                and now - self._budget_at < 1.0:
+            return self._budget_cache
+        try:
+            from bodo_tpu.runtime.memory_governor import governor
+            derived = governor().derived_budget()
+        except Exception:  # noqa: BLE001
+            derived = 0
+        out = max(_AUTO_FLOOR, int(derived * _AUTO_FRACTION)) \
+            if derived else _AUTO_DEFAULT
+        self._budget_cache, self._budget_at = out, now
+        return out
+
+    def _score(self, e: _Entry) -> float:
+        """Benefit = saved wall × hit recency: evicting min keeps the
+        entries that keep earning their memory."""
+        age = max(self._now() - e.last_use, 0.0)
+        return (e.saved_wall_s * (1.0 + e.hits)) / (age + 1.0)
+
+    def _sync_grant_locked(self) -> None:
+        """Keep one persistent governor grant sized to the device
+        footprint, so cached results are visible memory pressure.
+        Resyncs are throttled to >=1 MiB drift: the grant is advisory
+        accounting and this sits on the per-node record path."""
+        if not config.mem_governor:
+            return
+        if self._grant is not None and self.device_bytes > 0 and \
+                abs(self.device_bytes - self._grant_bytes) < (1 << 20):
+            return
+        try:
+            from bodo_tpu.runtime import memory_governor as mg
+            if self.device_bytes <= 0:
+                if self._grant is not None:
+                    g, self._grant = self._grant, None
+                    self._grant_bytes = 0
+                    g.release()
+                return
+            gov = mg.governor()
+            if self._grant is None:
+                self._grant = gov.admit("result_cache",
+                                        want=self.device_bytes,
+                                        wait=False)
+            gov.resize_grant(self._grant, self.device_bytes)
+            self._grant_bytes = self.device_bytes
+        except Exception:  # noqa: BLE001 - accounting is best-effort
+            pass
+
+    def _charge_locked(self, e: _Entry, table, nbytes: int) -> None:
+        r = self._refs.get(id(table))
+        if r is None:
+            self._refs[id(table)] = [1, nbytes]
+            self.device_bytes += nbytes
+        else:
+            r[0] += 1
+        e.table = table
+        e.nbytes = nbytes
+
+    def _deref_locked(self, e: _Entry) -> None:
+        t = e.table
+        if t is None:
+            return
+        e.table = None
+        r = self._refs.get(id(t))
+        if r is not None:
+            r[0] -= 1
+            if r[0] <= 0:
+                self.device_bytes -= r[1]
+                del self._refs[id(t)]
+
+    def _drop_locked(self, e: _Entry) -> None:
+        self._deref_locked(e)
+        if e.host is not None:
+            self.host_bytes -= e.host_nbytes
+            e.host, e.host_nbytes = None, 0
+        self._entries.pop(e.key, None)
+        ks = self._by_raw.get(e.raw)
+        if ks is not None:
+            ks.discard(e.key)
+            if not ks:
+                del self._by_raw[e.raw]
+        if e.kind == "q":
+            fpk = (e.key[1], e.key[2])
+            if self._by_fp.get(fpk) == e.key:
+                del self._by_fp[fpk]
+
+    def _spill_locked(self, e: _Entry) -> None:
+        """Device → host pandas tier (query entries only — node-level
+        memoization is not worth a host copy)."""
+        if e.kind != "q" or not config.result_cache_host_spill \
+                or int(config.result_cache_host_bytes) <= 0:
+            self._drop_locked(e)
+            return
+        try:
+            df = e.table.to_pandas()
+            nb = int(df.memory_usage(deep=True).sum())
+        except Exception:  # noqa: BLE001
+            self._drop_locked(e)
+            return
+        self._deref_locked(e)
+        e.host = df
+        e.host_nbytes = nb
+        self.host_bytes += nb
+        self._c["spills"] = self._c.get("spills", 0) + 1
+
+    def _rehydrate_locked(self, e: _Entry):
+        """Host → device on a hit, restoring the original distribution
+        (a 1D result re-shards over the current mesh)."""
+        from bodo_tpu.parallel import mesh as mesh_mod
+        from bodo_tpu.runtime.memory_governor import table_device_bytes
+        from bodo_tpu.table.table import ONED, Table
+        t = Table.from_pandas(e.host)
+        if e.dist == ONED and mesh_mod.num_shards() > 1:
+            t = t.shard()
+        nb = int(table_device_bytes(t))
+        self.host_bytes -= e.host_nbytes
+        e.host, e.host_nbytes = None, 0
+        self._charge_locked(e, t, nb)
+        self._c["rehydrations"] = self._c.get("rehydrations", 0) + 1
+        self._evict_locked(keep=e.key)
+        self._sync_grant_locked()
+        return t
+
+    def _evict_locked(self, keep=None) -> None:
+        budget = self._device_budget()
+        while self.device_bytes > budget:
+            cands = [e for e in self._entries.values()
+                     if e.table is not None and e.key != keep]
+            if not cands:
+                cands = [e for e in self._entries.values()
+                         if e.table is not None]
+                if not cands:
+                    break
+            victim = min(cands, key=self._score)
+            self._c["evictions"] = self._c.get("evictions", 0) + 1
+            self._spill_locked(victim)
+        host_budget = max(int(config.result_cache_host_bytes), 0)
+        while self.host_bytes > host_budget:
+            cands = [e for e in self._entries.values()
+                     if e.host is not None]
+            if not cands:
+                break
+            self._drop_locked(min(cands, key=self._score))
+        while len(self._entries) > _MAX_ENTRIES:
+            cands = [e for e in self._entries.values() if e.key != keep]
+            if not cands:
+                break
+            victim = min(cands, key=self._score)
+            self._c["evictions"] = self._c.get("evictions", 0) + 1
+            self._drop_locked(victim)
+
+    # -- store/lookup --------------------------------------------------------
+
+    def record(self, key, raw, table, wall_s, *, kind="n", sources=None,
+               visible=None, incr=None) -> None:
+        if key is None or not config.result_cache:
+            return
+        try:
+            from bodo_tpu.runtime.memory_governor import \
+                table_device_bytes
+            nbytes = int(table_device_bytes(table))
+        except Exception:  # noqa: BLE001
+            nbytes = 0
+        with self._mu:
+            if nbytes > self._device_budget():
+                self._c["rejected"] = self._c.get("rejected", 0) + 1
+                return
+            old = self._entries.get(key)
+            if old is not None:
+                self._drop_locked(old)
+            e = _Entry(key, raw, kind)
+            e.saved_wall_s = max(float(wall_s), 0.0)
+            e.last_use = self._now()
+            e.dist = table.distribution
+            e.sources = sources
+            e.visible = visible
+            e.incr = incr
+            self._entries[key] = e
+            self._charge_locked(e, table, nbytes)
+            self._by_raw.setdefault(raw, set()).add(key)
+            if kind == "q":
+                self._by_fp[(key[1], key[2])] = key
+            self._evict_locked(keep=key)
+            self._sync_grant_locked()
+
+    def lookup(self, key, *, prefix: str = ""):
+        """Table for a key, counting {prefix}hits/{prefix}misses; host
+        entries rehydrate transparently."""
+        if key is None or not config.result_cache:
+            return None
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                self._c[prefix + "misses"] = \
+                    self._c.get(prefix + "misses", 0) + 1
+                return None
+            e.hits += 1
+            e.last_use = self._now()
+            t = e.table
+            if t is None:
+                try:
+                    t = self._rehydrate_locked(e)
+                except Exception:  # noqa: BLE001
+                    self._drop_locked(e)
+                    self._c[prefix + "misses"] = \
+                        self._c.get(prefix + "misses", 0) + 1
+                    return None
+            self._c[prefix + "hits"] = self._c.get(prefix + "hits", 0) + 1
+            self.saved_wall_s += e.saved_wall_s
+            return t
+
+    def _materialize(self, e: _Entry):
+        """Device table for an entry the caller already holds (no hit
+        accounting) — None when it vanished or cannot rehydrate."""
+        with self._mu:
+            if self._entries.get(e.key) is not e:
+                return None
+            e.last_use = self._now()
+            if e.table is not None:
+                return e.table
+            try:
+                return self._rehydrate_locked(e)
+            except Exception:  # noqa: BLE001
+                self._drop_locked(e)
+                return None
+
+    # -- query boundary ------------------------------------------------------
+
+    def _query_info(self, root) -> Optional[_QueryInfo]:
+        if not config.result_cache:
+            return None
+        srcs = _sources_of(root)
+        if srcs is None:
+            return None
+        sigs = []
+        for kind, ident in srcs:
+            s = _source_sig(kind, ident)
+            if s is None:
+                self.count("sig_uncacheable")
+                return None
+            sigs.append((kind, ident, s))
+        sigs = tuple(sigs)
+        fp = _plan_fp(root)
+        env = _env_key()
+        key = ("q", fp, env, _sig_digest(sigs))
+        return _QueryInfo(fp, env, sigs, key, root.key())
+
+    def cached_execute(self, root, run):
+        """The execute() boundary: exact hit → serve; append-only change
+        on an incrementalizable cached plan → delta scan + splice; any
+        other change → invalidate + full run; miss → timed full run,
+        recorded (with hidden partials when the plan supports future
+        splices)."""
+        if not config.result_cache:
+            return run(root)
+        with signature_epoch():
+            try:
+                qi = self._query_info(root)
+            except Exception:  # noqa: BLE001 - keying must never fail exec
+                qi = None
+            if qi is None:
+                return run(root)
+            with self._mu:
+                e = self._entries.get(qi.key)
+                saved = e.saved_wall_s if e is not None else 0.0
+            t = self.lookup(qi.key, prefix="q_")
+            if t is not None:
+                vis = e.visible if e is not None else None
+                _explain_rcache(root, t, {"event": "hit",
+                                          "saved_s": round(saved, 6)})
+                return t.select(vis) if vis else t
+            with self._mu:
+                pk = self._by_fp.get((qi.fp, qi.env))
+                prev = self._entries.get(pk) if pk is not None else None
+            if prev is not None and prev.key != qi.key:
+                out = self._try_incremental(root, prev, qi, run)
+                if out is not None:
+                    return out
+                # same plan over changed data and no clean splice: the
+                # stale entry can never be served again — drop it
+                with self._mu:
+                    if self._entries.get(prev.key) is prev:
+                        self._drop_locked(prev)
+                        self._c["invalidations"] = \
+                            self._c.get("invalidations", 0) + 1
+                    self._sync_grant_locked()
+            return self._full_run(root, qi, run)
+
+    def _full_run(self, root, qi, run):
+        try:
+            incr = _analyze_incremental(root)
+        except Exception:  # noqa: BLE001 - analysis must never fail exec
+            incr = None
+        exec_root = incr["exec_root"] if incr else root
+        visible = incr["visible"] if incr else None
+        if exec_root is not root:
+            # augmented plan: inherit the root's EXPLAIN identity and
+            # give it its own fusion annotations (best-effort)
+            exec_root._explain_path = getattr(root, "_explain_path", None)
+            try:
+                from bodo_tpu.plan.fusion import plan_fusion_groups
+                plan_fusion_groups(exec_root)
+            except Exception:  # noqa: BLE001
+                pass
+        t0 = time.perf_counter()
+        t = run(exec_root)
+        wall = time.perf_counter() - t0
+        entry_incr = None
+        if incr:
+            entry_incr = {k: incr[k] for k in
+                          ("shape", "keys", "aggs", "means", "order",
+                           "path", "template")}
+        self.record(qi.key, qi.raw, t, wall, kind="q", sources=qi.sigs,
+                    visible=visible, incr=entry_incr)
+        return t.select(visible) if visible else t
+
+    def _try_incremental(self, root, prev, qi, run):
+        """Delta scan + splice against a superseded entry; None when the
+        change is not append-only, the plan does not support it, or the
+        splice fails (caller falls back to a clean full run)."""
+        if prev.incr is None or prev.sources is None:
+            return None
+        try:
+            appended = _classify_append(prev.sources, qi.sigs)
+        except Exception:  # noqa: BLE001
+            appended = None
+        if appended is None:
+            return None
+        delta_files, tail_only = appended
+        if prev.incr["shape"] == "concat" and not tail_only:
+            return None
+        t0 = time.perf_counter()
+        try:
+            old_t = self._materialize(prev)
+            if old_t is None:
+                return None
+            delta_root = _rebuild(prev.incr["template"],
+                                  scan_files=delta_files)
+            delta_root._explain_path = getattr(root, "_explain_path",
+                                               None)
+            delta_t = run(delta_root)
+            merged = _splice(old_t, delta_t, prev.incr)
+        except Exception as e:  # noqa: BLE001 - never a spliced partial
+            self.count("incremental_fallbacks")
+            log(1, f"result cache: incremental refresh failed "
+                   f"({type(e).__name__}: {e}); falling back to full "
+                   f"run")
+            return None
+        wall = time.perf_counter() - t0
+        self.count("q_incremental")
+        # the refreshed entry inherits the superseded entry's benefit
+        # estimate: serving it still saves a full recompute
+        self.record(qi.key, qi.raw, merged, prev.saved_wall_s, kind="q",
+                    sources=qi.sigs, visible=prev.visible,
+                    incr=prev.incr)
+        with self._mu:
+            if self._entries.get(prev.key) is prev:
+                self._drop_locked(prev)
+            self._sync_grant_locked()
+        log(1, f"result cache: incremental refresh over "
+               f"{len(delta_files)} appended file(s) in {wall:.3f}s")
+        _explain_rcache(root, merged,
+                        {"event": "incremental",
+                         "delta_files": len(delta_files),
+                         "wall_s": round(wall, 6)})
+        vis = prev.visible
+        return merged.select(vis) if vis else merged
+
+    # -- pressure / lifecycle ------------------------------------------------
+
+    def shed_for_pressure(self) -> int:
+        """Governor OOM response: push the whole device tier to host (or
+        drop it) — a cache entry must never OOM a live query. Returns
+        device bytes freed."""
+        if not config.result_cache:
+            return 0
+        with self._mu:
+            before = self.device_bytes
+            for e in list(self._entries.values()):
+                if e.table is not None:
+                    self._spill_locked(e)
+            self._evict_locked()
+            self._sync_grant_locked()
+            freed = before - self.device_bytes
+            if freed > 0:
+                self._c["pressure_sheds"] = \
+                    self._c.get("pressure_sheds", 0) + 1
+            return freed
+
+    def reconfigure(self) -> None:
+        """config.set_config hook: re-apply knobs (drop everything when
+        disabled, re-enforce budgets when resized)."""
+        if not config.result_cache:
+            self.clear()
+            return
+        with self._mu:
+            self._budget_cache = None
+            self._evict_locked()
+            self._sync_grant_locked()
+
+    def clear(self) -> None:
+        with self._mu:
+            for e in list(self._entries.values()):
+                self._drop_locked(e)
+            self._entries.clear()
+            self._by_fp.clear()
+            self._by_raw.clear()
+            self._refs.clear()
+            self.device_bytes = 0
+            self.host_bytes = 0
+            self._sync_grant_locked()
+
+    def pop(self, raw, default=None):
+        """Dict-compat invalidation by RAW plan key — the fusion layer
+        pops a node's entries after donating its buffers to XLA."""
+        with self._mu:
+            for k in list(self._by_raw.get(raw, ())):
+                e = self._entries.get(k)
+                if e is not None:
+                    self._drop_locked(e)
+            self._sync_grant_locked()
+        return default
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def __contains__(self, raw) -> bool:
+        with self._mu:
+            return raw in self._by_raw
+
+    def reset_stats(self) -> None:
+        with self._mu:
+            self._c.clear()
+            self.saved_wall_s = 0.0
+
+    def stats(self) -> dict:
+        with self._mu:
+            d = {k: int(v) for k, v in self._c.items()}
+            for k in ("hits", "misses", "q_hits", "q_misses",
+                      "q_incremental", "evictions", "invalidations",
+                      "incremental_fallbacks", "spills", "rehydrations",
+                      "rejected", "sig_uncacheable", "pressure_sheds"):
+                d.setdefault(k, 0)
+            dev = sum(1 for e in self._entries.values()
+                      if e.table is not None)
+            host = sum(1 for e in self._entries.values()
+                       if e.host is not None)
+            qh, qm = d["q_hits"], d["q_misses"]
+            d.update(entries=len(self._entries), device_entries=dev,
+                     host_entries=host, device_bytes=self.device_bytes,
+                     host_bytes=self.host_bytes,
+                     budget_bytes=self._device_budget(),
+                     saved_wall_s=round(self.saved_wall_s, 6),
+                     q_hit_rate=(qh / (qh + qm)) if (qh + qm) else 0.0,
+                     enabled=bool(config.result_cache))
+            return d
+
+
+def _explain_rcache(root, t, info: dict) -> None:
+    """EXPLAIN ANALYZE annotation for a cache-served / spliced root."""
+    try:
+        from bodo_tpu.utils import tracing
+        if not tracing.is_tracing():
+            return
+        from bodo_tpu.plan import explain
+        explain.record(root, rows=t.nrows,
+                       wall_s=float(info.get("wall_s", 0.0)),
+                       cached=info.get("event") == "hit", rcache=info)
+    except Exception:  # noqa: BLE001 - observability never breaks exec
+        pass
+
+
+# --------------------------------------------------------------------------
+# module-level singleton + façade (plan/physical.py and the observability
+# layers call through these; config.set_config reaches reconfigure())
+# --------------------------------------------------------------------------
+
+_cache: Optional[ResultCache] = None
+_cache_mu = threading.Lock()
+
+
+def cache() -> ResultCache:
+    global _cache
+    with _cache_mu:
+        if _cache is None:
+            _cache = ResultCache()
+        return _cache
+
+
+def node_key(node) -> Optional[Tuple]:
+    """Semantic per-node cache key, or None (disabled / unsignable)."""
+    if not config.result_cache:
+        return None
+    try:
+        srcs = _sources_of(node)
+        if srcs is None:
+            return None
+        sigs = []
+        for kind, ident in srcs:
+            s = _source_sig(kind, ident)
+            if s is None:
+                cache().count("sig_uncacheable")
+                return None
+            sigs.append((kind, ident, s))
+        return ("n", _plan_fp(node), _env_key(),
+                _sig_digest(tuple(sigs)))
+    except Exception:  # noqa: BLE001 - keying must never fail exec
+        return None
+
+
+def lookup(key):
+    return cache().lookup(key)
+
+
+def record(key, raw, table, wall_s) -> None:
+    try:
+        cache().record(key, raw, table, wall_s)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def cached_execute(root, run):
+    return cache().cached_execute(root, run)
+
+
+def shed_for_pressure() -> int:
+    return cache().shed_for_pressure()
+
+
+def reconfigure() -> None:
+    cache().reconfigure()
+
+
+def clear() -> None:
+    cache().clear()
+
+
+def stats() -> dict:
+    return cache().stats()
+
+
+def reset_stats() -> None:
+    cache().reset_stats()

@@ -39,6 +39,44 @@ from bodo_tpu.config import config
 
 _MAX_ENTRIES = 4096
 
+# Paths whose dataset signature could not be computed this session. The
+# all-zero fallback signature below can alias two DIFFERENT datasets'
+# fingerprints — fine for advisory stats (a collision only costs plan
+# quality), fatal for result caching (a collision serves wrong data).
+# So the failure is LOUD (once per path) and the result cache treats
+# the plan as non-cacheable (runtime/result_cache.py consults the same
+# channel through note_signature_failure).
+_sig_failed: set = set()
+_sig_failed_mu = threading.Lock()
+
+
+def note_signature_failure(path, err: BaseException) -> None:
+    """Warn once per path that its dataset signature is unavailable."""
+    key = str(path)
+    with _sig_failed_mu:
+        if key in _sig_failed:
+            return
+        _sig_failed.add(key)
+    import warnings
+    warnings.warn(
+        f"dataset signature unavailable for {key!r} "
+        f"({type(err).__name__}: {err}); the plan fingerprint falls "
+        f"back to an all-zero signature that can alias two different "
+        f"datasets — cardinality stats stay advisory, but results for "
+        f"plans reading this path are NOT cached",
+        RuntimeWarning, stacklevel=3)
+
+
+def degraded_paths() -> set:
+    """Paths with failed signatures (observability / tests)."""
+    with _sig_failed_mu:
+        return set(_sig_failed)
+
+
+def reset_degraded() -> None:
+    with _sig_failed_mu:
+        _sig_failed.clear()
+
 
 def _norm_key(node) -> tuple:
     """Structural plan key with process-local identities normalized out."""
@@ -52,7 +90,8 @@ def _norm_key(node) -> tuple:
             # cache keying: (path, mtime, size) per file
             from bodo_tpu.io.parquet import dataset_signature
             sigs = dataset_signature(node.path)
-        except Exception:
+        except Exception as e:
+            note_signature_failure(node.path, e)
             sigs = ((str(node.path), 0, 0),)
         return ("read_parquet", sigs, tuple(node.columns))
     k = node.key()

@@ -141,6 +141,23 @@ def sample() -> dict:
             }
         except Exception:
             pass
+    rc = _mod("bodo_tpu.runtime.result_cache")
+    if rc is not None:
+        try:
+            rs = rc.stats()
+            s["result_cache"] = {
+                "entries": int(rs.get("entries", 0)),
+                "device_bytes": int(rs.get("device_bytes", 0)),
+                "host_bytes": int(rs.get("host_bytes", 0)),
+                "q_hits": int(rs.get("q_hits", 0)),
+                "q_misses": int(rs.get("q_misses", 0)),
+                "q_incremental": int(rs.get("q_incremental", 0)),
+                "hit_rate": round(float(rs.get("q_hit_rate", 0.0)), 4),
+                "saved_wall_s": round(float(
+                    rs.get("saved_wall_s", 0.0)), 3),
+            }
+        except Exception:
+            pass
     fz = _mod("bodo_tpu.plan.fusion")
     if fz is not None:
         try:

@@ -5,6 +5,13 @@ BODO_SQL_PLAN_CACHE_DIR). Since our planner is milliseconds (no JVM), the
 cache stores the *parsed AST pickle* keyed by (query, catalog schema) —
 it mainly saves schema inference on remote scans and documents the
 surface; set BODO_TPU_SQL_PLAN_CACHE_DIR to enable.
+
+A plan-cache hit flows straight into the semantic result cache
+(runtime/result_cache.py): the cached AST lowers to the same logical
+plan, so its structural fingerprint matches the one the result cache
+keyed the previous execution under — a repeat SQL query skips BOTH the
+parse and the execution. ``stats()`` exposes hit/miss counters for the
+metrics registry (bodo_tpu_sql_plan_cache_total).
 """
 
 from __future__ import annotations
@@ -12,9 +19,29 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import threading
 from typing import Optional
 
 from bodo_tpu.config import config
+
+_stats_mu = threading.Lock()
+_stats = {"hits": 0, "misses": 0}
+
+
+def stats() -> dict:
+    with _stats_mu:
+        return dict(_stats)
+
+
+def reset_stats() -> None:
+    with _stats_mu:
+        _stats["hits"] = 0
+        _stats["misses"] = 0
+
+
+def _count(key: str) -> None:
+    with _stats_mu:
+        _stats[key] += 1
 
 
 def _key(query: str, schema_sig: str) -> str:
@@ -28,9 +55,12 @@ def get(query: str, schema_sig: str):
     p = os.path.join(d, _key(query, schema_sig) + ".pkl")
     try:
         with open(p, "rb") as f:
-            return pickle.load(f)
+            ast = pickle.load(f)
     except (OSError, pickle.PickleError, EOFError):
+        _count("misses")
         return None
+    _count("hits")
+    return ast
 
 
 def put(query: str, schema_sig: str, ast) -> None:

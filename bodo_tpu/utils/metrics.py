@@ -764,6 +764,43 @@ def sync_engine_metrics() -> None:
         g.labels(result="miss").set(cc["misses"])
     except Exception:  # pragma: no cover
         pass
+    # -- semantic result cache (lazy-module rule: nothing to report
+    # until the executor has loaded it anyway) -------------------------------
+    rc = sys.modules.get("bodo_tpu.runtime.result_cache")
+    if rc is not None:
+        try:
+            rs_ = rc.stats()
+            g = gauge("bodo_tpu_result_cache_events_total",
+                      "semantic result cache events", ("event",))
+            for k in ("hits", "misses", "q_hits", "q_misses",
+                      "q_incremental", "evictions", "invalidations",
+                      "incremental_fallbacks", "spills", "rehydrations",
+                      "rejected", "sig_uncacheable", "pressure_sheds"):
+                g.labels(event=k).set(rs_.get(k, 0))
+            gb = gauge("bodo_tpu_result_cache_bytes",
+                       "resident result-cache bytes per tier", ("tier",))
+            gb.labels(tier="device").set(rs_.get("device_bytes", 0))
+            gb.labels(tier="host").set(rs_.get("host_bytes", 0))
+            ge2 = gauge("bodo_tpu_result_cache_entries",
+                        "resident result-cache entries per tier",
+                        ("tier",))
+            ge2.labels(tier="device").set(rs_.get("device_entries", 0))
+            ge2.labels(tier="host").set(rs_.get("host_entries", 0))
+            gauge("bodo_tpu_result_cache_saved_seconds",
+                  "wall seconds saved by serving cached results").set(
+                rs_.get("saved_wall_s", 0.0))
+        except Exception:  # pragma: no cover
+            pass
+    # -- sql plan cache (sql/plan_cache.py is stdlib-safe) -------------------
+    try:
+        from bodo_tpu.sql import plan_cache
+        pc = plan_cache.stats()
+        g = gauge("bodo_tpu_sql_plan_cache_total",
+                  "persistent SQL plan cache lookups", ("result",))
+        g.labels(result="hit").set(pc.get("hits", 0))
+        g.labels(result="miss").set(pc.get("misses", 0))
+    except Exception:  # pragma: no cover
+        pass
     # pallas_kernels imports jax — only read the counter if the module
     # is already loaded (never force a jax import from a metrics scrape)
     pk = sys.modules.get("bodo_tpu.ops.pallas_kernels")

@@ -670,6 +670,20 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
             "count": int(hits + misses), "total_s": 0.0,
             "max_s": 0.0, "rows": 0, "hits": int(hits),
             "misses": int(misses)}
+    # semantic result cache: query-level hits/misses/incremental
+    # refreshes, with the wall seconds serving from cache saved
+    rce = series("bodo_tpu_result_cache_events_total")
+    rqh = rce.get(("q_hits",), 0)
+    rqm = rce.get(("q_misses",), 0)
+    if rqh or rqm:
+        out["cache:result"] = {
+            "count": int(rqh + rqm),
+            "total_s": series("bodo_tpu_result_cache_saved_seconds"
+                              ).get((), 0.0),
+            "max_s": 0.0, "rows": 0, "hits": int(rqh),
+            "misses": int(rqm),
+            "incremental": int(rce.get(("q_incremental",), 0)),
+            "evictions": int(rce.get(("evictions",), 0))}
     return out
 
 

@@ -53,10 +53,15 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # reason. test_fused_join.py compiles a wide set of fused join/shuffle
 # programs and asserts on process-wide lockstep manifests, comm sites
 # and the build cache, so it runs alone like test_fusion.py.
+# test_result_cache.py mutates parquet datasets on disk, pins tiny
+# cache/governor budgets and asserts on the process-wide result-cache
+# counters, so it must not share a process with modules that execute
+# plans concurrently.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
              "test_telemetry.py", "test_device_decode.py",
-             "test_comm_observatory.py", "test_fused_join.py")
+             "test_comm_observatory.py", "test_fused_join.py",
+             "test_result_cache.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,407 @@
+"""Semantic result cache + incremental append maintenance
+(runtime/result_cache.py).
+
+Covers the staleness regression the cache was built to fix (a mutated
+dataset must never serve a stale cached result), the semantic re-hit
+(a freshly-built identical plan hits), incremental splice correctness
+per aggregate across distribution modes (bit-identical to a
+cleared-cache full recompute on integer-valued data), clean
+invalidation for non-append changes and non-incrementalizable plans,
+chaos (an armed io fault mid-delta-scan falls back to a full run),
+benefit-aware eviction under a tiny budget, the host spill tier,
+loud-once signature degradation, the governor pressure hook, the
+config knob, SQL plan-cache hit accounting, and the EXPLAIN / metrics
+/ telemetry surfacing.
+
+Runs ISOLATED (runtests.py): mutates datasets on disk, pins tiny
+cache budgets and asserts on process-wide counters.
+"""
+
+import glob
+import os
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bodo_tpu
+import bodo_tpu.pandas_api as bpd
+from bodo_tpu.config import config, set_config
+from bodo_tpu.plan import physical
+from bodo_tpu.runtime import result_cache as rcache
+from bodo_tpu.runtime import stats_store
+from tests.utils import MODES, _mode
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(mesh8):
+    physical._result_cache.clear()
+    rcache.reset_stats()
+    stats_store.reset_degraded()
+    yield
+    physical._result_cache.clear()
+    set_config(result_cache=True, result_cache_bytes=0,
+               result_cache_host_spill=True)
+
+
+class _Dataset:
+    """A small multi-file parquet dataset with append/mutate helpers.
+    Part filenames sort after the existing ones, so an append is always
+    a tail append in scan order."""
+
+    def __init__(self, d: str, n_parts: int = 4, rows: int = 500):
+        self.dir = d
+        self.rows = rows
+        self._i = 0
+        self._rng = np.random.default_rng(3)
+        os.makedirs(d, exist_ok=True)
+        for _ in range(n_parts):
+            self.append(rows)
+
+    def _frame(self, n: int) -> pd.DataFrame:
+        return pd.DataFrame({
+            "k": self._rng.integers(0, 8, n).astype(np.int64),
+            "v": self._rng.integers(-50, 1000, n).astype(np.int64),
+        })
+
+    def append(self, n: int = 100) -> None:
+        self._frame(n).to_parquet(
+            os.path.join(self.dir, f"part-{self._i:05d}.parquet"))
+        self._i += 1
+
+    def mutate(self) -> None:
+        # different row count -> different size: never aliases the old
+        # signature even on coarse-mtime filesystems
+        path = sorted(glob.glob(os.path.join(self.dir, "*.parquet")))[0]
+        self._frame(self.rows + 37).to_parquet(path)
+
+    def pandas(self) -> pd.DataFrame:
+        paths = sorted(glob.glob(os.path.join(self.dir, "*.parquet")))
+        return pd.concat([pd.read_parquet(p) for p in paths],
+                         ignore_index=True)
+
+
+@pytest.fixture
+def ds(tmp_path):
+    return _Dataset(str(tmp_path / "ds"))
+
+
+def _groupby(path):
+    """Fresh plan each call: a hit proves the semantic key."""
+    df = bpd.read_parquet(path)
+    return df.groupby("k", as_index=False).agg(
+        s=("v", "sum"), c=("v", "count"), mn=("v", "min"),
+        mx=("v", "max"), m=("v", "mean")).to_pandas()
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    return df.sort_values("k").reset_index(drop=True)
+
+
+def _full_recompute(fn):
+    physical._result_cache.clear()
+    return fn()
+
+
+# ---------------------------------------------------------------------------
+# staleness regression + semantic re-hit
+# ---------------------------------------------------------------------------
+
+
+def test_mutated_dataset_never_serves_stale(ds):
+    """THE regression: the old session dict keyed results by plan
+    structure alone, so mutating a file between executes served the
+    first file's data forever."""
+    r1 = _groupby(ds.dir)
+    ds.mutate()
+    r2 = _groupby(ds.dir)
+    oracle = ds.pandas().groupby("k", as_index=False).agg(
+        s=("v", "sum"), c=("v", "count"), mn=("v", "min"),
+        mx=("v", "max"), m=("v", "mean"))
+    # exact values; dtype may be the engine's nullable Int64
+    pd.testing.assert_frame_equal(_norm(r2), _norm(oracle),
+                                  check_exact=True, check_dtype=False)
+    assert not _norm(r1).equals(_norm(r2))
+    assert rcache.stats()["invalidations"] >= 1
+
+
+def test_semantic_rehit(ds):
+    r1 = _groupby(ds.dir)
+    before = rcache.stats()
+    r2 = _groupby(ds.dir)
+    st = rcache.stats()
+    assert st["q_hits"] == before["q_hits"] + 1
+    assert st["q_misses"] == before["q_misses"]
+    pd.testing.assert_frame_equal(r1, r2)
+
+
+def test_knob_off_disables_reuse(ds):
+    set_config(result_cache=False)
+    _groupby(ds.dir)
+    before = rcache.stats()
+    _groupby(ds.dir)
+    st = rcache.stats()
+    assert st["q_hits"] == before["q_hits"]
+    assert len(physical._result_cache) == 0
+
+
+# ---------------------------------------------------------------------------
+# incremental append maintenance: correctness per shape / aggregate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_incremental_groupby_sweep_bit_identical(ds, mode):
+    """All five incrementalizable aggregates, per distribution mode:
+    the spliced result must be BIT-identical to a cleared-cache full
+    recompute (integer-valued data keeps float sums exact)."""
+    with _mode(mode):
+        _groupby(ds.dir)
+        ds.append(137)
+        before = rcache.stats()["q_incremental"]
+        spliced = _groupby(ds.dir)
+        assert rcache.stats()["q_incremental"] == before + 1
+        assert rcache.stats()["incremental_fallbacks"] == 0
+        full = _full_recompute(lambda: _groupby(ds.dir))
+    pd.testing.assert_frame_equal(_norm(spliced), _norm(full),
+                                  check_exact=True)
+
+
+def test_incremental_reduce_bit_identical(ds):
+    def q():
+        df = bpd.read_parquet(ds.dir)
+        s = df["v"]
+        return (float(s.sum()), int(s.count()), float(s.min()),
+                float(s.max()), float(s.mean()))
+
+    q()
+    ds.append(91)
+    before = rcache.stats()["q_incremental"]
+    spliced = q()
+    # five scalar reduces = five independent queries, each spliced
+    assert rcache.stats()["q_incremental"] == before + 5
+    full = _full_recompute(q)
+    assert spliced == full
+
+
+def test_incremental_filter_projection_concat(ds):
+    def q():
+        df = bpd.read_parquet(ds.dir)
+        return df[df["v"] % 2 == 0].assign(
+            u=lambda d: d["v"] + 1).to_pandas()
+
+    q()
+    ds.append(64)
+    before = rcache.stats()["q_incremental"]
+    spliced = q()
+    assert rcache.stats()["q_incremental"] == before + 1
+    full = _full_recompute(q)
+    pd.testing.assert_frame_equal(spliced.reset_index(drop=True),
+                                  full.reset_index(drop=True),
+                                  check_exact=True)
+
+
+def test_mutate_invalidates_cleanly(ds):
+    _groupby(ds.dir)
+    inc_before = rcache.stats()["q_incremental"]
+    ds.mutate()
+    r = _groupby(ds.dir)
+    st = rcache.stats()
+    assert st["q_incremental"] == inc_before  # mutate never splices
+    assert st["invalidations"] >= 1
+    full = _full_recompute(lambda: _groupby(ds.dir))
+    pd.testing.assert_frame_equal(_norm(r), _norm(full),
+                                  check_exact=True)
+
+
+def test_nonincremental_plan_falls_back_to_full(ds):
+    """A sorted output is not maintainable by splice: an append must
+    invalidate and fully re-run, and the result must be fresh."""
+    def q():
+        df = bpd.read_parquet(ds.dir)
+        return df.sort_values("v").head(20).to_pandas()
+
+    q()
+    inc_before = rcache.stats()["q_incremental"]
+    ds.append(80)
+    r = q()
+    assert rcache.stats()["q_incremental"] == inc_before
+    full = _full_recompute(q)
+    pd.testing.assert_frame_equal(r.reset_index(drop=True),
+                                  full.reset_index(drop=True),
+                                  check_exact=True)
+
+
+def test_chaos_fault_mid_delta_scan_falls_back(ds):
+    """An armed io.read fault during the delta scan must abort the
+    splice cleanly (no half-merged result) and serve a full re-run."""
+    _groupby(ds.dir)
+    ds.append(77)
+    old_retry = config.retry_attempts
+    set_config(faults="io.read=raise:OSError:1:1", retry_attempts=1)
+    try:
+        before = rcache.stats()["incremental_fallbacks"]
+        r = _groupby(ds.dir)
+        assert rcache.stats()["incremental_fallbacks"] == before + 1
+    finally:
+        set_config(faults="", retry_attempts=old_retry)
+    full = _full_recompute(lambda: _groupby(ds.dir))
+    pd.testing.assert_frame_equal(_norm(r), _norm(full),
+                                  check_exact=True)
+
+
+# ---------------------------------------------------------------------------
+# admission / eviction / spill
+# ---------------------------------------------------------------------------
+
+
+def _big_query(path, cutoff):
+    """~1 MiB result per distinct cutoff (distinct fingerprints)."""
+    df = bpd.read_parquet(path)
+    return df[df["v"] > cutoff].to_pandas()
+
+
+@pytest.fixture
+def big_ds(tmp_path):
+    return _Dataset(str(tmp_path / "big"), n_parts=2, rows=40_000)
+
+
+def test_benefit_eviction_hot_entry_survives(big_ds):
+    """Eviction is LRU-by-benefit, not insertion order: under pressure
+    the repeatedly-hit entry must outlive colder same-size entries."""
+    set_config(result_cache_bytes=4 << 20,
+               result_cache_host_spill=False)
+    _big_query(big_ds.dir, -100)          # the hot entry
+    for _ in range(4):
+        _big_query(big_ds.dir, -100)      # accumulate benefit
+    for cutoff in (-99, -98, -97, -96):   # pressure: cold entries
+        _big_query(big_ds.dir, cutoff)
+    assert rcache.stats()["evictions"] >= 1
+    before = rcache.stats()
+    _big_query(big_ds.dir, -100)
+    st = rcache.stats()
+    assert st["q_hits"] == before["q_hits"] + 1, \
+        "hot entry was evicted by colder entries"
+
+
+def test_host_spill_and_rehydrate(big_ds):
+    set_config(result_cache_bytes=2 << 20,
+               result_cache_host_spill=True)
+    r1 = _big_query(big_ds.dir, -100)
+    _big_query(big_ds.dir, -99)           # pressure: spills the first
+    assert rcache.stats()["spills"] >= 1
+    before = rcache.stats()
+    r2 = _big_query(big_ds.dir, -100)
+    st = rcache.stats()
+    assert st["rehydrations"] >= 1
+    assert st["q_hits"] == before["q_hits"] + 1
+    pd.testing.assert_frame_equal(r1.reset_index(drop=True),
+                                  r2.reset_index(drop=True))
+
+
+def test_oversized_result_rejected(big_ds):
+    set_config(result_cache_bytes=64 << 10,  # smaller than any result
+               result_cache_host_spill=False)
+    _big_query(big_ds.dir, -100)
+    assert rcache.stats()["rejected"] >= 1
+
+
+def test_shed_for_pressure_frees_device_bytes(ds):
+    _groupby(ds.dir)
+    assert rcache.stats()["device_bytes"] > 0
+    freed = rcache.shed_for_pressure()
+    st = rcache.stats()
+    assert freed > 0
+    assert st["pressure_sheds"] >= 1
+    assert st["device_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# signature degradation: loud once, never silently aliased
+# ---------------------------------------------------------------------------
+
+
+def test_signature_failure_uncacheable_and_warns_once(ds, monkeypatch):
+    from bodo_tpu.io import parquet as pq_mod
+
+    def boom(path):
+        raise OSError("signature probe failed")
+
+    monkeypatch.setattr(pq_mod, "dataset_signature", boom)
+    oracle = ds.pandas().groupby("k", as_index=False).agg(
+        s=("v", "sum"), c=("v", "count"), mn=("v", "min"),
+        mx=("v", "max"), m=("v", "mean"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        r1 = _groupby(ds.dir)
+        r2 = _groupby(ds.dir)
+    mine = [x for x in w if issubclass(x.category, RuntimeWarning)
+            and "signature" in str(x.message)]
+    assert len(mine) == 1, "must warn exactly once per path"
+    assert rcache.stats()["sig_uncacheable"] >= 1
+    assert rcache.stats()["q_hits"] == 0  # never cached, never served
+    assert ds.dir in stats_store.degraded_paths()
+    pd.testing.assert_frame_equal(_norm(r1), _norm(oracle),
+                                  check_exact=True, check_dtype=False)
+    pd.testing.assert_frame_equal(_norm(r2), _norm(oracle),
+                                  check_exact=True, check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# surfacing: SQL plan cache, EXPLAIN, metrics, telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_sql_plan_cache_hit_flows_into_result_cache(ds, tmp_path):
+    from bodo_tpu.sql import BodoSQLContext, plan_cache
+
+    set_config(sql_plan_cache_dir=str(tmp_path / "plans"))
+    try:
+        plan_cache.reset_stats()
+        ctx = BodoSQLContext({"t": bpd.read_parquet(ds.dir)})
+        q = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+        r1 = ctx.sql(q).to_pandas()
+        before = rcache.stats()["q_hits"]
+        r2 = ctx.sql(q).to_pandas()
+        st = plan_cache.stats()
+        assert st["hits"] >= 1 and st["misses"] >= 1
+        assert rcache.stats()["q_hits"] == before + 1
+        pd.testing.assert_frame_equal(_norm(r1), _norm(r2))
+    finally:
+        set_config(sql_plan_cache_dir="")
+
+
+def test_explain_analyze_annotates_cache_events(ds):
+    from bodo_tpu.plan import explain
+    from bodo_tpu.utils import tracing
+
+    set_config(tracing_level=1)
+    try:
+        _groupby(ds.dir)
+        with tracing.query_span() as qid:
+            _groupby(ds.dir)
+        tree = explain.explain_analyze(qid)
+        assert "result_cache[hit" in tree, tree
+        ds.append(50)
+        with tracing.query_span() as qid2:
+            _groupby(ds.dir)
+        tree2 = explain.explain_analyze(qid2)
+        assert "result_cache[incremental" in tree2, tree2
+    finally:
+        set_config(tracing_level=0)
+
+
+def test_metrics_and_telemetry_surfacing(ds):
+    from bodo_tpu.runtime import telemetry
+    from bodo_tpu.utils import metrics
+
+    _groupby(ds.dir)
+    _groupby(ds.dir)
+    text = metrics.expose_text()
+    assert 'bodo_tpu_result_cache_events_total{event="q_hits"}' in text
+    assert 'bodo_tpu_result_cache_bytes{tier="device"}' in text
+    assert metrics.check_exposition(text) == []
+    s = telemetry.sample()
+    assert s["result_cache"]["q_hits"] >= 1
+    assert s["result_cache"]["hit_rate"] > 0

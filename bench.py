@@ -1499,22 +1499,238 @@ def bench_join(args, n_rows: int):
     return 0
 
 
+def _serve_multitenant(args, templates, novel_fn, data_dir) -> dict:
+    """Multi-tenant phases of --suite serve, driven through the
+    bodo_tpu.serve client surface (runtime/scheduler.py):
+
+    1. CONCURRENT SESSIONS — ``--clients N`` threads each own a serving
+       Session and replay the dashboard templates against the one
+       resident gang; reports sustained QPS and submit->result p50/p99.
+    2. OVERLOAD — queue bounds are pinned tiny (serve_queue_depth=2,
+       serve_max_pending=4) and one session fires novel queries
+       unpaced: the round MUST produce typed Overloaded rejections with
+       positive retry-after hints and ZERO governor OOM retries
+       (backpressure instead of OOM), and every accepted future must
+       still complete.
+    3. ISOLATION — the result-cache budget is pinned to ~3x tenant A's
+       working set, then tenant B floods novel scan-sized queries well
+       past its fair share: the per-session eviction policy must evict
+       B's OWN entries (by_session[B].evicted > 0) while A's set stays
+       resident (by_session[A].evicted == 0) and A's re-run still
+       hits. Any violation raises."""
+    import threading
+
+    from bodo_tpu import pandas_api as bpd
+    from bodo_tpu import serve
+    from bodo_tpu.config import config, set_config
+    from bodo_tpu.plan.physical import _result_cache
+    from bodo_tpu.runtime import result_cache as rcache
+
+    def oom_retries() -> int:
+        try:
+            from bodo_tpu.runtime.memory_governor import governor
+            return int(governor().stats().get("n_oom_retries", 0))
+        except Exception:  # noqa: BLE001 - accounting probe only
+            return 0
+
+    out: dict = {}
+    serve.start()
+
+    # -- phase 1: N concurrent sessions, one resident gang ---------------
+    n_clients = max(1, int(getattr(args, "clients", 4) or 4))
+    per_client = 6 if args.quick else 12
+    mu = threading.Lock()
+    lat: list = []
+    errs: list = []
+    dropped = [0]
+
+    def client(ci: int) -> None:
+        s = serve.session(f"client{ci}")
+        for j in range(per_client):
+            fn = templates[(ci + j) % len(templates)]
+            for _ in range(3):
+                t0 = time.perf_counter()
+                try:
+                    s.run(fn, timeout=600)
+                except serve.ServeRejection as e:
+                    time.sleep(min(max(e.retry_after_s, 0.01), 0.5))
+                    continue
+                except Exception as e:  # noqa: BLE001 - reported below
+                    with mu:
+                        errs.append(f"{type(e).__name__}: {e}")
+                    return
+                with mu:
+                    lat.append(time.perf_counter() - t0)
+                break
+            else:
+                with mu:
+                    dropped[0] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(ci,),
+                                name=f"serve-client-{ci}")
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    phase_wall = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError(f"serve client queries failed: {errs[:3]}")
+    if not lat:
+        raise RuntimeError("serve concurrent phase completed nothing")
+    lat.sort()
+    qps = len(lat) / phase_wall if phase_wall > 0 else 0.0
+    out["clients"] = n_clients
+    out["requests_completed"] = len(lat)
+    out["requests_dropped"] = dropped[0]
+    out["wall_s"] = round(phase_wall, 4)
+    out["qps"] = round(qps, 2)
+    out["p50_s"] = round(lat[len(lat) // 2], 5)
+    out["p99_s"] = round(lat[min(len(lat) - 1,
+                                 int(len(lat) * 0.99))], 5)
+
+    # -- phase 2: overload -> typed backpressure, zero OOM ----------------
+    oom0 = oom_retries()
+    old_depth = config.serve_queue_depth
+    old_pending = config.serve_max_pending
+    old_adm = config.serve_admission
+    # bounded-queue backpressure is orthogonal to the admission screen;
+    # screen off so a recompile storm armed by this very novel-plan
+    # flood cannot back off the session whose queue we are overflowing
+    set_config(serve_queue_depth=2, serve_max_pending=4,
+               serve_admission=False)
+    sess = serve.session("overload")
+    futures: list = []
+    hints: list = []
+    rejected = 0
+    try:
+        for i in range(24):
+            try:
+                futures.append(
+                    sess.submit(lambda i=i: novel_fn(50_000 + i)))
+            except serve.ServeRejection as e:
+                rejected += 1
+                hints.append(e.retry_after_s)
+    finally:
+        set_config(serve_queue_depth=old_depth,
+                   serve_max_pending=old_pending,
+                   serve_admission=old_adm)
+    serve.drain(timeout=600)
+    accept_failures = []
+    for f in futures:
+        try:
+            f.result(timeout=600)
+        except Exception as e:  # noqa: BLE001 - asserted below
+            accept_failures.append(type(e).__name__)
+    oom_delta = oom_retries() - oom0
+    if rejected == 0:
+        raise RuntimeError(
+            "overload round produced no typed rejections — "
+            "backpressure contract broken")
+    if hints and min(hints) <= 0:
+        raise RuntimeError("Overloaded rejection carried no "
+                           "retry_after_s hint")
+    if accept_failures:
+        raise RuntimeError(
+            f"accepted overload queries failed: {accept_failures}")
+    if oom_delta != 0:
+        raise RuntimeError(
+            f"overload round cost {oom_delta} governor OOM retries — "
+            f"backpressure should shed before memory pressure")
+    out["overload"] = {
+        "submitted": 24, "accepted": len(futures),
+        "rejected_typed": rejected,
+        "min_retry_after_s": round(min(hints), 4) if hints else None,
+        "oom_retries": oom_delta,
+    }
+
+    # -- phase 3: per-tenant result-cache isolation ------------------------
+    _result_cache.clear()
+    rcache.reset_stats()
+    a = serve.session("tenant_a")
+    b = serve.session("tenant_b")
+    old_budget = config.result_cache_bytes
+    # eviction fairness is what this phase measures, not admission:
+    # screen off so a storm armed by B's novel-plan flood cannot back
+    # off either tenant mid-phase
+    set_config(serve_admission=False)
+
+    def flood(i: int):
+        # distinct constant -> distinct fingerprint; the result is a
+        # filtered FRAME (scan-sized), so the flood actually fills the
+        # pinned budget instead of trickling in tiny aggregates
+        df = bpd.read_parquet(data_dir)
+        return df[df["w"] < 300 + i].to_pandas()
+
+    try:
+        for fn in templates:
+            a.run(fn, timeout=600)      # A's working set, now resident
+        a_bytes = int(rcache.stats()["device_bytes"])
+        if a_bytes <= 0:
+            raise RuntimeError("tenant A's working set cached no device"
+                               " bytes — isolation phase cannot engage")
+        # ~3x A's set: A sits under its fair share (budget/2) for the
+        # whole flood while B must blow past it and evict its OWN
+        # entries
+        set_config(result_cache_bytes=a_bytes * 3)
+        for i in range(16):
+            b.run(lambda i=i: flood(i), timeout=600)
+        a_hits0 = rcache.stats()["by_session"].get(
+            "tenant_a", {}).get("q_hits", 0)
+        for fn in templates:
+            a.run(fn, timeout=600)      # A's re-run after the flood
+    finally:
+        set_config(result_cache_bytes=old_budget,
+                   serve_admission=old_adm)
+    by = rcache.stats()["by_session"]
+    a_row = by.get("tenant_a", {})
+    b_row = by.get("tenant_b", {})
+    rehits = a_row.get("q_hits", 0) - a_hits0
+    isolation_pass = (a_row.get("evicted", 0) == 0
+                      and rehits >= 2
+                      and b_row.get("evicted", 0) > 0)
+    if not isolation_pass:
+        raise RuntimeError(
+            f"cache isolation violated: tenant_a={a_row} "
+            f"(re-hits {rehits}) tenant_b={b_row}")
+    out["isolation"] = {
+        "passed": True, "a_working_set_bytes": a_bytes,
+        "pinned_budget_bytes": a_bytes * 3,
+        "a_evicted": a_row.get("evicted", 0), "a_rehits": rehits,
+        "b_evicted": b_row.get("evicted", 0),
+        "b_records": b_row.get("records", 0),
+    }
+    sst = serve.stats()
+    out["scheduler"] = {k: sst[k] for k in
+                        ("sessions", "completed", "failed",
+                         "decisions")}
+    return out
+
+
 def bench_serve(args, n_rows: int):
-    """--suite serve: semantic result cache under repeat traffic
-    (runtime/result_cache.py). A dashboard-shaped request mix — 90%
-    repeats of three fixed query templates (groupby sum/mean/count,
-    filter+groupby, whole-column reduce; each request rebuilds its plan
-    from scratch, so hits are purely semantic) and 10% novel one-off
-    filters — runs against a multi-file parquet dataset that gains a
-    ~1% append between rounds. The headline is the repeat speedup: p50
-    of the templates' cold (first-execution) walls over p50 of every
-    later repeat request (acceptance bar >= 20x on CPU). detail.suites
-    carries three independently-watched series: the served hit rate
-    (hitrate, regresses down), repeat p50 (s, regresses up), and the
-    incremental-refresh ratio (frac, regresses up) — the wall to
-    refresh a cached groupby after a fresh 1% append vs the
-    cleared-cache full recompute of the same plan (bar <= 0.10), with
-    the refreshed frame asserted bit-identical to the recompute."""
+    """--suite serve: the serving stack under repeat + multi-tenant
+    traffic. Part one exercises the semantic result cache
+    (runtime/result_cache.py) single-tenant: a dashboard-shaped request
+    mix — 90% repeats of three fixed query templates (groupby
+    sum/mean/count, filter+groupby, whole-column reduce; each request
+    rebuilds its plan from scratch, so hits are purely semantic) and
+    10% novel one-off filters — runs against a multi-file parquet
+    dataset that gains a ~1% append between rounds. The headline is the
+    repeat speedup: p50 of the templates' cold (first-execution) walls
+    over p50 of every later repeat request (acceptance bar >= 20x on
+    CPU). Part two (_serve_multitenant) drives the same templates
+    through bodo_tpu.serve: ``--clients N`` concurrent sessions on the
+    one resident gang, an overload round that must backpressure with
+    typed rejections (zero OOM), and a fair-share cache-isolation
+    assertion. detail.suites carries the independently-watched series:
+    hit rate (hitrate, regresses down), repeat p50 (s, regresses up),
+    incremental-refresh ratio (frac, regresses up — the wall to refresh
+    a cached groupby after a fresh 1% append vs the cleared-cache full
+    recompute, bar <= 0.10, refreshed frame asserted bit-identical),
+    plus serve_qps (qps, regresses down), serve_p50_s / serve_p99_s (s,
+    regress up) and serve_isolation (hitrate: 1.0 = the isolation
+    assertion held)."""
     import shutil
 
     import jax
@@ -1632,7 +1848,8 @@ def bench_serve(args, n_rows: int):
         full_df.sort_values("k").reset_index(drop=True),
         check_exact=True)
 
-    st = rcache.stats()
+    st = rcache.stats()  # single-tenant mix snapshot (phase 3 resets)
+    mt = _serve_multitenant(args, templates, novel, data_dir)
     detail = {
         "rows": n_rows, "parts_written": part_idx,
         "append_rows": append_rows, "rounds": rounds,
@@ -1656,6 +1873,7 @@ def bench_serve(args, n_rows: int):
                    "evictions", "spills", "entries", "device_bytes",
                    "host_bytes", "budget_bytes")},
         "saved_wall_s": round(st["saved_wall_s"], 3),
+        "multitenant": mt,
         "probe": getattr(args, "probe", {"attempted": False}),
         # independently-watched series (benchwatch lifts these into
         # their own direction-aware trajectories)
@@ -1669,6 +1887,22 @@ def bench_serve(args, n_rows: int):
             "serve_incremental_ratio": {
                 "metric": "serve_incremental_ratio",
                 "value": round(ratio, 4), "unit": "frac"},
+            "serve_qps": {
+                "metric": "serve_qps",
+                "value": mt["qps"], "unit": "qps"},
+            "serve_p50": {
+                "metric": "serve_p50_s",
+                "value": mt["p50_s"], "unit": "s"},
+            "serve_p99": {
+                "metric": "serve_p99_s",
+                "value": mt["p99_s"], "unit": "s"},
+            # 1.0 = the fair-share isolation assertion held (the phase
+            # raises otherwise, so a regression shows as a bench
+            # failure AND a series drop)
+            "serve_isolation": {
+                "metric": "serve_isolation",
+                "value": 1.0 if mt["isolation"]["passed"] else 0.0,
+                "unit": "hitrate"},
         },
     }
     print(f"serve: cold p50 {cold_p50:.4f}s repeat p50 "
@@ -1677,6 +1911,13 @@ def bench_serve(args, n_rows: int):
           f"1% append {incr_s:.4f}s vs full {full_s:.4f}s "
           f"(ratio {ratio:.3f}, incremental="
           f"{refreshed_incrementally})", file=sys.stderr)
+    print(f"serve multitenant: {mt['clients']} clients sustained "
+          f"{mt['qps']:.1f} qps (p50 {mt['p50_s']:.4f}s p99 "
+          f"{mt['p99_s']:.4f}s); overload shed "
+          f"{mt['overload']['rejected_typed']}/24 typed, "
+          f"{mt['overload']['oom_retries']} OOM; isolation: A evicted "
+          f"{mt['isolation']['a_evicted']}, B evicted "
+          f"{mt['isolation']['b_evicted']} -> PASS", file=sys.stderr)
     print(json.dumps({
         "metric": "serve_repeat_speedup",
         "value": round(speedup, 2),
@@ -1802,6 +2043,9 @@ def main():
     ap.add_argument("--no-gang", action="store_true", dest="no_gang",
                     help="comm: skip the 2-process injected-latency "
                          "skew probe")
+    ap.add_argument("--clients", type=int, default=4,
+                    help="serve: concurrent client sessions for the "
+                         "multi-tenant phase (default 4)")
     ap.add_argument("--explain", action="store_true",
                     help="taxi: EXPLAIN ANALYZE the plan-based pipeline "
                          "and run a --procs gang emitting one merged "

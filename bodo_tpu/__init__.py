@@ -7,12 +7,14 @@ relational kernels are jit-traced XLA programs (segment reductions, sorts,
 Pallas hash kernels), and distribution is SPMD over a `jax.sharding.Mesh`
 with lax collectives instead of MPI (see SURVEY.md §7).
 
-Public surfaces (mirroring the reference's four):
+Public surfaces (mirroring the reference's four, plus serving):
   - `bodo_tpu.jit`         — @jit decorator (reference bodo/decorators.py:338)
   - `bodo_tpu.pandas_api`  — lazy drop-in dataframe library
                              (reference bodo/pandas/frame.py:117)
   - `bodo_tpu.sql`         — SQL context (reference BodoSQL/bodosql/context.py:504)
   - `bodo_tpu.ml`          — distributed ML (reference bodo/ml_support/)
+  - `bodo_tpu.serve`       — multi-tenant sessions over one resident gang
+                             (runtime/scheduler.py)
 """
 
 import jax
@@ -62,5 +64,8 @@ def __getattr__(name):
         return m
     if name == "ml":
         import bodo_tpu.ml as m
+        return m
+    if name == "serve":
+        import bodo_tpu.serve as m
         return m
     raise AttributeError(f"module 'bodo_tpu' has no attribute {name!r}")

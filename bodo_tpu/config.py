@@ -364,6 +364,54 @@ class Config:
         default_factory=lambda: _env_int(
             "BODO_TPU_RESULT_CACHE_HOST_BYTES", 1 << 28)
     )
+    # -- query serving (runtime/scheduler.py, bodo_tpu.serve) ----------------
+    # Worker threads draining the per-session queues onto the gang. One
+    # worker serializes queries (an SPMD gang runs one program at a
+    # time anyway); more overlap host-side planning/IO of one query
+    # with device execution of another.
+    serve_workers: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_SERVE_WORKERS", 1)
+    )
+    # Per-session bounded queue depth; overflow is a typed Overloaded
+    # rejection with a retry-after hint, never an unbounded buffer.
+    serve_queue_depth: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_SERVE_QUEUE_DEPTH", 32)
+    )
+    # Total queued requests across all sessions before global shedding.
+    serve_max_pending: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_SERVE_MAX_PENDING",
+                                         256)
+    )
+    # Admission control from live health/metrics signals (off = every
+    # submit is admitted; bounded queues still backpressure).
+    serve_admission: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_SERVE_ADMISSION",
+                                          True)
+    )
+    # Governor occupancy (granted / derived budget) at which new work
+    # is shed with Overloaded instead of risking OOM.
+    serve_shed_occupancy: float = field(
+        default_factory=lambda: _env_float(
+            "BODO_TPU_SERVE_SHED_OCCUPANCY", 0.92)
+    )
+    # Gang comm wait fraction above which comm-wait-dominated sessions
+    # (their own EWMA also above this) are backed off.
+    serve_comm_wait_frac: float = field(
+        default_factory=lambda: _env_float(
+            "BODO_TPU_SERVE_COMM_WAIT_FRAC", 0.5)
+    )
+    # Priority aging rate: every this-many seconds a session's head
+    # request has waited discounts one second of its accrued virtual
+    # time, bounding starvation of low-weight sessions.
+    serve_aging_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_SERVE_AGING_S", 5.0)
+    )
+    # Base retry-after hint (seconds) attached to typed rejections
+    # (scaled up by rejection severity and measured queue wait).
+    serve_retry_after_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_SERVE_RETRY_AFTER",
+                                           0.25)
+    )
     # -- resilience (runtime/resilience.py) ----------------------------------
     # Armed fault-injection spec (see resilience module docstring for the
     # grammar, e.g. "io.read=raise:OSError,collective=raise:Internal:1:0").
@@ -487,6 +535,13 @@ def set_config(**kwargs) -> None:
             rc = _sys.modules.get("bodo_tpu.runtime.result_cache")
             if rc is not None:
                 rc.reconfigure()
+        if k.startswith("serve_"):
+            # re-size a live scheduler's worker pool / drop its signal
+            # snapshot (lazy: never imports the module to reconfigure)
+            import sys as _sys
+            sch = _sys.modules.get("bodo_tpu.runtime.scheduler")
+            if sch is not None:
+                sch.reconfigure()
         if k == "stats_store_dir":
             # flush + drop the open store so the next lookup re-binds to
             # the new directory

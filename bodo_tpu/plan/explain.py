@@ -41,11 +41,14 @@ def _qid() -> str:
     return tracing.current_query_id() or "-"
 
 
-def begin_query(root: L.Node, query_id: Optional[str] = None) -> None:
+def begin_query(root: L.Node, query_id: Optional[str] = None,
+                session: Optional[str] = None) -> None:
     """Anchor a query: assign dotted paths over the (optimized) tree and
     open its record store. Called by physical.execute when tracing is
     on. Shared subplans (the optimizer memoizes by key) keep the first
-    path they get — later parents see them as cache hits anyway."""
+    path they get — later parents see them as cache hits anyway.
+    ``session`` tags the query with the serving session that issued it
+    (rendered in the EXPLAIN ANALYZE header, carried by slow_queries)."""
     global _last_qid
     qid = query_id or _qid()
     assign_paths(root, "0", force=True)
@@ -57,7 +60,17 @@ def begin_query(root: L.Node, query_id: Optional[str] = None) -> None:
                 _queries.popitem(last=False)
         else:
             q["root"] = root
+        if session:
+            q["session"] = session
         _last_qid = qid
+
+
+def query_session(query_id: Optional[str] = None) -> Optional[str]:
+    """Serving session a recorded query was tagged with, if any."""
+    with _lock:
+        qid = query_id or _last_qid
+        q = _queries.get(qid) if qid else None
+        return q.get("session") if q else None
 
 
 def assign_paths(node: L.Node, base: str, force: bool = False,
@@ -229,9 +242,15 @@ def slow_queries(n: int = 5) -> List[dict]:
             wall = max((r["wall_s"] for r in recs), default=0.0)
         scored.append((float(wall), qid))
     scored.sort(key=lambda t: -t[0])
-    return [{"query_id": qid, "wall_s": round(wall, 6),
-             "explain": explain_analyze(qid)}
-            for wall, qid in scored[:max(0, int(n))]]
+    out = []
+    for wall, qid in scored[:max(0, int(n))]:
+        row = {"query_id": qid, "wall_s": round(wall, 6),
+               "explain": explain_analyze(qid)}
+        sid = query_session(qid)
+        if sid:
+            row["session"] = sid
+        out.append(row)
+    return out
 
 
 def reset() -> None:
@@ -350,6 +369,7 @@ def explain_analyze(query_id: Optional[str] = None) -> str:
         qid = query_id or _last_qid
         q = _queries.get(qid) if qid else None
         root = q["root"] if q else None
+        session = q.get("session") if q else None
         records = {p: dict(r) for p, r in q["records"].items()} if q \
             else {}
     if qid is None or q is None:
@@ -362,6 +382,8 @@ def explain_analyze(query_id: Optional[str] = None) -> str:
     if wall is None and records:
         wall = max(r["wall_s"] for r in records.values())
     header = f"EXPLAIN ANALYZE  query={qid}"
+    if session:
+        header += f"  session={session}"
     if wall is not None:
         header += f"  wall={wall:.3f}s"
     lines.append(header)

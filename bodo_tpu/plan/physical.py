@@ -61,15 +61,31 @@ def execute(node: L.Node, optimize_first: bool = True) -> Table:
         return _rcache.cached_execute(node, _exec)
     # every traced execution belongs to a query: adopt the caller's
     # span if one is active, otherwise open one for this plan so all
-    # events/records below carry a query id
+    # events/records below carry a query id. The serving layer's
+    # session (if any) tags the query — EXPLAIN/slow-query records then
+    # say WHICH tenant ran the plan (multi-tenant attribution)
     from bodo_tpu.plan import explain
+    session = _current_session()
     qid = tracing.current_query_id()
     if qid is not None:
-        explain.begin_query(node, qid)
+        explain.begin_query(node, qid, session=session)
         return _rcache.cached_execute(node, _exec)
     with tracing.query_span() as qid:
-        explain.begin_query(node, qid)
+        explain.begin_query(node, qid, session=session)
         return _rcache.cached_execute(node, _exec)
+
+
+def _current_session():
+    """Serving-session id of the executing query, or None outside the
+    serving layer (lazy: never imports the scheduler)."""
+    import sys
+    sch = sys.modules.get("bodo_tpu.runtime.scheduler")
+    if sch is None:
+        return None
+    try:
+        return sch.current_session()
+    except Exception:  # noqa: BLE001 - attribution is best-effort
+        return None
 
 
 def _maybe_shard(t: Table) -> Table:

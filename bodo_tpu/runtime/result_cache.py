@@ -51,6 +51,26 @@ re-sharded — on the next hit); ``shed_for_pressure()`` lets the
 governor's OOM handler drop the whole device tier rather than OOM a
 query to keep a cache entry.
 
+MULTI-TENANCY: every entry is tagged with the serving session that
+recorded it (runtime/scheduler.py's contextvar; "-" outside the serving
+layer) and per-session device bytes are accounted. Under device
+pressure eviction is FAIR-SHARE: with more than one session holding
+device entries, victims come from sessions above their equal share of
+the budget (lowest benefit score first); when the inserting session is
+the only one over its share, its own entry is the victim — a tenant
+flooding the cache self-limits to its share and cannot evict another
+tenant's within-share working set. ``stats()["by_session"]`` exposes
+per-session hit/miss/eviction/byte counters (the isolation assertion in
+``bench.py --suite serve`` reads these).
+
+OWNERSHIP: the cache is PER-PROCESS and assumes the single resident
+gang of this process — device buffers in entries are only valid on the
+process that created them, and the byte accounting assumes one governor.
+``cache()`` asserts this: a fork (different pid) gets a loud warning and
+a fresh empty cache instead of silently serving another process's
+device handles. Cross-process / cross-gang sharing is future work
+(ROADMAP item 4 — the host tier is the natural exchange format).
+
 Everything is best-effort: a cache failure must cost a recompute, never
 the query.
 """
@@ -59,8 +79,10 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
+import os as _os
 import threading
 import time
+import warnings
 from typing import Dict, Optional, Set, Tuple
 
 from bodo_tpu.config import config
@@ -395,10 +417,24 @@ def _classify_append(old_sigs, new_sigs):
 # the cache
 # --------------------------------------------------------------------------
 
+def _current_session() -> str:
+    """Serving-session label for attribution ("-" outside the serving
+    layer). Read via sys.modules.get — recording a cache entry must
+    never import the scheduler."""
+    import sys
+    sch = sys.modules.get("bodo_tpu.runtime.scheduler")
+    if sch is None:
+        return "-"
+    try:
+        return sch.current_session() or "-"
+    except Exception:  # noqa: BLE001 - attribution is best-effort
+        return "-"
+
+
 class _Entry:
     __slots__ = ("key", "raw", "kind", "table", "host", "dist", "nbytes",
                  "host_nbytes", "saved_wall_s", "hits", "last_use",
-                 "sources", "visible", "incr")
+                 "sources", "visible", "incr", "session")
 
     def __init__(self, key, raw, kind):
         self.key, self.raw, self.kind = key, raw, kind
@@ -413,6 +449,7 @@ class _Entry:
         self.sources = None
         self.visible = None
         self.incr = None
+        self.session = "-"
 
 
 class ResultCache:
@@ -433,6 +470,8 @@ class ResultCache:
         self._budget_cache: Optional[int] = None
         self._budget_at = 0.0
         self._c: Dict[str, int] = {}
+        self._sess: Dict[str, Dict[str, int]] = {}  # session -> counters
+        self._owner_pid = _os.getpid()
 
     # -- plumbing ------------------------------------------------------------
 
@@ -442,6 +481,20 @@ class ResultCache:
     def count(self, name: str, n: int = 1) -> None:
         with self._mu:
             self._c[name] = self._c.get(name, 0) + n
+
+    def _count_sess_locked(self, session: str, name: str,
+                           n: int = 1) -> None:
+        d = self._sess.setdefault(session or "-", {})
+        d[name] = d.get(name, 0) + n
+
+    def assert_single_gang_owner(self) -> None:
+        """Hard ownership check: this cache's device buffers belong to
+        the process (and resident gang) that created them."""
+        if self._owner_pid != _os.getpid():
+            raise AssertionError(
+                f"result cache owned by pid {self._owner_pid} used from "
+                f"pid {_os.getpid()}: device entries are per-process; "
+                f"cross-process sharing is ROADMAP item 4")
 
     def _device_budget(self) -> int:
         b = int(config.result_cache_bytes)
@@ -572,18 +625,48 @@ class ResultCache:
         self._sync_grant_locked()
         return t
 
+    def _sess_dev_locked(self) -> Dict[str, int]:
+        """Per-session device bytes (entry-attributed: a table shared
+        across sessions counts toward each holder's footprint, which is
+        the conservative side for fair-share comparisons)."""
+        by: Dict[str, int] = {}
+        for e in self._entries.values():
+            if e.table is not None:
+                by[e.session] = by.get(e.session, 0) + e.nbytes
+        return by
+
+    def _device_victim_locked(self, budget: int, keep) -> Optional[_Entry]:
+        """Fair-share victim choice. Single tenant: global min benefit
+        score (original behavior). Multiple tenants: victims come from
+        sessions above their equal share of the budget; when only the
+        inserting (keep) session is over its share, ITS entry is the
+        victim — a flooding tenant self-limits instead of evicting a
+        within-share working set of another tenant."""
+        cands = [e for e in self._entries.values()
+                 if e.table is not None and e.key != keep]
+        by_sess = self._sess_dev_locked()
+        if len(by_sess) > 1:
+            share = budget // len(by_sess)
+            over = [e for e in cands if by_sess.get(e.session, 0) > share]
+            if over:
+                return min(over, key=self._score)
+            keep_e = self._entries.get(keep) if keep is not None else None
+            if keep_e is not None and keep_e.table is not None \
+                    and by_sess.get(keep_e.session, 0) > share:
+                return keep_e
+        if not cands:
+            cands = [e for e in self._entries.values()
+                     if e.table is not None]
+        return min(cands, key=self._score) if cands else None
+
     def _evict_locked(self, keep=None) -> None:
         budget = self._device_budget()
         while self.device_bytes > budget:
-            cands = [e for e in self._entries.values()
-                     if e.table is not None and e.key != keep]
-            if not cands:
-                cands = [e for e in self._entries.values()
-                         if e.table is not None]
-                if not cands:
-                    break
-            victim = min(cands, key=self._score)
+            victim = self._device_victim_locked(budget, keep)
+            if victim is None:
+                break
             self._c["evictions"] = self._c.get("evictions", 0) + 1
+            self._count_sess_locked(victim.session, "evicted")
             self._spill_locked(victim)
         host_budget = max(int(config.result_cache_host_bytes), 0)
         while self.host_bytes > host_budget:
@@ -598,6 +681,7 @@ class ResultCache:
                 break
             victim = min(cands, key=self._score)
             self._c["evictions"] = self._c.get("evictions", 0) + 1
+            self._count_sess_locked(victim.session, "evicted")
             self._drop_locked(victim)
 
     # -- store/lookup --------------------------------------------------------
@@ -612,9 +696,11 @@ class ResultCache:
             nbytes = int(table_device_bytes(table))
         except Exception:  # noqa: BLE001
             nbytes = 0
+        session = _current_session()
         with self._mu:
             if nbytes > self._device_budget():
                 self._c["rejected"] = self._c.get("rejected", 0) + 1
+                self._count_sess_locked(session, "rejected")
                 return
             old = self._entries.get(key)
             if old is not None:
@@ -626,6 +712,8 @@ class ResultCache:
             e.sources = sources
             e.visible = visible
             e.incr = incr
+            e.session = session
+            self._count_sess_locked(session, "records")
             self._entries[key] = e
             self._charge_locked(e, table, nbytes)
             self._by_raw.setdefault(raw, set()).add(key)
@@ -639,11 +727,13 @@ class ResultCache:
         entries rehydrate transparently."""
         if key is None or not config.result_cache:
             return None
+        session = _current_session()
         with self._mu:
             e = self._entries.get(key)
             if e is None:
                 self._c[prefix + "misses"] = \
                     self._c.get(prefix + "misses", 0) + 1
+                self._count_sess_locked(session, prefix + "misses")
                 return None
             e.hits += 1
             e.last_use = self._now()
@@ -655,8 +745,10 @@ class ResultCache:
                     self._drop_locked(e)
                     self._c[prefix + "misses"] = \
                         self._c.get(prefix + "misses", 0) + 1
+                    self._count_sess_locked(session, prefix + "misses")
                     return None
             self._c[prefix + "hits"] = self._c.get(prefix + "hits", 0) + 1
+            self._count_sess_locked(session, prefix + "hits")
             self.saved_wall_s += e.saved_wall_s
             return t
 
@@ -883,6 +975,7 @@ class ResultCache:
     def reset_stats(self) -> None:
         with self._mu:
             self._c.clear()
+            self._sess.clear()
             self.saved_wall_s = 0.0
 
     def stats(self) -> dict:
@@ -904,7 +997,22 @@ class ResultCache:
                      budget_bytes=self._device_budget(),
                      saved_wall_s=round(self.saved_wall_s, 6),
                      q_hit_rate=(qh / (qh + qm)) if (qh + qm) else 0.0,
-                     enabled=bool(config.result_cache))
+                     enabled=bool(config.result_cache),
+                     owner_pid=self._owner_pid)
+            by_dev = self._sess_dev_locked()
+            by_ent: Dict[str, int] = {}
+            for e in self._entries.values():
+                by_ent[e.session] = by_ent.get(e.session, 0) + 1
+            by = {}
+            for sid in set(self._sess) | set(by_ent):
+                row = dict(self._sess.get(sid, {}))
+                for k in ("q_hits", "q_misses", "hits", "misses",
+                          "evicted", "records", "rejected"):
+                    row.setdefault(k, 0)
+                row["entries"] = by_ent.get(sid, 0)
+                row["device_bytes"] = by_dev.get(sid, 0)
+                by[sid] = row
+            d["by_session"] = by
             return d
 
 
@@ -935,6 +1043,17 @@ def cache() -> ResultCache:
     global _cache
     with _cache_mu:
         if _cache is None:
+            _cache = ResultCache()
+        elif _cache._owner_pid != _os.getpid():
+            # fork detected: the inherited entries hold device buffers
+            # (and a governor grant) belonging to the PARENT's gang —
+            # serving them here would be silent cross-process sharing.
+            # Loudly start over; real sharing is ROADMAP item 4.
+            warnings.warn(
+                f"bodo_tpu result cache: pid changed "
+                f"({_cache._owner_pid} -> {_os.getpid()}); the cache is "
+                f"per-process/per-gang — starting a fresh empty cache",
+                RuntimeWarning, stacklevel=2)
             _cache = ResultCache()
         return _cache
 

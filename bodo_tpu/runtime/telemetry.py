@@ -145,10 +145,22 @@ def sample() -> dict:
     if rc is not None:
         try:
             rs = rc.stats()
+            budget = int(rs.get("budget_bytes", 0))
+            dev = int(rs.get("device_bytes", 0))
             s["result_cache"] = {
                 "entries": int(rs.get("entries", 0)),
-                "device_bytes": int(rs.get("device_bytes", 0)),
+                "device_bytes": dev,
                 "host_bytes": int(rs.get("host_bytes", 0)),
+                "budget_bytes": budget,
+                # occupancy + shed/eviction pressure: the admission
+                # controller (runtime/scheduler.py) reads cache
+                # pressure here without a full /metrics scrape
+                "occupancy_frac": round(dev / budget, 4) if budget
+                else 0.0,
+                "evictions": int(rs.get("evictions", 0)),
+                "pressure_sheds": int(rs.get("pressure_sheds", 0)),
+                "rejected": int(rs.get("rejected", 0)),
+                "spills": int(rs.get("spills", 0)),
                 "q_hits": int(rs.get("q_hits", 0)),
                 "q_misses": int(rs.get("q_misses", 0)),
                 "q_incremental": int(rs.get("q_incremental", 0)),
@@ -156,6 +168,22 @@ def sample() -> dict:
                 "saved_wall_s": round(float(
                     rs.get("saved_wall_s", 0.0)), 3),
             }
+        except Exception:
+            pass
+    sch = _mod("bodo_tpu.runtime.scheduler")
+    if sch is not None:
+        try:
+            ss = sch.stats()
+            if ss is not None:
+                s["scheduler"] = {
+                    "sessions": int(ss.get("sessions", 0)),
+                    "queued": int(ss.get("queued", 0)),
+                    "running": int(ss.get("running", 0)),
+                    "completed": int(ss.get("completed", 0)),
+                    "failed": int(ss.get("failed", 0)),
+                    "decisions": {k: int(v) for k, v in
+                                  ss.get("decisions", {}).items()},
+                }
         except Exception:
             pass
     fz = _mod("bodo_tpu.plan.fusion")
@@ -433,6 +461,42 @@ def health() -> dict:
                 }
             doc["xla_live_device_bytes"] = int(
                 ob.ledger_stats()["live_bytes"])
+        except Exception:
+            pass
+    rc = _mod("bodo_tpu.runtime.result_cache")
+    if rc is not None:
+        try:
+            rs = rc.stats()
+            budget = int(rs.get("budget_bytes", 0))
+            dev = int(rs.get("device_bytes", 0))
+            # occupancy/shed block: cache pressure for the admission
+            # controller without a full /metrics scrape. Like the storm
+            # flag it does NOT flip "status" — a full cache is load,
+            # not ill health
+            doc["result_cache"] = {
+                "device_bytes": dev,
+                "budget_bytes": budget,
+                "occupancy_frac": round(dev / budget, 4) if budget
+                else 0.0,
+                "entries": int(rs.get("entries", 0)),
+                "evictions": int(rs.get("evictions", 0)),
+                "pressure_sheds": int(rs.get("pressure_sheds", 0)),
+                "rejected": int(rs.get("rejected", 0)),
+            }
+        except Exception:
+            pass
+    sch = _mod("bodo_tpu.runtime.scheduler")
+    if sch is not None:
+        try:
+            ss = sch.stats()
+            if ss is not None:
+                doc["scheduler"] = {
+                    "sessions": int(ss.get("sessions", 0)),
+                    "queued": int(ss.get("queued", 0)),
+                    "running": int(ss.get("running", 0)),
+                    "decisions": {k: int(v) for k, v in
+                                  ss.get("decisions", {}).items()},
+                }
         except Exception:
             pass
     with _lock:

@@ -11,7 +11,10 @@ A plan-cache hit flows straight into the semantic result cache
 plan, so its structural fingerprint matches the one the result cache
 keyed the previous execution under — a repeat SQL query skips BOTH the
 parse and the execution. ``stats()`` exposes hit/miss counters for the
-metrics registry (bodo_tpu_sql_plan_cache_total).
+metrics registry (bodo_tpu_sql_plan_cache_total), totals plus a
+``by_session`` breakdown labeled with the serving session that issued
+the query (runtime/scheduler.py's contextvar; "-" outside the serving
+layer — bodo_tpu_sql_plan_cache_session_total).
 """
 
 from __future__ import annotations
@@ -19,29 +22,48 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import sys
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 from bodo_tpu.config import config
 
 _stats_mu = threading.Lock()
 _stats = {"hits": 0, "misses": 0}
+_by_session: Dict[str, Dict[str, int]] = {}
+
+
+def _session() -> str:
+    sch = sys.modules.get("bodo_tpu.runtime.scheduler")
+    if sch is None:
+        return "-"
+    try:
+        return sch.current_session() or "-"
+    except Exception:  # noqa: BLE001 - attribution is best-effort
+        return "-"
 
 
 def stats() -> dict:
     with _stats_mu:
-        return dict(_stats)
+        out = dict(_stats)
+        out["by_session"] = {sid: dict(row)
+                             for sid, row in _by_session.items()}
+        return out
 
 
 def reset_stats() -> None:
     with _stats_mu:
         _stats["hits"] = 0
         _stats["misses"] = 0
+        _by_session.clear()
 
 
 def _count(key: str) -> None:
+    sid = _session()
     with _stats_mu:
         _stats[key] += 1
+        row = _by_session.setdefault(sid, {"hits": 0, "misses": 0})
+        row[key] += 1
 
 
 def _key(query: str, schema_sig: str) -> str:

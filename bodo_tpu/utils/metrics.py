@@ -789,6 +789,19 @@ def sync_engine_metrics() -> None:
             gauge("bodo_tpu_result_cache_saved_seconds",
                   "wall seconds saved by serving cached results").set(
                 rs_.get("saved_wall_s", 0.0))
+            gauge("bodo_tpu_result_cache_budget_bytes",
+                  "device-byte budget of the result cache (admission "
+                  "reads occupancy = bytes/budget)").set(
+                rs_.get("budget_bytes", 0))
+            gs = gauge("bodo_tpu_result_cache_session_events_total",
+                       "per-session result cache events",
+                       ("session", "event"))
+            gsb = gauge("bodo_tpu_result_cache_session_bytes",
+                        "per-session resident device bytes", ("session",))
+            for sid, row in rs_.get("by_session", {}).items():
+                for ev in ("q_hits", "q_misses", "evicted", "records"):
+                    gs.labels(session=sid, event=ev).set(row.get(ev, 0))
+                gsb.labels(session=sid).set(row.get("device_bytes", 0))
         except Exception:  # pragma: no cover
             pass
     # -- sql plan cache (sql/plan_cache.py is stdlib-safe) -------------------
@@ -799,8 +812,45 @@ def sync_engine_metrics() -> None:
                   "persistent SQL plan cache lookups", ("result",))
         g.labels(result="hit").set(pc.get("hits", 0))
         g.labels(result="miss").set(pc.get("misses", 0))
+        gps = gauge("bodo_tpu_sql_plan_cache_session_total",
+                    "per-session SQL plan cache lookups",
+                    ("session", "result"))
+        for sid, row in pc.get("by_session", {}).items():
+            gps.labels(session=sid, result="hit").set(row.get("hits", 0))
+            gps.labels(session=sid, result="miss").set(
+                row.get("misses", 0))
     except Exception:  # pragma: no cover
         pass
+    # -- query scheduler (lazy-module rule: nothing to serve until the
+    # serving layer has loaded it anyway) ------------------------------------
+    sch = sys.modules.get("bodo_tpu.runtime.scheduler")
+    if sch is not None:
+        try:
+            ss = sch.stats()
+            if ss is not None:
+                gauge("bodo_tpu_serve_sessions",
+                      "open serving sessions").set(ss.get("sessions", 0))
+                gauge("bodo_tpu_serve_queued",
+                      "requests queued across all sessions").set(
+                    ss.get("queued", 0))
+                gauge("bodo_tpu_serve_running",
+                      "requests executing on the gang").set(
+                    ss.get("running", 0))
+                gauge("bodo_tpu_serve_workers",
+                      "live scheduler worker threads").set(
+                    ss.get("workers", 0))
+                gauge("bodo_tpu_serve_completed_total",
+                      "queries completed by the serving layer").set(
+                    ss.get("completed", 0))
+                gauge("bodo_tpu_serve_failed_total",
+                      "queries delivered as typed failures").set(
+                    ss.get("failed", 0))
+                gd = gauge("bodo_tpu_serve_decisions_total",
+                           "admission decisions by action", ("action",))
+                for action, n in ss.get("decisions", {}).items():
+                    gd.labels(action=action).set(n)
+        except Exception:  # pragma: no cover
+            pass
     # pallas_kernels imports jax — only read the counter if the module
     # is already loaded (never force a jax import from a metrics scrape)
     pk = sys.modules.get("bodo_tpu.ops.pallas_kernels")

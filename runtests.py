@@ -56,12 +56,14 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # test_result_cache.py mutates parquet datasets on disk, pins tiny
 # cache/governor budgets and asserts on the process-wide result-cache
 # counters, so it must not share a process with modules that execute
-# plans concurrently.
+# plans concurrently. test_scheduler.py owns the process-wide serving
+# scheduler singleton (worker threads, serve_* config, per-session
+# cache counters, an armed chaos fault), so it runs alone too.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
              "test_telemetry.py", "test_device_decode.py",
              "test_comm_observatory.py", "test_fused_join.py",
-             "test_result_cache.py")
+             "test_result_cache.py", "test_scheduler.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1708,6 +1708,290 @@ def _serve_multitenant(args, templates, novel_fn, data_dir) -> dict:
     return out
 
 
+def _serve_fleet(args, n_rows: int) -> dict:
+    """Fleet phases of --suite serve (``--gangs N``), driven through
+    the bodo_tpu.fleet client surface (runtime/fleet.py):
+
+    1. SCALING — the same repeat-template workload (8 distinct query
+       templates, each with its own routing key so consistent hashing
+       spreads them over the ring) runs against a 1-gang fleet and then
+       an N-gang fleet from ``--clients`` threads; the headline is
+       aggregate QPS scaling qps_N / qps_1 (acceptance bar > 1.5x for
+       N=2 on one box).
+    2. HIT RETENTION — with routing enabled, a warmed repeat round must
+       keep hitting each template's owner-gang result cache: aggregate
+       q_hit rate across gangs during the repeat rounds (bar >= 0.7).
+    3. MIXED SLO — a latency-class session (light repeats) shares the
+       fleet with throughput-class sessions flooding novel queries;
+       reports the latency-class p99.
+    4. CHAOS — a fresh fleet arms ``fleet.serve=kill`` in ONE gang via
+       the fault-injection registry and drives concurrent sessions:
+       the killed gang's in-flight queries must fail TYPED (QueryFailed
+       / rejection — never a hang or OOM), the controller must evict it
+       from the ring, and every survivor-routed query must complete."""
+    import shutil
+    import threading as th
+
+    import numpy as np
+    import pandas as pd
+
+    import bodo_tpu.fleet as fleet
+    from bodo_tpu.runtime.fleet import QueryFailed, ServeRejection
+
+    data_dir = os.path.join(_REPO, ".bench_data", f"fleet_{n_rows}")
+    shutil.rmtree(data_dir, ignore_errors=True)
+    os.makedirs(data_dir)
+    rng = np.random.default_rng(11)
+    n_parts = 4
+    for i in range(n_parts):
+        pd.DataFrame({
+            "k": rng.integers(0, 64, max(1000, n_rows // n_parts)
+                              ).astype(np.int64),
+            "v": rng.integers(0, 1_000_000, max(1000, n_rows // n_parts)
+                              ).astype(np.int64),
+            "w": rng.integers(0, 1000, max(1000, n_rows // n_parts)
+                              ).astype(np.int64),
+        }).to_parquet(os.path.join(data_dir, f"part-{i:05d}.parquet"))
+
+    def make_template(cut: int):
+        def tpl(d=data_dir, c=cut):
+            from bodo_tpu import pandas_api as bpd
+            df = bpd.read_parquet(d)
+            return df[df["w"] < c].groupby("k", as_index=False).agg(
+                s=("v", "sum"), c_=("v", "count")).to_pandas()
+        return tpl
+
+    # 8 distinct templates -> 8 routing keys spread over the ring
+    templates = [(f"tpl-{c}", make_template(c))
+                 for c in (125, 250, 375, 500, 625, 750, 875, 990)]
+    n_clients = max(int(args.clients), 2)
+    per_client = 60 if args.quick else 150
+    window = 8  # pipelined submits in flight per client
+
+    def agg_cache(ctl):
+        hits = misses = 0
+        for gid in list(ctl._gangs):
+            st = (ctl.gang_stats(gid) or {}).get("result_cache", {})
+            hits += int(st.get("q_hits", 0))
+            misses += int(st.get("q_misses", 0))
+        return hits, misses
+
+    def drive(label: str) -> dict:
+        """Warm every template once, then repeat rounds from n_clients
+        threads; returns qps + latency percentiles + hit retention."""
+        s = fleet.session(f"bench-{label}")
+        for key, fn in templates:
+            s.run(fn, key=key, timeout=180.0)
+        ctl = fleet.controller()
+        h0, m0 = agg_cache(ctl)
+        lats, errs = [], []
+        mu = th.Lock()
+
+        def client(ci: int):
+            # pipelined: keep `window` submits in flight so the fleet
+            # (not client round-trip latency) is the bottleneck
+            from collections import deque
+            sess = fleet.session(f"bench-{label}-c{ci}")
+            pending = deque()
+
+            def reap():
+                t0, fut = pending.popleft()
+                try:
+                    fut.result(timeout=120.0)
+                    with mu:
+                        lats.append(time.perf_counter() - t0)
+                except (ServeRejection, QueryFailed) as e:
+                    with mu:
+                        errs.append(type(e).__name__)
+
+            for j in range(per_client):
+                key, fn = templates[(ci + j) % len(templates)]
+                try:
+                    pending.append((time.perf_counter(),
+                                    sess.submit(fn, key=key)))
+                except (ServeRejection, QueryFailed) as e:
+                    with mu:
+                        errs.append(type(e).__name__)
+                    continue
+                if len(pending) >= window:
+                    reap()
+            while pending:
+                reap()
+
+        t0 = time.perf_counter()
+        threads = [th.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        wall = time.perf_counter() - t0
+        h1, m1 = agg_cache(ctl)
+        dh, dm = h1 - h0, m1 - m0
+        lats.sort()
+        return {
+            "requests": len(lats), "typed_errors": len(errs),
+            "wall_s": round(wall, 3),
+            "qps": round(len(lats) / wall, 2) if wall > 0 else 0.0,
+            "p50_s": round(lats[len(lats) // 2], 5) if lats else None,
+            "p99_s": round(lats[min(len(lats) - 1,
+                                    int(len(lats) * 0.99))], 5)
+            if lats else None,
+            "hit_rate": round(dh / (dh + dm), 4) if dh + dm else 0.0,
+        }
+
+    # -- phase 1+2: scaling + hit retention --------------------------------
+    fleet.start(gangs=1, timeout=180.0)
+    one = drive("g1")
+    fleet.stop()
+    fleet.start(gangs=args.gangs, timeout=180.0)
+    many = drive(f"g{args.gangs}")
+    scaling = (many["qps"] / one["qps"]) if one["qps"] else 0.0
+
+    # -- phase 3: mixed SLO on the warm N-gang fleet -----------------------
+    lat_sess = fleet.session("slo-lat", priority=1.0, slo="latency")
+    lat_lats = []
+    stop_flood = th.Event()
+
+    def flood(ci: int):
+        sess = fleet.session(f"slo-tp-{ci}", slo="throughput")
+        j = 0
+        while not stop_flood.is_set():
+            c = 13 + (ci * 997 + j * 131) % 960  # novel plan each time
+            try:
+                sess.run(make_template(c), key=f"novel-{ci}-{j}",
+                         timeout=120.0)
+            except (ServeRejection, QueryFailed):
+                pass
+            j += 1
+
+    flooders = [th.Thread(target=flood, args=(ci,))
+                for ci in range(max(n_clients - 1, 1))]
+    for t in flooders:
+        t.start()
+    for j in range(8 if args.quick else 16):
+        key, fn = templates[j % len(templates)]
+        t0 = time.perf_counter()
+        try:
+            lat_sess.run(fn, key=key, timeout=120.0)
+            lat_lats.append(time.perf_counter() - t0)
+        except (ServeRejection, QueryFailed):
+            pass
+    stop_flood.set()
+    for t in flooders:
+        t.join(timeout=180.0)
+    lat_lats.sort()
+    slo_p99 = lat_lats[min(len(lat_lats) - 1,
+                           int(len(lat_lats) * 0.99))] \
+        if lat_lats else None
+    fleet.stop()
+
+    # -- phase 4: chaos — kill one gang under concurrent sessions ----------
+    kill_after = 3
+    fleet.start(gangs=args.gangs, timeout=180.0,
+                gang_env={0: {"BODO_TPU_FAULTS":
+                              f"fleet.serve=kill:{kill_after}"}})
+    ctl = fleet.controller()
+    typed, completed, hung = [], [], []
+    mu = th.Lock()
+
+    def chaos_client(ci: int):
+        sess = fleet.session(f"chaos-{ci}")
+        for j in range(per_client):
+            key, fn = templates[(ci + j) % len(templates)]
+            for attempt in range(4):
+                try:
+                    sess.run(fn, key=key, timeout=120.0)
+                    with mu:
+                        completed.append(key)
+                    break
+                except QueryFailed as e:
+                    # in-flight loss on the killed gang: surfaced to
+                    # the client, never silently replayed
+                    with mu:
+                        typed.append(type(e).__name__)
+                    break
+                except ServeRejection as e:
+                    # backpressure: honor the retry hint like a real
+                    # client, bounded attempts
+                    with mu:
+                        typed.append(type(e).__name__)
+                    if attempt < 3:
+                        time.sleep(min(max(e.retry_after_s, 0.05),
+                                       2.0))
+                except Exception as e:  # noqa: BLE001 - untyped=fail
+                    with mu:
+                        hung.append(f"{type(e).__name__}: {e}")
+                    break
+
+    threads = [th.Thread(target=chaos_client, args=(ci,))
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    still_running = sum(t.is_alive() for t in threads)
+    st = fleet.controller().stats()
+    dead_gangs = [gid for gid, g in st["gangs"].items()
+                  if g["state"] == "dead"]
+    # after the eviction, routed queries must still succeed
+    post = fleet.session("chaos-post")
+    for key, fn in templates[:2]:
+        post.run(fn, key=key, timeout=120.0)
+    fleet.stop()
+    chaos_ok = (len(dead_gangs) == 1 and not hung
+                and still_running == 0 and len(completed) > 0)
+    from collections import Counter
+    chaos = {
+        "passed": bool(chaos_ok), "killed_gang": dead_gangs,
+        "typed_failures": len(typed),
+        "typed_kinds": dict(Counter(typed)),
+        "completed": len(completed),
+        "untyped_failures": hung, "clients_hung": still_running,
+        "rerouted": st["rerouted"], "gangs_evicted": st["gangs_evicted"],
+    }
+    if not chaos_ok:
+        raise RuntimeError(f"fleet chaos phase failed: {chaos}")
+
+    out = {
+        "gangs": args.gangs, "clients": n_clients,
+        "per_client": per_client,
+        # QPS scaling is process parallelism: it needs at least
+        # `gangs` cores to manifest. Recorded so a 1-core smoke box's
+        # flat scaling reads as environment, not regression.
+        "host_cpus": os.cpu_count() or 1,
+        "single": one, "fleet": many,
+        "qps_scaling": round(scaling, 3),
+        "hit_retention": many["hit_rate"],
+        "slo_latency_p99_s": round(slo_p99, 5)
+        if slo_p99 is not None else None,
+        "chaos": chaos,
+        "suites": {
+            "fleet_qps_scaling": {
+                "metric": "fleet_qps_scaling",
+                "value": round(scaling, 3), "unit": "x"},
+            "fleet_hit_retention": {
+                "metric": "fleet_hit_retention",
+                "value": many["hit_rate"], "unit": "hitrate"},
+            "fleet_slo_p99": {
+                "metric": "fleet_slo_p99_s",
+                "value": round(slo_p99, 5)
+                if slo_p99 is not None else 0.0, "unit": "s"},
+            # 1.0 = the chaos phase held (it raises otherwise)
+            "fleet_chaos": {
+                "metric": "fleet_chaos",
+                "value": 1.0 if chaos_ok else 0.0, "unit": "hitrate"},
+        },
+    }
+    print(f"serve fleet: {args.gangs} gangs scaled "
+          f"{one['qps']:.1f} -> {many['qps']:.1f} qps "
+          f"({scaling:.2f}x), hit retention {many['hit_rate']:.2f}, "
+          f"latency-SLO p99 {slo_p99 if slo_p99 else 0:.4f}s under "
+          f"flood; chaos: {len(typed)} typed / {len(completed)} "
+          f"completed, evicted {dead_gangs}", file=sys.stderr)
+    return out
+
+
 def bench_serve(args, n_rows: int):
     """--suite serve: the serving stack under repeat + multi-tenant
     traffic. Part one exercises the semantic result cache
@@ -1850,6 +2134,8 @@ def bench_serve(args, n_rows: int):
 
     st = rcache.stats()  # single-tenant mix snapshot (phase 3 resets)
     mt = _serve_multitenant(args, templates, novel, data_dir)
+    fl = _serve_fleet(args, n_rows) if getattr(args, "gangs", 0) > 1 \
+        else None
     detail = {
         "rows": n_rows, "parts_written": part_idx,
         "append_rows": append_rows, "rounds": rounds,
@@ -1874,6 +2160,7 @@ def bench_serve(args, n_rows: int):
                    "host_bytes", "budget_bytes")},
         "saved_wall_s": round(st["saved_wall_s"], 3),
         "multitenant": mt,
+        "fleet": fl,
         "probe": getattr(args, "probe", {"attempted": False}),
         # independently-watched series (benchwatch lifts these into
         # their own direction-aware trajectories)
@@ -1905,6 +2192,8 @@ def bench_serve(args, n_rows: int):
                 "unit": "hitrate"},
         },
     }
+    if fl is not None:
+        detail["suites"].update(fl.pop("suites"))
     print(f"serve: cold p50 {cold_p50:.4f}s repeat p50 "
           f"{repeat_p50:.5f}s speedup {speedup:.1f}x hit rate "
           f"{hit_rate:.2f} ({st['q_hits']}/{served}); refresh after "
@@ -2046,6 +2335,11 @@ def main():
     ap.add_argument("--clients", type=int, default=4,
                     help="serve: concurrent client sessions for the "
                          "multi-tenant phase (default 4)")
+    ap.add_argument("--gangs", type=int, default=0,
+                    help="serve: also run the fleet phases with N gang "
+                         "processes (QPS scaling vs 1 gang, routed "
+                         "cache hit retention, mixed-SLO p99, "
+                         "kill-one-gang chaos); 0/1 skips (default)")
     ap.add_argument("--explain", action="store_true",
                     help="taxi: EXPLAIN ANALYZE the plan-based pipeline "
                          "and run a --procs gang emitting one merged "

@@ -14,6 +14,7 @@ Public surfaces (mirroring the reference's four, plus serving):
   - `bodo_tpu.sql`         — SQL context (reference BodoSQL/bodosql/context.py:504)
   - `bodo_tpu.ml`          — distributed ML (reference bodo/ml_support/)
   - `bodo_tpu.serve`       — multi-tenant sessions over one resident gang
+  - `bodo_tpu.fleet`       — one controller, many gangs, peered caches
                              (runtime/scheduler.py)
 """
 
@@ -67,5 +68,8 @@ def __getattr__(name):
         return m
     if name == "serve":
         import bodo_tpu.serve as m
+        return m
+    if name == "fleet":
+        import bodo_tpu.fleet as m
         return m
     raise AttributeError(f"module 'bodo_tpu' has no attribute {name!r}")

@@ -412,6 +412,56 @@ class Config:
         default_factory=lambda: _env_float("BODO_TPU_SERVE_RETRY_AFTER",
                                            0.25)
     )
+    # Latency-bound SLO class: priority aging runs this many times
+    # faster for slo="latency" sessions, so their queued requests
+    # overtake throughput-bound traffic without starving it.
+    serve_latency_boost: float = field(
+        default_factory=lambda: _env_float(
+            "BODO_TPU_SERVE_LATENCY_BOOST", 4.0)
+    )
+    # -- fleet serving (runtime/fleet.py, bodo_tpu.fleet) ---------------------
+    # Stable identity of THIS gang process within a fleet. Set by the
+    # fleet controller in each gang's environment; empty outside fleet
+    # mode. Exported on set_config so result-cache ownership, metric
+    # labels and flight-recorder manifests all see the same id.
+    gang_id: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_GANG_ID", "")
+    )
+    # TCP port for the controller's client listener (-1 = in-process
+    # controller only, no listener; 0 = ephemeral).
+    fleet_port: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_FLEET_PORT", -1)
+    )
+    # Default gang count for fleet.start() when none is given.
+    fleet_gangs: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_FLEET_GANGS", 2)
+    )
+    # Controller scrape cadence of each gang's /metrics + /healthz.
+    fleet_scrape_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_FLEET_SCRAPE_S", 0.5)
+    )
+    # Hard cap on a single wire-protocol frame body; an oversized
+    # header is a typed ProtocolError, never an attempted allocation.
+    fleet_frame_max: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_FLEET_FRAME_MAX",
+                                         64 << 20)
+    )
+    # Cache peering: on a local result-cache miss the owning gang asks
+    # the fingerprint's previous owner before recomputing.
+    fleet_peering: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_FLEET_PEERING", True)
+    )
+    # Per-session in-flight quota at the controller; overflow is a
+    # typed Overloaded(reason="session_quota"), not an unbounded pile.
+    fleet_session_quota: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_FLEET_SESSION_QUOTA",
+                                         64)
+    )
+    # Consecutive failed scrapes before a gang is declared dead and
+    # evicted from the routing ring.
+    fleet_dead_scrapes: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_FLEET_DEAD_SCRAPES", 3)
+    )
     # -- resilience (runtime/resilience.py) ----------------------------------
     # Armed fault-injection spec (see resilience module docstring for the
     # grammar, e.g. "io.read=raise:OSError,collective=raise:Internal:1:0").
@@ -542,6 +592,20 @@ def set_config(**kwargs) -> None:
             sch = _sys.modules.get("bodo_tpu.runtime.scheduler")
             if sch is not None:
                 sch.reconfigure()
+        if k == "gang_id":
+            # export like faults so result-cache ownership, metric
+            # labels and spawned sub-workers see the same identity
+            if v:
+                os.environ["BODO_TPU_GANG_ID"] = v
+            else:
+                os.environ.pop("BODO_TPU_GANG_ID", None)
+        if k.startswith("fleet_"):
+            # re-apply knobs to a live controller (lazy: never imports
+            # the module just to reconfigure it)
+            import sys as _sys
+            fl = _sys.modules.get("bodo_tpu.runtime.fleet")
+            if fl is not None:
+                fl.reconfigure()
         if k == "stats_store_dir":
             # flush + drop the open store so the next lookup re-binds to
             # the new directory

@@ -205,6 +205,37 @@ def _triage_memory(telemetry: Optional[dict]) -> Optional[dict]:
     return out
 
 
+def _triage_fleet(telemetry: Optional[dict]) -> Optional[dict]:
+    """Fleet-controller triage from the bundle's telemetry samples:
+    name the gangs that were shed/degraded/backed-off or dead at the
+    last sample, plus routing/peering health counters."""
+    samples = (telemetry or {}).get("samples") or []
+    fleets = [s.get("fleet") for s in samples if s.get("fleet")]
+    if not fleets:
+        return None
+    last = fleets[-1]
+    gangs = last.get("gangs") or {}
+    out: dict = {
+        "gangs": len(gangs),
+        "by_state": {},
+        "rerouted": int(last.get("rerouted", 0)),
+        "scrape_failures": int(last.get("scrape_failures", 0)),
+        "peer_hits": int(last.get("peer_hits", 0)),
+        "invalidations_broadcast": int(
+            last.get("invalidations_broadcast", 0)),
+    }
+    unhealthy = []
+    for gid, g in sorted(gangs.items()):
+        state = g.get("state", "unknown")
+        out["by_state"][state] = out["by_state"].get(state, 0) + 1
+        if state != "ok":
+            unhealthy.append({"gang": gid, "state": state,
+                              "reason": g.get("reason")})
+    if unhealthy:
+        out["unhealthy_gangs"] = unhealthy
+    return out
+
+
 def _triage_xla(bundle: str) -> Optional[dict]:
     """Compile & device-memory triage from the bundle's registry dump:
     name the storming signature, rank retrace causes, surface the
@@ -259,6 +290,8 @@ def triage(bundle: str) -> dict:
         "time": manifest.get("iso_time"),
         "faults_armed": manifest.get("faults_armed", []),
     }
+    if manifest.get("gang_id"):
+        out["gang_id"] = manifest["gang_id"]
     ranks = manifest.get("ranks") or {}
     if ranks:
         out["ranks"] = ranks
@@ -271,8 +304,9 @@ def triage(bundle: str) -> dict:
     logs, arrivals = _parse_lockstep_logs(bundle)
     out["lockstep"] = _triage_lockstep(logs)
     out["comm"] = _triage_comm(logs, arrivals)
-    out["memory"] = _triage_memory(
-        _read_json(os.path.join(bundle, "telemetry.json")))
+    telem = _read_json(os.path.join(bundle, "telemetry.json"))
+    out["memory"] = _triage_memory(telem)
+    out["fleet"] = _triage_fleet(telem)
     out["xla"] = _triage_xla(bundle)
     slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
     out["slow_queries"] = [{"query_id": q.get("query_id"),
@@ -322,7 +356,8 @@ def render(t: dict) -> str:
     """Human-readable triage report."""
     lines = [f"FLIGHT RECORDER TRIAGE  {t['bundle']}",
              f"reason: {t['reason']}"
-             + (f"  at {t['time']}" if t.get("time") else "")]
+             + (f"  at {t['time']}" if t.get("time") else "")
+             + (f"  gang {t['gang_id']}" if t.get("gang_id") else "")]
     if t.get("faults_armed"):
         lines.append(f"faults armed: {', '.join(t['faults_armed'])}")
     for r, d in sorted(t.get("ranks", {}).items(), key=lambda kv:
@@ -403,6 +438,22 @@ def render(t: dict) -> str:
                 f"{_fmt_bytes(mem.get('spilled_bytes', 0))} in "
                 f"{mem.get('n_spills', 0)} spills, "
                 f"{mem.get('oom_retries', 0)} OOM retries")
+    fl = t.get("fleet")
+    if fl:
+        lines.append("fleet:")
+        states = ", ".join(f"{k}: {v}" for k, v in
+                           sorted(fl.get("by_state", {}).items()))
+        lines.append(
+            f"  {fl['gangs']} gangs ({states}); "
+            f"{fl.get('rerouted', 0)} rerouted submits, "
+            f"{fl.get('scrape_failures', 0)} scrape failures, "
+            f"{fl.get('peer_hits', 0)} peer cache hits, "
+            f"{fl.get('invalidations_broadcast', 0)} invalidation "
+            f"broadcasts")
+        for g in fl.get("unhealthy_gangs", []):
+            reason = f" ({g['reason']})" if g.get("reason") else ""
+            lines.append(f"  UNHEALTHY GANG {g['gang']}: "
+                         f"{g['state']}{reason}")
     x = t.get("xla")
     if x:
         lines.append("xla observatory:")

@@ -95,7 +95,7 @@ def _cfg(name: str, env: str, default, cast):
 # ---------------------------------------------------------------------------
 
 POINTS = ("collective", "device_put", "io.read", "io.write",
-          "spawn.worker_start", "stage.boundary")
+          "spawn.worker_start", "stage.boundary", "fleet.serve")
 
 
 class FaultInjected(RuntimeError):

@@ -63,13 +63,19 @@ tenant's within-share working set. ``stats()["by_session"]`` exposes
 per-session hit/miss/eviction/byte counters (the isolation assertion in
 ``bench.py --suite serve`` reads these).
 
-OWNERSHIP: the cache is PER-PROCESS and assumes the single resident
-gang of this process — device buffers in entries are only valid on the
-process that created them, and the byte accounting assumes one governor.
-``cache()`` asserts this: a fork (different pid) gets a loud warning and
-a fresh empty cache instead of silently serving another process's
-device handles. Cross-process / cross-gang sharing is future work
-(ROADMAP item 4 — the host tier is the natural exchange format).
+OWNERSHIP: the cache is PER-GANG — ownership is the (pid, gang_id)
+pair. Device buffers in entries are only valid on the process that
+created them, and the byte accounting assumes one governor. ``cache()``
+asserts this: a plain fork (different pid, same gang identity) gets a
+loud warning and a fresh empty cache instead of silently serving
+another process's device handles, while a legitimate fleet gang
+process (its own ``BODO_TPU_GANG_ID``) starts its private cache
+silently. Cross-gang sharing happens explicitly through the fleet
+peering tier (``set_peer_hooks`` / ``peer_export`` /
+``invalidate_paths`` — runtime/fleet.py): on a local miss the owning
+gang may import a peer's entry via the host pandas exchange format,
+and a dataset mutation on any gang broadcasts the mutated source
+paths so no peer ever serves a pre-mutation result.
 
 Everything is best-effort: a cache failure must cost a recompute, never
 the query.
@@ -417,6 +423,13 @@ def _classify_append(old_sigs, new_sigs):
 # the cache
 # --------------------------------------------------------------------------
 
+def _gang_id() -> str:
+    """This process's fleet gang identity ("" outside fleet mode). Read
+    from the environment, not config — ownership checks must agree with
+    what the fleet controller exported at spawn time."""
+    return _os.environ.get("BODO_TPU_GANG_ID", "")
+
+
 def _current_session() -> str:
     """Serving-session label for attribution ("-" outside the serving
     layer). Read via sys.modules.get — recording a cache entry must
@@ -472,6 +485,7 @@ class ResultCache:
         self._c: Dict[str, int] = {}
         self._sess: Dict[str, Dict[str, int]] = {}  # session -> counters
         self._owner_pid = _os.getpid()
+        self._owner_gang = _gang_id()
 
     # -- plumbing ------------------------------------------------------------
 
@@ -489,12 +503,16 @@ class ResultCache:
 
     def assert_single_gang_owner(self) -> None:
         """Hard ownership check: this cache's device buffers belong to
-        the process (and resident gang) that created them."""
-        if self._owner_pid != _os.getpid():
+        the (pid, gang_id) that created them."""
+        if (self._owner_pid, self._owner_gang) != \
+                (_os.getpid(), _gang_id()):
             raise AssertionError(
-                f"result cache owned by pid {self._owner_pid} used from "
-                f"pid {_os.getpid()}: device entries are per-process; "
-                f"cross-process sharing is ROADMAP item 4")
+                f"result cache owned by pid={self._owner_pid} "
+                f"gang={self._owner_gang or '-'} used from "
+                f"pid={_os.getpid()} gang={_gang_id() or '-'}: device "
+                f"entries are per-gang; fleet gangs each own a private "
+                f"cache (BODO_TPU_GANG_ID) and exchange results via "
+                f"the peering tier (runtime/fleet.py)")
 
     def _device_budget(self) -> int:
         b = int(config.result_cache_bytes)
@@ -820,13 +838,19 @@ class ResultCache:
                 if out is not None:
                     return out
                 # same plan over changed data and no clean splice: the
-                # stale entry can never be served again — drop it
+                # stale entry can never be served again — drop it, and
+                # tell the fleet (when peered) so no other gang serves
+                # its copy of the pre-mutation result
                 with self._mu:
                     if self._entries.get(prev.key) is prev:
                         self._drop_locked(prev)
                         self._c["invalidations"] = \
                             self._c.get("invalidations", 0) + 1
                     self._sync_grant_locked()
+                self._notify_invalidated(prev)
+            t = self._peer_fill(root, qi)
+            if t is not None:
+                return t
             return self._full_run(root, qi, run)
 
     def _full_run(self, root, qi, run):
@@ -909,6 +933,105 @@ class ResultCache:
         vis = prev.visible
         return merged.select(vis) if vis else merged
 
+    # -- fleet peering -------------------------------------------------------
+
+    def _peer_fill(self, root, qi):
+        """On a local q-miss, ask the fleet peering tier (when hooked)
+        for the fingerprint's previous owner's copy before recomputing.
+        A successful import is recorded locally like a fresh result, so
+        the NEXT repeat is a plain device hit."""
+        fetch = _peer_fetch
+        if fetch is None or not getattr(config, "fleet_peering", True):
+            return None
+        try:
+            payload = fetch(qi.key)
+        except Exception:  # noqa: BLE001 - peering is best-effort
+            payload = None
+        if not payload:
+            self.count("peer_misses")
+            return None
+        try:
+            from bodo_tpu.parallel import mesh as mesh_mod
+            from bodo_tpu.table.table import Table
+            t = Table.from_pandas(payload["df"])
+            if payload.get("dist") == "1D" and mesh_mod.num_shards() > 1:
+                t = t.shard()
+        except Exception:  # noqa: BLE001 - a bad payload costs a rerun
+            self.count("peer_misses")
+            return None
+        self.count("peer_hits")
+        vis = payload.get("visible")
+        self.record(qi.key, qi.raw, t,
+                    float(payload.get("saved_wall_s", 0.0)), kind="q",
+                    sources=qi.sigs, visible=vis)
+        _explain_rcache(root, t, {"event": "peer_hit"})
+        return t.select(vis) if vis else t
+
+    def peer_export(self, key):
+        """Serve a cached query entry to a peer gang in the host
+        exchange format (pandas + distribution/visibility metadata);
+        None on miss. The importer re-shards for its own mesh."""
+        if not config.result_cache:
+            return None
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None or e.kind != "q":
+                return None
+            try:
+                from bodo_tpu.table.table import ONED
+                df = e.host if e.host is not None \
+                    else e.table.to_pandas()
+                payload = {
+                    "df": df,
+                    "dist": "1D" if e.dist == ONED else "REP",
+                    "visible": e.visible,
+                    "saved_wall_s": e.saved_wall_s,
+                }
+            except Exception:  # noqa: BLE001 - export must never raise
+                return None
+            self._c["peer_serves"] = self._c.get("peer_serves", 0) + 1
+            return payload
+
+    def invalidate_paths(self, paths) -> int:
+        """Fleet invalidation broadcast receiver: drop every entry whose
+        source identities intersect ``paths`` (plus a conservative
+        repr-substring match for entries without structured sources).
+        Returns entries dropped; never re-broadcasts."""
+        if not paths:
+            return 0
+        pset = {str(p) for p in paths}
+        dropped = 0
+        with self._mu:
+            for e in list(self._entries.values()):
+                if e.sources:
+                    idents = {str(s[1]) for s in e.sources}
+                    hit = bool(idents & pset)
+                else:
+                    r = repr(e.raw)
+                    hit = any(p in r for p in pset)
+                if hit:
+                    self._drop_locked(e)
+                    dropped += 1
+            if dropped:
+                self._c["invalidations_remote"] = \
+                    self._c.get("invalidations_remote", 0) + dropped
+            self._sync_grant_locked()
+        return dropped
+
+    def _notify_invalidated(self, prev) -> None:
+        """Tell the fleet (when hooked) which source datasets just
+        invalidated a cached result, so the controller can broadcast
+        and no peer serves its pre-mutation copy."""
+        notify = _peer_notify
+        if notify is None:
+            return
+        try:
+            paths = tuple(str(s[1]) for s in (prev.sources or ()))
+            if paths:
+                notify(paths)
+        except Exception:  # noqa: BLE001 - peering is best-effort
+            pass
+
     # -- pressure / lifecycle ------------------------------------------------
 
     def shed_for_pressure(self) -> int:
@@ -984,7 +1107,9 @@ class ResultCache:
             for k in ("hits", "misses", "q_hits", "q_misses",
                       "q_incremental", "evictions", "invalidations",
                       "incremental_fallbacks", "spills", "rehydrations",
-                      "rejected", "sig_uncacheable", "pressure_sheds"):
+                      "rejected", "sig_uncacheable", "pressure_sheds",
+                      "peer_hits", "peer_misses", "peer_serves",
+                      "invalidations_remote"):
                 d.setdefault(k, 0)
             dev = sum(1 for e in self._entries.values()
                       if e.table is not None)
@@ -998,7 +1123,8 @@ class ResultCache:
                      saved_wall_s=round(self.saved_wall_s, 6),
                      q_hit_rate=(qh / (qh + qm)) if (qh + qm) else 0.0,
                      enabled=bool(config.result_cache),
-                     owner_pid=self._owner_pid)
+                     owner_pid=self._owner_pid,
+                     owner_gang=self._owner_gang)
             by_dev = self._sess_dev_locked()
             by_ent: Dict[str, int] = {}
             for e in self._entries.values():
@@ -1038,22 +1164,58 @@ def _explain_rcache(root, t, info: dict) -> None:
 _cache: Optional[ResultCache] = None
 _cache_mu = threading.Lock()
 
+# fleet peering hooks (runtime/fleet.py installs these on gang startup):
+# fetch(key) -> payload dict | None asks the fingerprint's previous
+# owner for its copy; notify(paths) reports a local mutation-driven
+# invalidation for fleet-wide broadcast. Module-level so a test (or a
+# fleet teardown) can unhook without touching the cache instance.
+_peer_fetch = None
+_peer_notify = None
+
+
+def set_peer_hooks(fetch=None, notify=None) -> None:
+    """Install (or clear, with Nones) the fleet peering hooks."""
+    global _peer_fetch, _peer_notify
+    with _cache_mu:
+        _peer_fetch = fetch
+        _peer_notify = notify
+
+
+def peer_export(key):
+    """Module façade: host-format payload for a cached query entry."""
+    return cache().peer_export(key)
+
+
+def invalidate_paths(paths) -> int:
+    """Module façade: apply a fleet invalidation broadcast."""
+    return cache().invalidate_paths(paths)
+
 
 def cache() -> ResultCache:
     global _cache
     with _cache_mu:
         if _cache is None:
             _cache = ResultCache()
-        elif _cache._owner_pid != _os.getpid():
-            # fork detected: the inherited entries hold device buffers
-            # (and a governor grant) belonging to the PARENT's gang —
-            # serving them here would be silent cross-process sharing.
-            # Loudly start over; real sharing is ROADMAP item 4.
-            warnings.warn(
-                f"bodo_tpu result cache: pid changed "
-                f"({_cache._owner_pid} -> {_os.getpid()}); the cache is "
-                f"per-process/per-gang — starting a fresh empty cache",
-                RuntimeWarning, stacklevel=2)
+        elif (_cache._owner_pid, _cache._owner_gang) != \
+                (_os.getpid(), _gang_id()):
+            # ownership changed: the inherited entries hold device
+            # buffers (and a governor grant) belonging to the OWNER's
+            # gang — serving them here would be silent cross-process
+            # sharing. A fleet gang process (its own BODO_TPU_GANG_ID,
+            # exported by the controller at spawn) legitimately starts
+            # its private cache without noise; a plain fork gets the
+            # loud warning.
+            gid = _gang_id()
+            if not (gid and gid != _cache._owner_gang):
+                warnings.warn(
+                    f"bodo_tpu result cache: owner changed "
+                    f"(pid {_cache._owner_pid} -> {_os.getpid()}, gang "
+                    f"{_cache._owner_gang or '-'} -> {gid or '-'}); the "
+                    f"cache is per-gang — starting a fresh empty cache. "
+                    f"Fleet gang processes should carry their own "
+                    f"BODO_TPU_GANG_ID (bodo_tpu.fleet sets this) and "
+                    f"share results via the peering tier instead",
+                    RuntimeWarning, stacklevel=2)
             _cache = ResultCache()
         return _cache
 

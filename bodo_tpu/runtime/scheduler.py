@@ -402,11 +402,16 @@ class Session:
     only written by worker threads between queries."""
 
     def __init__(self, sched: "Scheduler", sid: str, *,
-                 priority: float = 1.0, allow_degraded: bool = False):
+                 priority: float = 1.0, allow_degraded: bool = False,
+                 slo: str = "throughput"):
         self._sched = sched
         self.sid = sid
         self.weight = max(float(priority), 0.01)
         self.allow_degraded = bool(allow_degraded)
+        # SLO class: "latency" sessions age faster in _rank_locked so
+        # their queued head overtakes throughput-bound traffic.
+        self.slo = slo if slo in ("latency", "throughput") \
+            else "throughput"
         self.queue: deque = deque()
         self.vtime = 0.0              # served seconds / weight
         self.served_s = 0.0
@@ -435,6 +440,7 @@ class Session:
             return {
                 "session": self.sid,
                 "weight": self.weight,
+                "slo": self.slo,
                 "allow_degraded": self.allow_degraded,
                 "queued": len(self.queue),
                 "vtime_s": round(self.vtime, 6),
@@ -487,19 +493,22 @@ class Scheduler:
 
     def session(self, session_id: Optional[str] = None, *,
                 priority: float = 1.0,
-                allow_degraded: bool = False) -> Session:
+                allow_degraded: bool = False,
+                slo: str = "throughput") -> Session:
         """Open (or re-open) a session. Re-opening an existing id keeps
-        its queue/accounting but re-applies priority/degraded opt-in."""
+        its queue/accounting but re-applies priority/degraded/SLO."""
         with self._cv:
             sid = session_id or f"s{next(self._seq)}"
             s = self._sessions.get(sid)
             if s is None:
                 s = Session(self, sid, priority=priority,
-                            allow_degraded=allow_degraded)
+                            allow_degraded=allow_degraded, slo=slo)
                 self._sessions[sid] = s
             else:
                 s.weight = max(float(priority), 0.01)
                 s.allow_degraded = bool(allow_degraded)
+                s.slo = slo if slo in ("latency", "throughput") \
+                    else "throughput"
                 s.closed = False
             return s
 
@@ -535,12 +544,22 @@ class Scheduler:
                 self._decisions.get(exc.kind, 0) + 1
             session._count(f"rejected_{exc.kind}")
         try:
+            import os as _os
+
             from bodo_tpu.utils import metrics
+            names = ("kind", "session")
+            labels = {"kind": exc.kind, "session": session.sid}
+            gid = _os.environ.get("BODO_TPU_GANG_ID", "")
+            if gid:
+                # fleet gang: per-gang attribution on the scraped
+                # series (env is process-constant, so the label set
+                # never flips mid-registry)
+                names += ("gang",)
+                labels["gang"] = gid
             metrics.counter(
                 "bodo_tpu_serve_rejections_total",
                 "admission/backpressure rejections by kind",
-                ("kind", "session")).labels(
-                kind=exc.kind, session=session.sid).inc()
+                names).labels(**labels).inc()
         except Exception:  # noqa: BLE001
             pass
         raise exc
@@ -597,8 +616,12 @@ class Scheduler:
     def _rank_locked(self, s: Session, now: float) -> float:
         """Virtual-time rank with priority aging: every serve_aging_s
         seconds the head request has waited discounts one second of
-        accrued virtual time, so starvation is bounded."""
+        accrued virtual time, so starvation is bounded. Latency-class
+        sessions age serve_latency_boost× faster — their head overtakes
+        queued throughput traffic without zeroing its progress."""
         aging = max(float(config.serve_aging_s), 0.01)
+        if s.slo == "latency":
+            aging /= max(float(config.serve_latency_boost), 1.0)
         waited = now - s.queue[0].enq_ts
         return s.vtime - waited / aging
 

@@ -230,6 +230,17 @@ def sample() -> dict:
                 s["comm"] = sk
         except Exception:
             pass
+    gid = os.environ.get("BODO_TPU_GANG_ID", "")
+    if gid:
+        s["gang_id"] = gid
+    fl = _mod("bodo_tpu.runtime.fleet")
+    if fl is not None:
+        try:
+            fs = fl.controller_stats()
+            if fs is not None:
+                s["fleet"] = fs
+        except Exception:
+            pass
     return s
 
 
@@ -410,6 +421,11 @@ def health() -> dict:
         "time": round(time.time(), 3),
         "pid": os.getpid(),
     }
+    gid = os.environ.get("BODO_TPU_GANG_ID", "")
+    if gid:
+        # stable fleet identity: the controller's scrapes (and doctor
+        # triage) name gangs by this, not by pid/port
+        doc["gang_id"] = gid
     resil = _mod("bodo_tpu.runtime.resilience")
     if resil is not None:
         try:
@@ -497,6 +513,17 @@ def health() -> dict:
                     "decisions": {k: int(v) for k, v in
                                   ss.get("decisions", {}).items()},
                 }
+        except Exception:
+            pass
+    fl = _mod("bodo_tpu.runtime.fleet")
+    if fl is not None:
+        try:
+            fs = fl.controller_stats()
+            if fs is not None:
+                # per-gang attribution: which gangs this controller is
+                # fronting and what state each is in (ok/shed/degraded/
+                # backoff/dead) — doctor triage names gangs from here
+                doc["fleet"] = fs
         except Exception:
             pass
     with _lock:
@@ -610,6 +637,7 @@ def _write_manifest(d: str, reason: str,
         "ts": round(time.time(), 3),
         "iso_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "pid": os.getpid(),
+        "gang_id": os.environ.get("BODO_TPU_GANG_ID", ""),
         "rank": resil.current_rank() if resil is not None else None,
         "config": {f.name: getattr(config, f.name)
                    for f in _dc_fields(type(config))},

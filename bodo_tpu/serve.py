@@ -87,13 +87,16 @@ def drain(timeout: float = 30.0) -> bool:
 
 
 def session(session_id: Optional[str] = None, *, priority: float = 1.0,
-            allow_degraded: bool = False) -> Session:
+            allow_degraded: bool = False,
+            slo: str = "throughput") -> Session:
     """Open a logical session on the resident gang. ``priority`` is the
     fair-share weight (2.0 gets twice the gang of 1.0 under
     contention); ``allow_degraded`` opts into service while the gang
-    has unhealthy ranks."""
+    has unhealthy ranks; ``slo`` is the service class — ``"latency"``
+    ages serve_latency_boost× faster under contention,
+    ``"throughput"`` (default) takes the plain fair share."""
     return scheduler().session(session_id, priority=priority,
-                               allow_degraded=allow_degraded)
+                               allow_degraded=allow_degraded, slo=slo)
 
 
 def submit(fn: Callable, session_id: str = "default"):

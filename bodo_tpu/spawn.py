@@ -355,6 +355,14 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                     "BODO_TPU_COORD": coord,
                     "BODO_TPU_NPROCS": str(n_processes),
                     "BODO_TPU_PROC_ID": str(i),
+                    # stable gang identity: inherited when the spawner
+                    # is itself a fleet gang (so sub-workers attribute
+                    # to the owning gang), minted from the spawner pid
+                    # otherwise — controller logs, /healthz and doctor
+                    # output name gangs by this, never by pid/port
+                    "BODO_TPU_GANG_ID":
+                        os.environ.get("BODO_TPU_GANG_ID")
+                        or f"gang-{os.getpid()}",
                     "BODO_TPU_RESIL_PATH": resil_path,
                     "BODO_TPU_HB_PATH": hb_path,
                     # lockstep side-channel logs share the gang temp

@@ -29,6 +29,7 @@ bookkeeping on the hot event path.
 from __future__ import annotations
 
 import math
+import os
 import re
 import sys
 import threading
@@ -596,6 +597,35 @@ def record_compile(program: str, seconds: float) -> None:
               ("program",)).labels(program=program).observe(seconds)
 
 
+class _GangBound:
+    """Gauge façade that injects a constant ``gang`` label value into
+    every labels()/set() call (fleet gang processes only)."""
+
+    def __init__(self, g: Gauge, gid: str):
+        self._g, self._gid = g, gid
+
+    def labels(self, **kw) -> _Child:
+        kw["gang"] = self._gid
+        return self._g.labels(**kw)
+
+    def set(self, v: float) -> None:
+        self._g.labels(gang=self._gid).set(v)
+
+
+def _gang_gauge(name: str, help: str = "",
+                labelnames: Sequence[str] = ()):
+    """Gauge that grows a ``gang`` label when this process is a fleet
+    gang (BODO_TPU_GANG_ID set at spawn): the controller's scrapes then
+    attribute per-gang series unambiguously. Outside fleet mode the
+    series keeps its classic shape — the env is set for the process's
+    whole life, so the label set never flips mid-registry."""
+    gid = os.environ.get("BODO_TPU_GANG_ID", "")
+    if not gid:
+        return gauge(name, help, labelnames)
+    return _GangBound(gauge(name, help, tuple(labelnames) + ("gang",)),
+                      gid)
+
+
 def sync_engine_metrics() -> None:
     """Pull every subsystem's stats snapshot into the registry. Cheap
     (a few dict copies); called by snapshot()/expose_text() and by
@@ -770,34 +800,38 @@ def sync_engine_metrics() -> None:
     if rc is not None:
         try:
             rs_ = rc.stats()
-            g = gauge("bodo_tpu_result_cache_events_total",
-                      "semantic result cache events", ("event",))
+            g = _gang_gauge("bodo_tpu_result_cache_events_total",
+                            "semantic result cache events", ("event",))
             for k in ("hits", "misses", "q_hits", "q_misses",
                       "q_incremental", "evictions", "invalidations",
                       "incremental_fallbacks", "spills", "rehydrations",
-                      "rejected", "sig_uncacheable", "pressure_sheds"):
+                      "rejected", "sig_uncacheable", "pressure_sheds",
+                      "peer_hits", "peer_misses", "peer_serves",
+                      "invalidations_remote"):
                 g.labels(event=k).set(rs_.get(k, 0))
-            gb = gauge("bodo_tpu_result_cache_bytes",
-                       "resident result-cache bytes per tier", ("tier",))
+            gb = _gang_gauge("bodo_tpu_result_cache_bytes",
+                             "resident result-cache bytes per tier",
+                             ("tier",))
             gb.labels(tier="device").set(rs_.get("device_bytes", 0))
             gb.labels(tier="host").set(rs_.get("host_bytes", 0))
-            ge2 = gauge("bodo_tpu_result_cache_entries",
-                        "resident result-cache entries per tier",
-                        ("tier",))
+            ge2 = _gang_gauge("bodo_tpu_result_cache_entries",
+                              "resident result-cache entries per tier",
+                              ("tier",))
             ge2.labels(tier="device").set(rs_.get("device_entries", 0))
             ge2.labels(tier="host").set(rs_.get("host_entries", 0))
-            gauge("bodo_tpu_result_cache_saved_seconds",
-                  "wall seconds saved by serving cached results").set(
-                rs_.get("saved_wall_s", 0.0))
-            gauge("bodo_tpu_result_cache_budget_bytes",
-                  "device-byte budget of the result cache (admission "
-                  "reads occupancy = bytes/budget)").set(
-                rs_.get("budget_bytes", 0))
-            gs = gauge("bodo_tpu_result_cache_session_events_total",
-                       "per-session result cache events",
-                       ("session", "event"))
-            gsb = gauge("bodo_tpu_result_cache_session_bytes",
-                        "per-session resident device bytes", ("session",))
+            _gang_gauge("bodo_tpu_result_cache_saved_seconds",
+                        "wall seconds saved by serving cached "
+                        "results").set(rs_.get("saved_wall_s", 0.0))
+            _gang_gauge("bodo_tpu_result_cache_budget_bytes",
+                        "device-byte budget of the result cache "
+                        "(admission reads occupancy = "
+                        "bytes/budget)").set(rs_.get("budget_bytes", 0))
+            gs = _gang_gauge("bodo_tpu_result_cache_session_events_total",
+                             "per-session result cache events",
+                             ("session", "event"))
+            gsb = _gang_gauge("bodo_tpu_result_cache_session_bytes",
+                              "per-session resident device bytes",
+                              ("session",))
             for sid, row in rs_.get("by_session", {}).items():
                 for ev in ("q_hits", "q_misses", "evicted", "records"):
                     gs.labels(session=sid, event=ev).set(row.get(ev, 0))
@@ -828,25 +862,27 @@ def sync_engine_metrics() -> None:
         try:
             ss = sch.stats()
             if ss is not None:
-                gauge("bodo_tpu_serve_sessions",
-                      "open serving sessions").set(ss.get("sessions", 0))
-                gauge("bodo_tpu_serve_queued",
-                      "requests queued across all sessions").set(
+                _gang_gauge("bodo_tpu_serve_sessions",
+                            "open serving sessions").set(
+                    ss.get("sessions", 0))
+                _gang_gauge("bodo_tpu_serve_queued",
+                            "requests queued across all sessions").set(
                     ss.get("queued", 0))
-                gauge("bodo_tpu_serve_running",
-                      "requests executing on the gang").set(
+                _gang_gauge("bodo_tpu_serve_running",
+                            "requests executing on the gang").set(
                     ss.get("running", 0))
-                gauge("bodo_tpu_serve_workers",
-                      "live scheduler worker threads").set(
+                _gang_gauge("bodo_tpu_serve_workers",
+                            "live scheduler worker threads").set(
                     ss.get("workers", 0))
-                gauge("bodo_tpu_serve_completed_total",
-                      "queries completed by the serving layer").set(
+                _gang_gauge("bodo_tpu_serve_completed_total",
+                            "queries completed by the serving layer").set(
                     ss.get("completed", 0))
-                gauge("bodo_tpu_serve_failed_total",
-                      "queries delivered as typed failures").set(
+                _gang_gauge("bodo_tpu_serve_failed_total",
+                            "queries delivered as typed failures").set(
                     ss.get("failed", 0))
-                gd = gauge("bodo_tpu_serve_decisions_total",
-                           "admission decisions by action", ("action",))
+                gd = _gang_gauge("bodo_tpu_serve_decisions_total",
+                                 "admission decisions by action",
+                                 ("action",))
                 for action, n in ss.get("decisions", {}).items():
                     gd.labels(action=action).set(n)
         except Exception:  # pragma: no cover

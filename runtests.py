@@ -59,11 +59,16 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # plans concurrently. test_scheduler.py owns the process-wide serving
 # scheduler singleton (worker threads, serve_* config, per-session
 # cache counters, an armed chaos fault), so it runs alone too.
+# test_fleet.py owns real subprocess gangs (ports, the fleet
+# controller singleton, fault-injected gang deaths, process-wide
+# result-cache ownership env), so it runs alone; wall time is bounded
+# by the same per-group watchdog as every other group.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
              "test_telemetry.py", "test_device_decode.py",
              "test_comm_observatory.py", "test_fused_join.py",
-             "test_result_cache.py", "test_scheduler.py")
+             "test_result_cache.py", "test_scheduler.py",
+             "test_fleet.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,504 @@
+"""Fleet serving (runtime/fleet.py + the bodo_tpu.fleet façade).
+
+Covers the wire protocol against hostile input (truncated frames,
+oversized headers, bad kinds — typed ProtocolError, never a dead or
+wedged gang), the consistent-hash ring invariants (only ~1/N of the
+keyspace moves on join/leave; previous-owner peer hints), typed-error
+round-tripping, end-to-end serving over real gang processes (routing,
+repeat cache hits on the owner gang, session quotas, gang identity in
+/healthz + as a label on scraped metric series), the scale-out peering
+path (a moved key's first miss fills from the previous owner), THE
+cross-gang staleness regression (a dataset mutation on one gang must
+invalidate peered entries fleet-wide — no gang serves a pre-mutation
+result), chaos (the fault-injection registry kills one gang mid-stream
+under concurrent sessions: its in-flight queries fail typed, the
+controller evicts it, survivors keep serving), and the (pid, gang_id)
+result-cache ownership fix for legitimate fleet gang processes.
+
+Runs ISOLATED (runtests.py): owns real subprocess gangs, binds ports,
+and mutates process-wide env/caches. Wall time is bounded by the
+per-group watchdog.
+"""
+
+import glob
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bodo_tpu.fleet as fleet
+from bodo_tpu.runtime import fleet as flr
+from bodo_tpu.runtime import result_cache as rcache
+from bodo_tpu.runtime.fleet import (
+    BackOff,
+    Degraded,
+    Overloaded,
+    ProtocolError,
+    QueryFailed,
+    ServeRejection,
+    _exc_from_wire,
+    _exc_to_wire,
+    _HDR,
+    _KIND_JSON,
+    _Ring,
+    _recv_frame,
+    _send_frame,
+    _send_json,
+    _recv_json,
+)
+
+# the protocol/ring/ownership units below run in tier-1; everything
+# that spawns real gang processes is marked slow (tier-2)
+_live = pytest.mark.slow
+
+
+# ---------------------------------------------------------------------------
+# wire protocol vs hostile input (no gangs needed)
+# ---------------------------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_roundtrip():
+    a, b = _pair()
+    _send_json(a, {"op": "ping", "x": 1})
+    assert _recv_json(b) == {"op": "ping", "x": 1}
+    a.close(), b.close()
+
+
+def test_truncated_header_is_typed():
+    a, b = _pair()
+    a.sendall(b"\x00\x00")  # 2 of 5 header bytes, then EOF
+    a.close()
+    with pytest.raises(ProtocolError, match="truncated"):
+        _recv_frame(b)
+    b.close()
+
+
+def test_truncated_body_is_typed():
+    a, b = _pair()
+    a.sendall(_HDR.pack(100, _KIND_JSON) + b"only a few")
+    a.close()
+    with pytest.raises(ProtocolError, match="truncated"):
+        _recv_frame(b)
+    b.close()
+
+
+def test_oversized_frame_rejected_before_allocation():
+    from bodo_tpu.config import config
+    a, b = _pair()
+    # an adversarial header claiming a frame far past the bound
+    a.sendall(_HDR.pack(int(config.fleet_frame_max) + 1, _KIND_JSON))
+    with pytest.raises(ProtocolError, match="oversized"):
+        _recv_frame(b)
+    a.close(), b.close()
+
+
+def test_unknown_kind_byte_is_typed():
+    a, b = _pair()
+    a.sendall(struct.pack(">IB", 4, 0xFF) + b"abcd")
+    with pytest.raises(ProtocolError, match="kind"):
+        _recv_frame(b)
+    a.close(), b.close()
+
+
+def test_bad_json_body_is_typed():
+    a, b = _pair()
+    _send_frame(a, _KIND_JSON, b"not json at all")
+    with pytest.raises(ProtocolError, match="JSON"):
+        _recv_json(b)
+    a.close(), b.close()
+
+
+def test_typed_errors_roundtrip_the_wire():
+    for exc in (Overloaded("q full", retry_after_s=1.5, reason="queue"),
+                Degraded("2 ranks down", retry_after_s=3.0,
+                         reason="unhealthy"),
+                BackOff("storm", retry_after_s=0.5, reason="storm")):
+        back = _exc_from_wire(_exc_to_wire(exc))
+        assert type(back) is type(exc)
+        assert back.retry_after_s == exc.retry_after_s
+        assert back.reason == exc.reason
+    qf = QueryFailed("s1", "q9", RuntimeError("boom"))
+    back = _exc_from_wire(_exc_to_wire(qf))
+    assert isinstance(back, QueryFailed)
+    assert back.session_id == "s1" and back.query_id == "q9"
+    assert "boom" in str(back.__cause__)
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_join_moves_about_one_over_n():
+    r = _Ring(vnodes=64)
+    for i in range(3):
+        r.add(f"gang-{i}")
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: r.owner(k) for k in keys}
+    r.add("gang-3")
+    moved = sum(1 for k in keys if r.owner(k) != before[k])
+    # joining the 4th gang should claim ~1/4 of the keyspace; naive
+    # modulo hashing would move ~3/4
+    assert 0.10 < moved / len(keys) < 0.45
+    # every moved key moved TO the new gang, and its prev_owner names
+    # the gang that held it before the join
+    for k in keys:
+        if r.owner(k) != before[k]:
+            assert r.owner(k) == "gang-3"
+            assert r.prev_owner(k) == before[k]
+
+
+def test_ring_leave_moves_only_departed_keys():
+    r = _Ring(vnodes=64)
+    for i in range(4):
+        r.add(f"gang-{i}")
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: r.owner(k) for k in keys}
+    r.remove("gang-2")
+    for k in keys:
+        if before[k] != "gang-2":
+            assert r.owner(k) == before[k]  # survivors keep their keys
+        else:
+            assert r.owner(k) != "gang-2"
+
+
+def test_ring_successors_distinct_and_complete():
+    r = _Ring(vnodes=16)
+    for i in range(3):
+        r.add(f"gang-{i}")
+    succ = r.successors("some-key")
+    assert sorted(succ) == ["gang-0", "gang-1", "gang-2"]
+    assert succ[0] == r.owner("some-key")
+
+
+# ---------------------------------------------------------------------------
+# result-cache ownership: (pid, gang_id), not pid alone
+# ---------------------------------------------------------------------------
+
+
+def test_fork_guard_not_fired_for_fleet_gangs(monkeypatch):
+    """Satellite 2: a legitimate fleet gang (fresh BODO_TPU_GANG_ID)
+    must get a silent fresh cache, not the single-gang RuntimeWarning."""
+    c0 = rcache.cache()
+    monkeypatch.setenv("BODO_TPU_GANG_ID", f"gang-test-{os.getpid()}")
+    monkeypatch.setattr(rcache._cache, "_owner_gang", "gang-other")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning -> test failure
+        c1 = rcache.cache()
+    assert c1 is not c0
+    assert c1._owner_gang == os.environ["BODO_TPU_GANG_ID"]
+    # re-own the fresh cache once the patched env goes away, so later
+    # modules sharing this process don't see a spurious ownership
+    # change (a mid-suite reset wipes per-session cache stats)
+    monkeypatch.undo()
+    c1._owner_gang = rcache._gang_id()
+
+
+# ---------------------------------------------------------------------------
+# live fleets
+# ---------------------------------------------------------------------------
+
+
+def _mk_dataset(d: str, n_parts: int = 3, rows: int = 400) -> None:
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(5)
+    for i in range(n_parts):
+        pd.DataFrame({
+            "k": rng.integers(0, 8, rows).astype(np.int64),
+            "v": rng.integers(-50, 1000, rows).astype(np.int64),
+        }).to_parquet(os.path.join(d, f"part-{i:05d}.parquet"))
+
+
+def _groupby_thunk(d: str):
+    def q(d=d):
+        import bodo_tpu.pandas_api as bpd
+        df = bpd.read_parquet(d)
+        return df.groupby("k", as_index=False).agg(
+            s=("v", "sum"), c=("v", "count")).to_pandas()
+    return q
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    return df.sort_values("k").reset_index(drop=True)
+
+
+@pytest.fixture(scope="module")
+def fleet2(tmp_path_factory):
+    """One 2-gang fleet shared by the read-only integration tests."""
+    ctl = fleet.start(gangs=2, timeout=240.0)
+    yield ctl
+    fleet.stop()
+
+
+@_live
+def test_submit_roundtrip_and_routing(fleet2):
+    s = fleet.session("it-basic")
+    assert s.run(lambda: 40 + 2, timeout=120.0) == 42
+    # explicit keys land on their ring owner deterministically
+    ring = fleet2._ring
+    keys_by_gang = {}
+    for i in range(64):
+        keys_by_gang.setdefault(ring.owner(f"rk-{i}"),
+                                []).append(f"rk-{i}")
+    assert len(keys_by_gang) == 2  # both gangs own some keyspace
+
+
+@_live
+def test_repeat_hits_owner_gang_cache(fleet2, tmp_path):
+    d = str(tmp_path / "ds_hit")
+    _mk_dataset(d)
+    s = fleet.session("it-cache")
+    q = _groupby_thunk(d)
+    r1 = s.run(q, key="hit-key", timeout=180.0)
+    owner = fleet2._ring.owner("hit-key")
+    before = fleet.gang_stats(owner)["result_cache"]
+    r2 = s.run(q, key="hit-key", timeout=120.0)
+    after = fleet.gang_stats(owner)["result_cache"]
+    assert after["q_hits"] == before["q_hits"] + 1
+    pd.testing.assert_frame_equal(_norm(r1), _norm(r2))
+
+
+@_live
+def test_session_quota_is_typed(fleet2):
+    from bodo_tpu.config import set_config
+    set_config(fleet_session_quota=2)
+    try:
+        s = fleet.session("it-quota")
+        futs = [s.submit(lambda: time.sleep(0.5) or 1)
+                for _ in range(2)]
+        with pytest.raises(Overloaded) as ei:
+            s.submit(lambda: 2)
+        assert ei.value.reason == "session_quota"
+        assert ei.value.retry_after_s > 0
+        assert [f.result(timeout=60.0) for f in futs] == [1, 1]
+    finally:
+        set_config(fleet_session_quota=64)
+
+
+@_live
+def test_gang_identity_in_healthz_and_metric_labels(fleet2):
+    """Satellite 1: stable gang_id in /healthz and as a label on the
+    scraped bodo_tpu_serve_* / bodo_tpu_result_cache_* series."""
+    s = fleet.session("it-ident")
+    s.run(lambda: 1, timeout=120.0)
+    for gid, g in fleet2._gangs.items():
+        with urllib.request.urlopen(
+                f"http://{g.telemetry_addr}/healthz", timeout=10.0) as r:
+            h = json.loads(r.read().decode())
+        assert h.get("gang_id") == gid
+        with urllib.request.urlopen(
+                f"http://{g.telemetry_addr}/metrics", timeout=10.0) as r:
+            met = r.read().decode()
+        assert f'gang="{gid}"' in met
+        assert "bodo_tpu_serve_sessions" in met
+
+
+@_live
+def test_controller_stats_and_telemetry_block(fleet2):
+    st = fleet.stats()
+    assert set(st["gangs"]) == set(fleet2._ring.members())
+    for g in st["gangs"].values():
+        assert g["state"] in ("ok", "shed", "degraded", "backoff")
+    # the controller process's own telemetry sample carries the block
+    from bodo_tpu.runtime import telemetry
+    samp = telemetry.sample()
+    assert "fleet" in samp and "gangs" in samp["fleet"]
+
+
+@_live
+def test_doctor_triage_names_gangs(fleet2):
+    from bodo_tpu.doctor import _triage_fleet
+    tri = _triage_fleet({"samples": [{"fleet": fleet.stats()}]})
+    assert tri["gangs"] == 2
+    assert "by_state" in tri
+
+
+@_live
+def test_hostile_frames_do_not_kill_gang(fleet2):
+    g = next(iter(fleet2._gangs.values()))
+    host, port = g.serve_addr.rsplit(":", 1)
+    # oversized header: typed ProtocolError response
+    with socket.create_connection((host, int(port)), timeout=10.0) as s:
+        s.sendall(_HDR.pack(1 << 30, _KIND_JSON))
+        resp = _recv_json(s)
+        assert resp["etype"] == "ProtocolError"
+    # truncated frame: close mid-body — gang must just drop the conn
+    with socket.create_connection((host, int(port)), timeout=10.0) as s:
+        s.sendall(_HDR.pack(64, _KIND_JSON) + b"half")
+    # the gang is still alive and serving
+    with socket.create_connection((host, int(port)), timeout=10.0) as s:
+        _send_json(s, {"op": "ping"})
+        assert _recv_json(s)["ok"] is True
+
+
+@_live
+def test_unpicklable_submit_is_typed(fleet2):
+    s = fleet.session("it-pickle")
+    with pytest.raises((QueryFailed, ServeRejection, ProtocolError,
+                        Exception)):
+        # a thunk returning an unpicklable value fails typed, not hung
+        s.run(lambda: (_ for _ in ()), timeout=120.0)
+
+
+# ---------------------------------------------------------------------------
+# scale-out peering + THE cross-gang staleness regression
+# ---------------------------------------------------------------------------
+
+
+@_live
+def test_scaleout_peering_and_fleetwide_invalidation(tmp_path):
+    d = str(tmp_path / "ds_peer")
+    _mk_dataset(d)
+    q = _groupby_thunk(d)
+    fleet.stop()  # the module fixture's fleet, if it is still up
+    ctl = fleet.start(gangs=1, timeout=240.0)
+    try:
+        s = fleet.session("peer")
+        r1 = s.run(q, key="P", timeout=180.0)
+
+        # scale out; pick a key the NEW gang owns — its previous owner
+        # (gang-0) holds the warm entry
+        new_gid = ctl.add_gang(timeout=240.0)
+        key = next(f"P{i}" for i in range(1000)
+                   if ctl._ring.owner(f"P{i}") == new_gid)
+        assert ctl._ring.prev_owner(key) == "gang-0"
+        r2 = s.run(q, key=key, timeout=180.0)
+        pd.testing.assert_frame_equal(_norm(r1), _norm(r2))
+        new_rc = fleet.gang_stats(new_gid)["result_cache"]
+        old_rc = fleet.gang_stats("gang-0")["result_cache"]
+        assert new_rc["peer_hits"] >= 1       # filled from the peer...
+        assert old_rc["peer_serves"] >= 1     # ...which served it
+
+        # THE staleness regression: mutate the dataset, re-run on the
+        # owner — every OTHER gang must drop its peered entry too
+        part0 = sorted(glob.glob(os.path.join(d, "*.parquet")))[0]
+        rng = np.random.default_rng(17)
+        pd.DataFrame({
+            "k": rng.integers(0, 8, 437).astype(np.int64),
+            "v": rng.integers(-50, 1000, 437).astype(np.int64),
+        }).to_parquet(part0)
+        r3 = s.run(q, key=key, timeout=180.0)
+        assert not _norm(r3).equals(_norm(r2))
+
+        st = ctl.stats()
+        assert st["invalidations_broadcast"] >= 1
+        g0 = fleet.gang_stats("gang-0")["result_cache"]
+        assert g0["invalidations_remote"] >= 1
+
+        # no gang serves a pre-mutation result: route the same query
+        # to EACH gang and compare against the post-mutation oracle
+        paths = sorted(glob.glob(os.path.join(d, "*.parquet")))
+        oracle = _norm(pd.concat(
+            [pd.read_parquet(p) for p in paths],
+            ignore_index=True).groupby("k", as_index=False).agg(
+                s=("v", "sum"), c=("v", "count")))
+        for gid in list(ctl._gangs):
+            k = next(f"S{i}" for i in range(1000)
+                     if ctl._ring.owner(f"S{i}") == gid)
+            got = _norm(s.run(q, key=k, timeout=180.0))
+            pd.testing.assert_frame_equal(
+                got, oracle, check_exact=True, check_dtype=False)
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill one gang mid-stream under concurrent sessions
+# ---------------------------------------------------------------------------
+
+
+@_live
+def test_gang_death_midstream_is_typed_and_evicted(tmp_path):
+    """Satellite 4: the fault registry kills gang-0 after its 2nd
+    fleet.serve injection — after the ack, before the result, so the
+    client observes a mid-stream EOF. It must surface as a typed
+    QueryFailed, the controller must evict the gang, other sessions
+    must keep serving, and re-routed queries must complete."""
+    ctl = fleet.start(
+        gangs=2, timeout=240.0,
+        gang_env={0: {"BODO_TPU_FAULTS": "fleet.serve=kill:2"}})
+    try:
+        ring = ctl._ring
+        key0 = next(f"C{i}" for i in range(1000)
+                    if ring.owner(f"C{i}") == "gang-0")
+        key1 = next(f"C{i}" for i in range(1000)
+                    if ring.owner(f"C{i}") == "gang-1")
+
+        typed, completed, untyped = [], [], []
+        mu = threading.Lock()
+
+        def client(ci: int, key: str):
+            s = fleet.session(f"chaos-{ci}")
+            for j in range(4):
+                try:
+                    s.run(lambda: 7 * 6, key=key, timeout=120.0)
+                    with mu:
+                        completed.append((ci, j))
+                except (ServeRejection, QueryFailed):
+                    with mu:
+                        typed.append((ci, j))
+                except Exception as e:  # noqa: BLE001
+                    with mu:
+                        untyped.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client,
+                                    args=(ci, key0 if ci % 2 == 0
+                                          else key1))
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        assert not any(t.is_alive() for t in threads), "client hung"
+        assert untyped == []            # every failure was typed
+        assert len(typed) >= 1          # the kill was observed
+        assert len(completed) >= 1      # survivors kept serving
+
+        st = ctl.stats()
+        assert st["gangs"]["gang-0"]["state"] == "dead"
+        assert ctl._ring.members() == ["gang-1"]
+
+        # the dead gang's keyspace re-routes and completes
+        s = fleet.session("chaos-post")
+        assert s.run(lambda: 5, key=key0, timeout=120.0) == 5
+        assert ctl.stats()["gangs_evicted"] >= 1
+
+        # doctor triage names the dead gang
+        from bodo_tpu.doctor import _triage_fleet
+        tri = _triage_fleet({"samples": [{"fleet": ctl.stats()}]})
+        assert any(u["gang"] == "gang-0"
+                   for u in tri["unhealthy_gangs"])
+    finally:
+        fleet.stop()
+
+
+@_live
+def test_all_gangs_bad_is_typed_rejection():
+    """With every gang evicted the client must get a typed rejection
+    carrying a retry hint — never a hang."""
+    fleet.stop()
+    ctl = fleet.start(gangs=1, timeout=240.0)
+    try:
+        with ctl._mu:
+            ctl._mark_dead_locked(ctl._gangs["gang-0"], "test")
+        s = fleet.session("dead-fleet")
+        with pytest.raises(Overloaded) as ei:
+            s.submit(lambda: 1).result(timeout=60.0)
+        assert ei.value.retry_after_s > 0
+    finally:
+        fleet.stop()

@@ -2218,6 +2218,173 @@ def bench_serve(args, n_rows: int):
     return 0
 
 
+def bench_chaos(args, n_rows: int):
+    """--suite chaos: elastic shrink-grow recovery (runtime/elastic.py)
+    under an injected mid-pipeline rank kill. Leg one runs a
+    taxi-shaped stage pipeline on a 3-process elastic gang twice: a
+    clean run, then one with ``elastic.checkpoint@1=kill:2`` armed so
+    rank 1 dies at its second stage boundary — the gang must shrink to
+    2 ranks, reshard the last complete checkpoint, resume the suffix,
+    and produce a final query result bit-identical to the clean 3-rank
+    run. The headline chaos_mttr_s is the rank-loss-detection ->
+    first-result-after-recovery wall from the run report. Leg two
+    measures the stage-checkpoint observation cost on the plan-based
+    taxi hot path: interleaved runs with config.elastic off/on (result
+    cache disabled so every run executes);
+    chaos_checkpoint_overhead_frac must stay under the 2% acceptance
+    bar (the in-process tier registers metadata only — the semantic
+    result cache owns the bytes). Both series ride detail.suites and
+    are watched direction-aware by benchwatch (s / frac: a regression
+    is an increase)."""
+    import numpy as np
+    import pandas as pd
+
+    from bodo_tpu.config import set_config
+    from bodo_tpu.runtime import elastic
+
+    rows = min(n_rows, 300_000)
+
+    # -- leg 1: kill @rank mid-pipeline; shrink, resume, bit-identical
+    def init(rank, nprocs):
+        # every rank derives its contiguous shard from the SAME seeded
+        # frame, so the union of shards is identical for any mesh width
+        # (that is what makes clean-vs-recovered comparable bit-for-bit)
+        rng = np.random.default_rng(11)
+        df = pd.DataFrame({
+            "pickup_hour": rng.integers(0, 24, rows).astype(np.int64),
+            "trip_miles": rng.gamma(2.0, 3.0, rows),
+            "fare": rng.gamma(3.0, 7.0, rows),
+        })
+        b = [round(i * rows / nprocs) for i in range(nprocs + 1)]
+        return df.iloc[b[rank]:b[rank + 1]].reset_index(drop=True)
+
+    def s_filter(df, ctx):
+        return df[df["trip_miles"] < 40.0].reset_index(drop=True)
+
+    def s_derive(df, ctx):
+        out = df.copy()
+        out["fare_per_mile"] = out["fare"] / (out["trip_miles"] + 0.1)
+        return out
+
+    def s_bucket(df, ctx):
+        out = df.copy()
+        out["bucket"] = (out["pickup_hour"] // 6).astype(np.int64)
+        return out
+
+    stages = [s_filter, s_derive, s_bucket]
+
+    def final(run):
+        whole = elastic.default_merge(run.results)
+        return whole.groupby("bucket", as_index=False).agg(
+            trips=("fare", "count"), mean_fpm=("fare_per_mile", "mean"))
+
+    t0 = time.perf_counter()
+    clean = elastic.run_elastic(stages, 3, init=init, timeout=300.0,
+                                grow=False)
+    clean_s = time.perf_counter() - t0
+    want = final(clean)
+
+    os.environ["BODO_TPU_FAULTS"] = "elastic.checkpoint@1=kill:2"
+    try:
+        t0 = time.perf_counter()
+        rec = elastic.run_elastic(stages, 3, init=init, timeout=300.0,
+                                  grow=False)
+        rec_s = time.perf_counter() - t0
+    finally:
+        os.environ.pop("BODO_TPU_FAULTS", None)
+    got = final(rec)
+    if not got.equals(want):
+        raise RuntimeError("chaos: recovered result differs from the "
+                           "clean 3-rank run")
+    rep = rec.report
+    if rep["shrinks"] != 1 or rep["final_nprocs"] != 2 or \
+            rep["mttr_s"] is None:
+        raise RuntimeError(f"chaos: no shrink recovery observed: {rep}")
+    mttr = rep["mttr_s"]
+    recovered_overhead = max(0.0, rec_s / max(clean_s, 1e-9) - 1.0)
+
+    # -- leg 2: checkpoint-observation overhead on the taxi hot path --
+    # frontend_pipeline is the plan-based taxi flavor: it executes
+    # through plan/physical._exec, where the elastic.observe_stage
+    # stage-boundary hook lives (the eager relational flavor never
+    # enters the plan executor)
+    from bodo_tpu.workloads.taxi import frontend_pipeline, gen_taxi_data
+    data_dir = os.path.join(_REPO, ".bench_data")
+    os.makedirs(data_dir, exist_ok=True)
+    pq = os.path.join(data_dir, f"trips_{rows}.parquet")
+    csv = os.path.join(data_dir, f"weather_{rows}.csv")
+    if not (os.path.exists(pq) and os.path.exists(csv)):
+        print(f"generating {rows} rows ...", file=sys.stderr)
+        gen_taxi_data(rows, pq, csv)
+
+    def taxi_once():
+        return frontend_pipeline(pq, csv)
+
+    elastic.reset()
+    set_config(result_cache=False)   # every run must execute
+    try:
+        taxi_once()                   # compile warmup
+        off, on = [], []
+        for _ in range(3):            # interleaved A/B: drift-robust
+            set_config(elastic=False)
+            t0 = time.perf_counter()
+            taxi_once()
+            off.append(time.perf_counter() - t0)
+            set_config(elastic=True)
+            t0 = time.perf_counter()
+            taxi_once()
+            on.append(time.perf_counter() - t0)
+    finally:
+        set_config(result_cache=True, elastic=True)
+    overhead = max(0.0, min(on) / max(min(off), 1e-9) - 1.0)
+    ckpt = elastic.head()["checkpoints"]
+    if ckpt["registered"] <= 0:
+        raise RuntimeError("chaos: elastic.observe_stage registered no "
+                           "stage anchors — the overhead leg measured "
+                           "nothing")
+    if overhead >= 0.02:
+        raise RuntimeError(
+            f"chaos: checkpoint observation overhead {overhead:.2%} "
+            f"breaches the 2% bar (off {min(off):.4f}s / on "
+            f"{min(on):.4f}s)")
+
+    detail = {
+        "rows": rows, "mesh": args.mesh,
+        "clean_s": round(clean_s, 3), "recovered_s": round(rec_s, 3),
+        "mttr_s": round(mttr, 4),
+        "recovered_overhead_frac": round(recovered_overhead, 4),
+        "checkpoint_overhead_frac": round(overhead, 4),
+        "taxi_off_s": [round(x, 4) for x in off],
+        "taxi_on_s": [round(x, 4) for x in on],
+        "stage_anchors_registered": ckpt["registered"],
+        "recovery": {k: rep[k] for k in
+                     ("epochs", "shrinks", "grows", "evicted",
+                      "final_nprocs")},
+        "probe": getattr(args, "probe", {"attempted": False}),
+        # independently-watched series (benchwatch lifts these into
+        # direction-aware trajectories: both regress upward)
+        "suites": {
+            "chaos_mttr": {
+                "metric": "chaos_mttr_s",
+                "value": round(mttr, 4), "unit": "s"},
+            "chaos_checkpoint_overhead": {
+                "metric": "chaos_checkpoint_overhead_frac",
+                "value": round(overhead, 4), "unit": "frac"},
+        },
+    }
+    print(f"chaos: clean {clean_s:.2f}s recovered {rec_s:.2f}s "
+          f"(mttr {mttr:.2f}s, +{recovered_overhead:.1%} recovered "
+          f"overhead); taxi checkpoint overhead {overhead:.2%} "
+          f"({ckpt['registered']} stage anchors)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "chaos_mttr_s", "value": round(mttr, 4), "unit": "s",
+        # normalized against the acceptance bar (recover in <= 10s)
+        "vs_baseline": round(mttr / 10.0, 4),
+        "detail": detail,
+    }))
+    return 0
+
+
 def _gang_taxi_worker(pq: str, csv: str):
     """Worker fn for the --explain gang: each rank runs the plan-based
     taxi pipeline on its LOCAL mesh (the CPU backend cannot execute
@@ -2322,7 +2489,7 @@ def main():
     ap.add_argument("--suite",
                     choices=["taxi", "tpch", "scan", "lockstep",
                              "trace", "fusion", "telemetry", "comm",
-                             "compile", "join", "serve"],
+                             "compile", "join", "serve", "chaos"],
                     default="taxi")
     ap.add_argument("--compare", action="store_true",
                     help="after the suite, run the benchwatch "
@@ -2376,6 +2543,8 @@ def main():
         args.rows = 2_000_000  # probe-side rows; join cost, not scan cost
     if args.suite == "serve" and args.rows is None and not args.quick:
         args.rows = 2_000_000  # repeat wins show against a real cold scan
+    if args.suite == "chaos" and args.rows is None and not args.quick:
+        args.rows = 300_000  # recovery/checkpoint cost, not scan cost
     if args.stream:
         os.environ["BODO_TPU_STREAM_EXEC"] = "1"
         if args.mesh is None:
@@ -2450,6 +2619,8 @@ def main():
         return _finish(args, bench_join(args, n_rows))
     if args.suite == "serve":
         return _finish(args, bench_serve(args, n_rows))
+    if args.suite == "chaos":
+        return _finish(args, bench_chaos(args, n_rows))
 
     import pandas as pd  # noqa: F401
 

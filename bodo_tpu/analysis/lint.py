@@ -25,6 +25,15 @@ Rules:
       effect lands re-runs the effect (duplicate rows / double
       writes) — the ParquetWriter class of bug from the PR-2 review.
 
+  checkpoint-non-idempotent
+      A non-idempotent operation (write/send/append) between a
+      checkpoint store's two-phase `.register(...)` and its
+      `.commit(tok)`. The window is exactly the span a crash discards:
+      the snapshot is not yet visible to recovery, so an effect landed
+      there replays when the elastic suffix resumes from the PREVIOUS
+      checkpoint (duplicate write) — keep the register->commit window
+      effect-free.
+
   unlocked-shared-state
       A write to module-level mutable state outside any `with <lock>:`
       block, in modules that define threading locks (i.e. modules whose
@@ -90,6 +99,8 @@ RULES = {
         "host side effect inside a jax-traced function body",
     "retry-non-idempotent":
         "non-idempotent operation inside the retry envelope",
+    "checkpoint-non-idempotent":
+        "side effect inside the checkpoint register->commit window",
     "unlocked-shared-state":
         "module-global state written without holding a lock",
     "fusion-host-call":
@@ -133,6 +144,11 @@ _SIDE_EFFECT_OK = {"time.monotonic", "time.perf_counter", "time.time",
 
 _NONIDEMPOTENT = {"write", "writelines", "write_table", "send",
                   "sendall", "appendleft", "append_row"}
+
+# receivers that look like a two-phase checkpoint store: their
+# .register(...) opens an uncommitted-snapshot window that .commit(tok)
+# closes (runtime/elastic.py CheckpointStore is the canonical one)
+_CKPT_RECV_RE = re.compile(r"ckpt|checkpoint|store", re.IGNORECASE)
 
 # host-sync calls illegal inside a @fusion_stage body (whole-stage
 # fusion: the body runs inside ONE compiled program)
@@ -319,6 +335,28 @@ def _contains_lax_collective(fn: ast.AST) -> bool:
     return False
 
 
+def _calls_in_order(fn: ast.AST) -> List[ast.Call]:
+    """Call nodes lexically inside ``fn``'s own body — nested
+    function/lambda bodies excluded (they execute at their OWN call
+    time, not inside this function's checkpoint window) — in source
+    order."""
+    out: List[ast.Call] = []
+
+    def rec(n: ast.AST) -> None:
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(c, ast.Call):
+                out.append(c)
+            rec(c)
+
+    rec(fn)
+    out.sort(key=lambda c: (getattr(c, "lineno", 0),
+                            getattr(c, "col_offset", 0)))
+    return out
+
+
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, src_lines: List[str],
                  info: _ModuleInfo):
@@ -371,6 +409,7 @@ class _Checker(ast.NodeVisitor):
                     "route through bounded_jit or a registered "
                     "KernelCache")
         self._func.append(node.name)
+        self._check_checkpoint_windows(node)
         self._local_defs.append({})
         if traced:
             self._traced_depth += 1
@@ -557,6 +596,35 @@ class _Checker(ast.NodeVisitor):
                         f"the effect lands replays it (duplicate "
                         f"write)")
                     return
+
+    def _check_checkpoint_windows(self, fn) -> None:
+        """Linear source-order scan of this function's calls: a
+        ``<ckpt-store>.register(...)`` opens an uncommitted-snapshot
+        window that the matching ``<ckpt-store>.commit(...)`` closes;
+        any non-idempotent effect inside the window replays on elastic
+        resume (the snapshot it rode with was never committed)."""
+        open_regs: Dict[str, ast.Call] = {}
+        for c in _calls_in_order(fn):
+            if not isinstance(c.func, ast.Attribute):
+                continue
+            t = c.func.attr
+            recv = _dotted(c.func.value)
+            if t == "register" and recv and _CKPT_RECV_RE.search(recv):
+                open_regs[recv] = c
+                continue
+            if t == "commit" and recv in open_regs:
+                del open_regs[recv]
+                continue
+            if open_regs and t in _NONIDEMPOTENT:
+                stores = ", ".join(sorted(open_regs))
+                self._add(
+                    "checkpoint-non-idempotent", c,
+                    f"non-idempotent `.{t}(...)` between "
+                    f"{stores!r}.register() and its commit: a crash "
+                    f"here discards the registered snapshot, so the "
+                    f"resumed suffix replays this effect (duplicate "
+                    f"write) — move it after commit or make it "
+                    f"idempotent")
 
     # -- shared-state mutation --------------------------------------------
 

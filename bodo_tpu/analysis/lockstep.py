@@ -66,6 +66,12 @@ _lock = threading.Lock()
 _checker = None       # Checker | False (disabled after warning) | None
 _stats = {"collectives": 0, "wait_s": 0.0, "max_wait_s": 0.0,
           "mismatches": 0, "timeouts": 0, "fused_dispatches": 0}
+# mesh epoch: bumped by the elastic layer on every re-mesh (shrink or
+# grow). Sequence numbers and fingerprints are namespaced per epoch —
+# survivors of a shrink restart from seq 1 in fresh per-epoch logs, so
+# post-recovery dispatches can never be cross-checked against the old
+# mesh's stream (which would false-positive as divergence).
+_mesh_epoch = 0
 
 # Whole-stage fusion moves member collectives INSIDE one compiled
 # program, where per-op pre_collective hooks can no longer fire at
@@ -115,13 +121,46 @@ def reset() -> None:
     """Drop the active checker and zero counters (tests; also called by
     set_config when any lockstep knob changes so the next dispatch
     rebinds to the new settings)."""
-    global _checker
+    global _checker, _mesh_epoch
     with _lock:
         if _checker:
             _checker.close()
         _checker = None
+        _mesh_epoch = 0
         for k in _stats:
             _stats[k] = 0 if k != "wait_s" and k != "max_wait_s" else 0.0
+
+
+def mesh_epoch() -> int:
+    return _mesh_epoch
+
+
+def set_mesh_epoch(epoch: int, rank: Optional[int] = None,
+                   nprocs: Optional[int] = None) -> None:
+    """Enter a new mesh epoch after an elastic re-mesh: drop the
+    current checker so the next dispatch rebinds under the (renumbered)
+    rank/nprocs the caller has already published to the environment,
+    with a fresh sequence counter, an epoch-suffixed log file, and
+    epoch-prefixed fingerprints. Cumulative stats are preserved — a
+    re-mesh is recovery, not a test reset."""
+    global _checker, _mesh_epoch
+    with _lock:
+        if _checker:
+            _checker.close()
+        _checker = None
+        _mesh_epoch = int(epoch)
+    if rank is not None:
+        os.environ["BODO_TPU_PROC_ID"] = str(int(rank))
+    if nprocs is not None:
+        os.environ["BODO_TPU_NPROCS"] = str(int(nprocs))
+
+
+def _log_name(epoch: int, rank: int) -> str:
+    # epoch 0 keeps the historical name: telemetry's log tail, doctor's
+    # skew triage and existing gangs all parse lockstep_<rank>.log
+    if epoch:
+        return f"lockstep_e{epoch}_{rank}.log"
+    return f"lockstep_{rank}.log"
 
 
 def _rank() -> int:
@@ -246,7 +285,8 @@ def _get_checker() -> Optional["Checker"]:
                 "directory; lockstep checking disabled\n")
             _checker = False
             return None
-        _checker = Checker(d or None, _rank(), nprocs)
+        _checker = Checker(d or None, _rank(), nprocs,
+                           epoch=_mesh_epoch)
         return _checker
 
 
@@ -303,10 +343,12 @@ class Checker:
     """Per-process lockstep state: own sequence counter + log writer,
     plus incremental readers over every peer's log."""
 
-    def __init__(self, dirpath: Optional[str], rank: int, nprocs: int):
+    def __init__(self, dirpath: Optional[str], rank: int, nprocs: int,
+                 epoch: int = 0):
         self.dir = dirpath
         self.rank = int(rank)
         self.nprocs = int(nprocs)
+        self.epoch = int(epoch)
         self.seq = 0
         self._mu = threading.Lock()
         self._f = None
@@ -314,7 +356,8 @@ class Checker:
             try:
                 os.makedirs(dirpath, exist_ok=True)
                 self._f = open(
-                    os.path.join(dirpath, f"lockstep_{self.rank}.log"),
+                    os.path.join(dirpath,
+                                 _log_name(self.epoch, self.rank)),
                     "a")
             except OSError as e:  # unusable dir: record-only mode
                 sys.stderr.write(
@@ -331,7 +374,11 @@ class Checker:
             self._f = None
 
     def check(self, op: str, site: str) -> float:
-        fingerprint = f"{op}@{site}"
+        # the mesh-epoch field in the fingerprint makes a stale peer
+        # (still dispatching under the old mesh) an immediate, named
+        # mismatch instead of a confusing op-level divergence
+        fingerprint = f"e{self.epoch}:{op}@{site}" if self.epoch \
+            else f"{op}@{site}"
         with self._mu:
             self.seq += 1
             seq = self.seq
@@ -353,7 +400,7 @@ class Checker:
             plog = self._peers.get(peer)
             if plog is None:
                 plog = self._peers[peer] = _PeerLog(os.path.join(
-                    self.dir, f"lockstep_{peer}.log"))
+                    self.dir, _log_name(self.epoch, peer)))
             while True:
                 got = plog.entry(seq)
                 if got is not None:

@@ -505,6 +505,70 @@ class Config:
     spawn_gang_retries: int = field(
         default_factory=lambda: _env_int("BODO_TPU_SPAWN_GANG_RETRIES", 1)
     )
+    # -- elastic gangs (runtime/elastic.py) ----------------------------------
+    # Master switch for stage-checkpointed shrink-grow recovery: stage
+    # boundaries register checkpoints, a lost rank shrinks the mesh and
+    # resumes the plan suffix, and the scheduler resumes (not fails)
+    # queries that raise a RankLost. set_config(elastic=...) exports
+    # BODO_TPU_ELASTIC so spawned workers inherit the posture.
+    elastic: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_ELASTIC", True)
+    )
+    # Shared checkpoint/control directory for elastic gang runs (the
+    # launcher points this at each gang's temp dir; empty = the run's
+    # own gang dir only).
+    elastic_dir: str = field(
+        default_factory=lambda: _env_str("BODO_TPU_ELASTIC_DIR", "")
+    )
+    # Checkpoint-store byte bound per process (shards beyond the
+    # committed frontier are pruned after every commit; resident bytes
+    # are charged to the memory governor through an advisory grant).
+    elastic_ckpt_bytes: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_ELASTIC_CKPT_BYTES",
+                                         256 << 20)
+    )
+    # How many shrinks one gang run may absorb, and the smallest mesh
+    # recovery may shrink to before falling back to gang-level retry.
+    elastic_max_shrinks: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_ELASTIC_MAX_SHRINKS", 2)
+    )
+    elastic_min_ranks: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_ELASTIC_MIN_RANKS", 1)
+    )
+    # Whole-gang retries after elastic recovery itself fails (a fault
+    # during re-mesh must fall back to the existing gang-level retry).
+    elastic_gang_retries: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_ELASTIC_GANG_RETRIES",
+                                         1)
+    )
+    # Straggler-eviction policy: a rank whose checkpoint frontier trails
+    # its peers and has not advanced for this long is evicted like a
+    # dead one (0 = never evict stragglers). Attribution prefers the
+    # comm observatory's lockstep arrival stamps when available.
+    elastic_straggler_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_ELASTIC_STRAGGLER_S",
+                                           0.0)
+    )
+    # Grace given to an evicted-but-alive rank to exit clean before the
+    # parent tears it down (its state stays "evicted" either way).
+    elastic_evict_grace_s: float = field(
+        default_factory=lambda: _env_float(
+            "BODO_TPU_ELASTIC_EVICT_GRACE_S", 2.0)
+    )
+    # Background grow path: re-admit replacement capacity (a joiner
+    # rank at the next stage boundary of a shrunk run; full width at
+    # the next query boundary in serving).
+    elastic_grow: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_ELASTIC_GROW", True)
+    )
+    # Re-form the jax.distributed cluster on the post-shrink mesh (real
+    # pods). Off by default: the recovery shuffle moves state through
+    # the shared gang dir and must not depend on collectives; the CPU
+    # backend has no cross-process collectives to re-form anyway.
+    elastic_remesh_distributed: bool = field(
+        default_factory=lambda: _env_bool(
+            "BODO_TPU_ELASTIC_REMESH_DISTRIBUTED", False)
+    )
     # -- shardcheck / SPMD safety (analysis/) --------------------------------
     # Validate every logical plan against the distribution/shape
     # invariants before execution (analysis/plan_validator.py).
@@ -606,6 +670,16 @@ def set_config(**kwargs) -> None:
             fl = _sys.modules.get("bodo_tpu.runtime.fleet")
             if fl is not None:
                 fl.reconfigure()
+        if k.startswith("elastic"):
+            # export like faults/lockstep so spawned gang workers
+            # inherit the recovery posture and checkpoint budget
+            env_name = "BODO_TPU_" + k.upper()
+            if isinstance(v, bool):
+                os.environ[env_name] = "1" if v else "0"
+            elif v in ("", None):
+                os.environ.pop(env_name, None)
+            else:
+                os.environ[env_name] = str(v)
         if k == "stats_store_dir":
             # flush + drop the open store so the next lookup re-binds to
             # the new directory

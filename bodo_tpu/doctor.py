@@ -30,7 +30,7 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-_LOCKSTEP_RE = re.compile(r"^lockstep_(\d+)\.log$")
+_LOCKSTEP_RE = re.compile(r"^lockstep_(?:e(\d+)_)?(\d+)\.log$")
 _SHARD_RE = re.compile(r"^trace_shard_(\d+)\.json$")
 _SPARK = " ▁▂▃▄▅▆▇█"
 
@@ -49,17 +49,28 @@ def _parse_lockstep_logs(
     """({rank: {seq: fingerprint}}, {rank: {seq: arrival_ts}}) from the
     copied side-channel logs. Lines are ``seq\\tfingerprint`` with an
     optional third arrival-timestamp field (newer logs); the timestamp
-    map only carries entries whose line had one."""
-    logs: Dict[int, Dict[int, str]] = {}
-    arrivals: Dict[int, Dict[int, float]] = {}
+    map only carries entries whose line had one.
+
+    Elastic re-meshes namespace the logs by mesh epoch
+    (``lockstep_e<epoch>_<rank>.log``); the triage uses the HIGHEST
+    epoch present — the mesh the gang died in — so pre-shrink streams
+    from retired epochs don't masquerade as divergence."""
+    by_epoch: Dict[int, Dict[int, str]] = {}
     try:
         names = os.listdir(bundle)
     except OSError:
-        return logs, arrivals
+        return {}, {}
     for name in names:
         m = _LOCKSTEP_RE.match(name)
         if not m:
             continue
+        epoch = int(m.group(1) or 0)
+        by_epoch.setdefault(epoch, {})[int(m.group(2))] = name
+    logs: Dict[int, Dict[int, str]] = {}
+    arrivals: Dict[int, Dict[int, float]] = {}
+    if not by_epoch:
+        return logs, arrivals
+    for rank, name in by_epoch[max(by_epoch)].items():
         entries: Dict[int, str] = {}
         stamps: Dict[int, float] = {}
         try:
@@ -80,8 +91,8 @@ def _parse_lockstep_logs(
                             pass
         except OSError:
             continue
-        logs[int(m.group(1))] = entries
-        arrivals[int(m.group(1))] = stamps
+        logs[rank] = entries
+        arrivals[rank] = stamps
     return logs, arrivals
 
 
@@ -236,6 +247,42 @@ def _triage_fleet(telemetry: Optional[dict]) -> Optional[dict]:
     return out
 
 
+def _triage_elastic(bundle: str, manifest: dict,
+                    telemetry: Optional[dict]) -> Optional[dict]:
+    """Elastic shrink-grow triage: the bundle's ``remesh.json`` (copied
+    from the gang dir) is the recovery control record — which workers
+    were evicted and why, the surviving mesh, and the checkpoint stage
+    the suffix resumed from. Falls back to the last telemetry sample's
+    ``elastic`` serving block when the bundle predates a re-mesh."""
+    out: dict = {}
+    rm = _read_json(os.path.join(bundle, "remesh.json"))
+    if rm:
+        out["epoch"] = rm.get("epoch", 0)
+        out["evicted_workers"] = rm.get("evicted", [])
+        out["resume_stage"] = rm.get("resume_stage")
+        out["reason"] = rm.get("reason")
+        out["survivors"] = sorted(
+            int(w) for w in (rm.get("workers") or {}))
+    ranks = manifest.get("ranks") or {}
+    reasons = {int(r): d["evicted_reason"] for r, d in ranks.items()
+               if d.get("evicted_reason")}
+    if reasons:
+        out["evicted_reasons"] = {str(r): v
+                                  for r, v in sorted(reasons.items())}
+    samples = (telemetry or {}).get("samples") or []
+    els = [s.get("elastic") for s in samples if s.get("elastic")]
+    if els:
+        last = els[-1]
+        out.setdefault("epoch", last.get("epoch", 0))
+        out["capacity_frac"] = last.get("capacity_frac")
+        out["shrinks"] = last.get("shrinks")
+        out["grows"] = last.get("grows")
+        out["resumes"] = last.get("resumes")
+        if last.get("last_mttr_s") is not None:
+            out["last_mttr_s"] = last["last_mttr_s"]
+    return out or None
+
+
 def _triage_xla(bundle: str) -> Optional[dict]:
     """Compile & device-memory triage from the bundle's registry dump:
     name the storming signature, rank retrace causes, surface the
@@ -301,12 +348,18 @@ def triage(bundle: str) -> dict:
         out["hung_ranks"] = sorted(
             int(r) for r, d in ranks.items()
             if d.get("state") in ("hung", "timeout"))
+        # shrink-evicted ranks left the mesh deliberately (elastic
+        # recovery) — a distinct class from dead/hung, not a failure
+        out["evicted_ranks"] = sorted(
+            int(r) for r, d in ranks.items()
+            if d.get("state") == "evicted" or d.get("evicted"))
     logs, arrivals = _parse_lockstep_logs(bundle)
     out["lockstep"] = _triage_lockstep(logs)
     out["comm"] = _triage_comm(logs, arrivals)
     telem = _read_json(os.path.join(bundle, "telemetry.json"))
     out["memory"] = _triage_memory(telem)
     out["fleet"] = _triage_fleet(telem)
+    out["elastic"] = _triage_elastic(bundle, manifest, telem)
     out["xla"] = _triage_xla(bundle)
     slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
     out["slow_queries"] = [{"query_id": q.get("query_id"),
@@ -365,6 +418,8 @@ def render(t: dict) -> str:
         line = f"  rank {r}: {d.get('state')}"
         if d.get("returncode") is not None:
             line += f" rc={d['returncode']}"
+        if d.get("evicted_reason"):
+            line += f" (evicted: {d['evicted_reason']})"
         lines.append(line)
     ls = t.get("lockstep")
     if ls:
@@ -438,6 +493,36 @@ def render(t: dict) -> str:
                 f"{_fmt_bytes(mem.get('spilled_bytes', 0))} in "
                 f"{mem.get('n_spills', 0)} spills, "
                 f"{mem.get('oom_retries', 0)} OOM retries")
+    el = t.get("elastic")
+    if el:
+        lines.append("elastic:")
+        bits = []
+        if el.get("epoch"):
+            bits.append(f"mesh epoch {el['epoch']}")
+        if el.get("evicted_workers"):
+            reasons = el.get("evicted_reasons") or {}
+            who = ", ".join(
+                f"worker {w}"
+                + (f" ({reasons[str(w)]})" if str(w) in reasons else "")
+                for w in el["evicted_workers"])
+            bits.append(f"EVICTED {who}")
+        if el.get("survivors"):
+            bits.append(f"survivors {el['survivors']}")
+        if el.get("resume_stage") is not None:
+            bits.append(f"resumed from stage {el['resume_stage']}")
+        if bits:
+            lines.append("  " + "; ".join(bits))
+        counters = []
+        for k in ("shrinks", "grows", "resumes"):
+            if el.get(k):
+                counters.append(f"{el[k]} {k}")
+        if el.get("capacity_frac") is not None \
+                and el["capacity_frac"] < 1.0:
+            counters.append(f"capacity {el['capacity_frac']:.0%}")
+        if el.get("last_mttr_s") is not None:
+            counters.append(f"last MTTR {el['last_mttr_s']:.2f}s")
+        if counters:
+            lines.append("  " + ", ".join(counters))
     fl = t.get("fleet")
     if fl:
         lines.append("fleet:")

@@ -251,6 +251,48 @@ def skew_head() -> dict:
     }
 
 
+def straggler_from_logs(dirpath: str, nprocs: int,
+                        epoch: int = 0) -> Optional[int]:
+    """Straggler attribution from the lockstep arrival stamps: for each
+    sequence number every rank reached, the rank whose wall-clock
+    arrival stamp is LATEST is the one its peers waited for; the rank
+    that is latest most often is the straggler. This is the signal the
+    elastic layer's eviction policy uses to drop the rank the gang is
+    *waiting for*, not only the one that crashed. Returns the mesh rank
+    (epoch-local numbering) or None when the logs carry no comparable
+    stamps (lockstep off, single rank, or no common sequence)."""
+    from bodo_tpu.analysis.lockstep import _log_name
+    arrivals: Dict[int, Dict[int, float]] = {}
+    for rank in range(int(nprocs)):
+        path = os.path.join(dirpath, _log_name(int(epoch), rank))
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        stamps: Dict[int, float] = {}
+        for line in lines:
+            parts = line.split("\t")
+            if len(parts) < 3:
+                continue
+            try:
+                stamps[int(parts[0])] = float(parts[2])
+            except ValueError:
+                continue
+        if stamps:
+            arrivals[rank] = stamps
+    if len(arrivals) < 2:
+        return None
+    common = set.intersection(*(set(s) for s in arrivals.values()))
+    if not common:
+        return None
+    late: Dict[int, int] = {}
+    for seq in common:
+        worst = max(arrivals, key=lambda r: arrivals[r][seq])
+        late[worst] = late.get(worst, 0) + 1
+    return max(late, key=lambda r: late[r])
+
+
 def reset() -> None:
     global _seq
     with _lock:

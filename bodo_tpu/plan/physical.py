@@ -164,6 +164,14 @@ def _exec(node: L.Node) -> Table:
         from bodo_tpu.plan import adaptive
         adaptive.observe_stage(node, t)
     _rcache.record(key, node.key(), t, wall_s)
+    try:
+        # elastic checkpoint anchor: the AQE observation point doubles
+        # as the resumable-suffix boundary (the result cache owns the
+        # bytes; elastic tracks registration + accounting for /healthz)
+        from bodo_tpu.runtime import elastic
+        elastic.observe_stage(node, wall_s)
+    except Exception:  # noqa: BLE001 - accounting never fails a query
+        pass
     return t
 
 

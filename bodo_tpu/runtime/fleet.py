@@ -562,7 +562,7 @@ _PEER_HITS_RE = _re.compile(
 class _GangState:
     __slots__ = ("gang_id", "proc", "serve_addr", "telemetry_addr",
                  "state", "reason", "retry_after_s", "fail_scrapes",
-                 "admission", "stdin", "peer_hits")
+                 "admission", "stdin", "peer_hits", "capacity_frac")
 
     def __init__(self, gang_id: str):
         self.gang_id = gang_id
@@ -574,6 +574,10 @@ class _GangState:
         self.retry_after_s = 0.0
         self.fail_scrapes = 0
         self.peer_hits = 0
+        # surviving-rank fraction scraped from /healthz["elastic"]; a
+        # shrunk gang (< 1.0) keeps serving but at reduced throughput,
+        # so quota and routing scale by it rather than evicting.
+        self.capacity_frac = 1.0
         # one admission twin PER GANG: the pressure-event memory (last
         # OOM/shed counters) is per-scrape-target state
         self.admission = AdmissionController()
@@ -831,6 +835,13 @@ class FleetController:
             g.state = state
             g.reason = d.reason
             g.retry_after_s = d.retry_after_s
+            cap = sig.gang_capacity_frac
+            cap = 1.0 if cap is None else min(max(float(cap), 0.0), 1.0)
+            if cap != g.capacity_frac:
+                log(1, f"fleet: gang {g.gang_id} capacity "
+                       f"{g.capacity_frac:.2f} -> {cap:.2f} "
+                       f"(elastic epoch {sig.elastic_epoch})")
+            g.capacity_frac = cap
 
     def _mark_dead_locked(self, g: _GangState, why: str) -> None:
         g.state = "dead"
@@ -949,12 +960,25 @@ class FleetController:
                     retry_after_s=max(
                         float(config.serve_retry_after_s), 0.25) * 4,
                     reason="no_gangs")
-            for i, g in enumerate(cands):
-                if g.state == "ok":
-                    if i > 0:
-                        self._c["rerouted"] = \
-                            self._c.get("rerouted", 0) + 1
-                    return g
+            ok = [(i, g) for i, g in enumerate(cands)
+                  if g.state == "ok"]
+            if ok:
+                # affinity first — but when the ring owner is a shrunk
+                # (elastic) gang and a full-capacity gang is also ok,
+                # spill the key to the full gang: the shrunk gang keeps
+                # its warm keys only while no better host exists
+                i, g = ok[0]
+                if g.capacity_frac < 1.0:
+                    full = [(j, h) for j, h in ok
+                            if h.capacity_frac >= 1.0]
+                    if full:
+                        i, g = full[0]
+                        self._c["capacity_rerouted"] = \
+                            self._c.get("capacity_rerouted", 0) + 1
+                if i > 0:
+                    self._c["rerouted"] = \
+                        self._c.get("rerouted", 0) + 1
+                return g
             # no healthy gang: surface the least-bad state typed
             sev = {"backoff": 0, "shed": 1, "degraded": 2, "dead": 3}
             best = min(cands, key=lambda g: sev.get(g.state, 3))
@@ -967,12 +991,28 @@ class FleetController:
                 or max(float(config.fleet_scrape_s), 0.25) * 2,
                 reason=f"fleet_{best.state}")
 
+    def _capacity_frac(self) -> float:
+        """Mean surviving-rank fraction across live gangs (1.0 for an
+        unshrunk fleet; dead gangs don't count — the ring already
+        rerouted their keyspace)."""
+        with self._mu:
+            caps = [g.capacity_frac for g in self._gangs.values()
+                    if g.state != "dead"]
+        if not caps:
+            return 1.0
+        return min(max(sum(caps) / len(caps), 0.0), 1.0)
+
     def _submit(self, s: FleetSession, fn: Callable,
                 key: Optional[str]) -> Future:
         if s.closed:
             raise Overloaded(f"fleet session {s.sid!r} is closed",
                              reason="session_closed")
-        quota = max(int(config.fleet_session_quota), 1)
+        # a shrunk fleet admits proportionally less: quota scales by the
+        # mean surviving-rank fraction of live gangs, so an elastic
+        # N->N-1 shrink sheds load instead of queueing it onto fewer
+        # ranks (capacity restores to 1.0 once the gang grows back)
+        cap = self._capacity_frac()
+        quota = max(int(round(int(config.fleet_session_quota) * cap)), 1)
         with s._mu:
             if s._inflight >= quota:
                 self._c["quota_rejections"] = \
@@ -1162,12 +1202,15 @@ class FleetController:
                     "addr": g.serve_addr,
                     "telemetry": g.telemetry_addr,
                     "pid": g.proc.pid if g.proc is not None else None,
+                    "capacity_frac": g.capacity_frac,
                 } for g in self._gangs.values()}
             out = {
                 "gangs": gangs,
                 "ring_members": self._ring.members(),
                 "sessions": len(self._sessions),
                 "rerouted": self._c.get("rerouted", 0),
+                "capacity_rerouted":
+                    self._c.get("capacity_rerouted", 0),
                 "scrape_failures": self._c.get("scrape_failures", 0),
                 "gangs_evicted": self._c.get("gangs_evicted", 0),
                 "invalidations_broadcast":

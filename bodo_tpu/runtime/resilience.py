@@ -18,6 +18,17 @@ Three parts:
        io.write             parquet writers (per attempt)
        spawn.worker_start   spawned worker, BEFORE the jax import
        stage.boundary       plan-executor stage entry (both executors)
+       fleet.serve          fleet controller, per routed submission
+       elastic.checkpoint   elastic worker, at every stage-boundary
+                            checkpoint registration (kill here is the
+                            canonical mid-pipeline rank loss)
+       elastic.remesh       elastic worker, on adopting a new mesh
+                            epoch (before renumbering) — recovery of
+                            recovery; a fault here must fall back to
+                            the gang-level retry, never wedge
+       elastic.resume       elastic worker, after renumbering/lockstep
+                            re-namespacing, before the recovery
+                            reshard of the last checkpoint
 
    Tests and chaos runs arm them with a spec string, either in-process
    (`set_config(faults=...)`) or via `BODO_TPU_FAULTS=<spec>` in the
@@ -95,7 +106,8 @@ def _cfg(name: str, env: str, default, cast):
 # ---------------------------------------------------------------------------
 
 POINTS = ("collective", "device_put", "io.read", "io.write",
-          "spawn.worker_start", "stage.boundary", "fleet.serve")
+          "spawn.worker_start", "stage.boundary", "fleet.serve",
+          "elastic.checkpoint", "elastic.remesh", "elastic.resume")
 
 
 class FaultInjected(RuntimeError):

@@ -147,6 +147,11 @@ class AdmissionSignals:
     xla_budget_remaining: Optional[int] = None
     result_cache_occupancy: Optional[float] = None
     result_cache_pressure_sheds: Optional[int] = None
+    # elastic capacity: <1.0 when the gang shrank after a rank loss —
+    # the fleet admission twin scales the per-gang session quota (and
+    # routing weight) by this instead of rejecting outright
+    gang_capacity_frac: Optional[float] = None
+    elastic_epoch: Optional[int] = None
     source: str = "local"
 
     def merged(self, other: "AdmissionSignals") -> "AdmissionSignals":
@@ -186,6 +191,10 @@ def signals_from_health(doc: dict) -> AdmissionSignals:
             sig.result_cache_occupancy = float(rc["occupancy_frac"])
         if "pressure_sheds" in rc:
             sig.result_cache_pressure_sheds = int(rc["pressure_sheds"])
+    el = doc.get("elastic") or {}
+    if "capacity_frac" in el:
+        sig.gang_capacity_frac = float(el["capacity_frac"])
+        sig.elastic_epoch = int(el.get("epoch", 0))
     return sig
 
 
@@ -484,6 +493,7 @@ class Scheduler:
         self._decisions: Dict[str, int] = {}
         self._completed = 0
         self._failed = 0
+        self._resumed = 0
         self._sig_cache: Optional[AdmissionSignals] = None
         self._sig_at = 0.0
         self._seq = itertools.count(1)
@@ -708,20 +718,49 @@ class Scheduler:
             try:
                 out, qid = self._run_in_span(req)
             except BaseException as e:  # noqa: BLE001 - typed delivery
-                wall = time.perf_counter() - t0
-                self._account(s, wall, cm, comm0, ob, xla0)
+                # the scheduler fails nothing it can resume: a rank
+                # loss under an elastic gang re-runs the thunk ONCE on
+                # the shrunk mesh — completed stages hit the result
+                # cache, so only the plan suffix past the last stage
+                # checkpoint actually executes again. Other sessions
+                # never see the loss at all.
+                out = None
+                resumed = False
+                el = _mod("bodo_tpu.runtime.elastic")
+                if el is not None and config.elastic and \
+                        el.is_resumable(e):
+                    try:
+                        out, qid = self._run_in_span(req)
+                        resumed = True
+                    except BaseException as e2:  # noqa: BLE001
+                        e = e2
+                if not resumed:
+                    wall = time.perf_counter() - t0
+                    self._account(s, wall, cm, comm0, ob, xla0)
+                    with self._cv:
+                        self._failed += 1
+                        s._count("failed")
+                    req.future.set_exception(
+                        QueryFailed(s.sid, req.query_id, e))
+                    return
+                el.note_resume()
                 with self._cv:
-                    self._failed += 1
-                    s._count("failed")
-                req.future.set_exception(
-                    QueryFailed(s.sid, req.query_id, e))
-                return
+                    self._resumed += 1
+                    s._count("resumed")
             wall = time.perf_counter() - t0
             self._account(s, wall, cm, comm0, ob, xla0)
             with self._cv:
                 self._completed += 1
                 s._count("completed")
             req.future.set_result(out)
+            # background grow: a shrunk gang re-admits replacement
+            # capacity at the next query boundary
+            el = _mod("bodo_tpu.runtime.elastic")
+            if el is not None:
+                try:
+                    el.note_query_boundary()
+                except Exception:  # noqa: BLE001
+                    pass
         finally:
             if grant is not None:
                 try:
@@ -838,6 +877,7 @@ class Scheduler:
             self._decisions.clear()
             self._completed = 0
             self._failed = 0
+            self._resumed = 0
             self._sig_cache = None
         for s in sessions:
             for req in s.queue:
@@ -863,6 +903,7 @@ class Scheduler:
                                 if t.is_alive()]),
                 "completed": self._completed,
                 "failed": self._failed,
+                "resumed": self._resumed,
                 "decisions": dict(self._decisions),
                 "by_session": {sid: {
                     "queued": len(s.queue),

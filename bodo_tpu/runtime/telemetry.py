@@ -241,6 +241,17 @@ def sample() -> dict:
                 s["fleet"] = fs
         except Exception:
             pass
+    el = _mod("bodo_tpu.runtime.elastic")
+    if el is not None:
+        try:
+            eh = el.head()
+            # only worth a ring slot once recovery state exists — an
+            # epoch-0 full-capacity gang is the default
+            if eh.get("epoch") or eh.get("shrinks") or eh.get("grows") \
+                    or eh.get("resumes"):
+                s["elastic"] = eh
+        except Exception:
+            pass
     return s
 
 
@@ -441,15 +452,33 @@ def health() -> dict:
             doc["gang"] = {str(r): d for r, d in sorted(ranks.items())}
             hb_timeout = float(getattr(config, "spawn_hb_timeout_s",
                                        15.0))
+            # shrink-evicted ranks left the mesh on purpose: they read
+            # as reduced capacity (the elastic block below), never as
+            # an unhealthy gang that needs a restart
             bad = [r for r, d in ranks.items()
-                   if not d.get("alive", False)
-                   or d.get("hb_age_s", 0.0) > hb_timeout]
+                   if not d.get("evicted", False)
+                   and (not d.get("alive", False)
+                        or d.get("hb_age_s", 0.0) > hb_timeout)]
             if bad:
                 doc["status"] = "degraded"
                 doc["unhealthy_ranks"] = sorted(bad)
+            evicted = sorted(r for r, d in ranks.items()
+                             if d.get("evicted", False))
+            if evicted:
+                doc["evicted_ranks"] = evicted
         except Exception as e:
             doc["status"] = "unknown"
             doc["gang_error"] = f"{type(e).__name__}: {e}"
+    el = _mod("bodo_tpu.runtime.elastic")
+    if el is not None:
+        try:
+            eh = el.head()
+            # always present once the elastic module is loaded: the
+            # fleet admission twin rescales quotas/routing from
+            # capacity_frac, so "1.0" (full width) is signal too
+            doc["elastic"] = eh
+        except Exception:
+            pass
     cm = _mod("bodo_tpu.parallel.comm")
     if cm is not None:
         try:
@@ -733,7 +762,8 @@ def _copy_gang_artifacts(d: str, gang_dir: str) -> None:
         return
     for name in names:
         if not (name.startswith(("lockstep_", "err_", "stacks_"))
-                or name.startswith("trace_shard_")):
+                or name.startswith("trace_shard_")
+                or name == "remesh.json"):
             continue
         try:
             shutil.copy2(os.path.join(gang_dir, name),

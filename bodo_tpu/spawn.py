@@ -95,11 +95,19 @@ def main():
         except Exception:
             pass
 
-    if resil is not None:
-        resil.retry_call(_init, label="jax_distributed_init",
-                         on_retry=_reset)
-    else:
-        _init()
+    # elastic gangs (runtime/elastic.py) opt out of the shared
+    # jax.distributed cluster: recovery moves state through host files,
+    # and a shared coordination service would fatally terminate the
+    # SURVIVORS ~100s after a rank loss (heartbeat timeout at the
+    # shutdown barrier) — exactly the failure elasticity exists to
+    # absorb. Real pods re-form the cluster per mesh epoch instead
+    # (config.elastic_remesh_distributed).
+    if os.environ.get("BODO_TPU_NO_JAX_DIST") != "1":
+        if resil is not None:
+            resil.retry_call(_init, label="jax_distributed_init",
+                             on_retry=_reset)
+        else:
+            _init()
     with open(payload_path, "rb") as f:
         fn = cloudpickle.load(f)
     try:
@@ -140,8 +148,10 @@ _DUMP_GRACE_S = 2.0
 
 class SpawnError(RuntimeError):
     """A gang launch failed. `ranks` maps every rank to a diagnostic
-    dict: state ("ok" / "dead" / "hung" / "timeout" / "killed"),
-    returncode, and a stderr tail for ranks that failed. `reason` is the
+    dict: state ("ok" / "dead" / "hung" / "timeout" / "killed" /
+    "evicted"), returncode, and a stderr tail for ranks that failed.
+    "evicted" means the rank exited clean after a shrink-eviction
+    (runtime/elastic.py) — it is never a gang failure. `reason` is the
     gang-level failure ("worker death", "hung worker", "gang timeout");
     `transient` is True when every failing rank's stderr classified as a
     transient flake (the caller may gang-retry)."""
@@ -220,12 +230,16 @@ def _merge_gang_trace(d: str) -> None:
         pass
 
 
-def _register_gang_health(d: str, procs, hb_paths, start: float) -> None:
+def _register_gang_health(d: str, procs, hb_paths, start: float,
+                          evicted=None) -> None:
     """Expose this gang's per-rank liveness to /healthz while it runs:
     the telemetry endpoint's server thread polls the provider closure
     (proc returncodes, heartbeat file ages, lockstep log tails)
-    concurrently with the supervision loop. Best-effort — telemetry
-    must never fail a gang."""
+    concurrently with the supervision loop. `evicted` is an optional
+    callable returning the rank set shrink-evicted by the elastic
+    layer — those ranks are flagged so /healthz reports reduced
+    capacity, not an unhealthy gang. Best-effort — telemetry must
+    never fail a gang."""
     try:
         from bodo_tpu.runtime import telemetry
     except Exception:  # pragma: no cover
@@ -233,6 +247,12 @@ def _register_gang_health(d: str, procs, hb_paths, start: float) -> None:
 
     def provider() -> Dict[int, dict]:
         now = time.monotonic()
+        gone = set()
+        if evicted is not None:
+            try:
+                gone = set(evicted())
+            except Exception:  # pragma: no cover
+                gone = set()
         out: Dict[int, dict] = {}
         for i, p in enumerate(procs):
             rc = p.poll()
@@ -242,6 +262,8 @@ def _register_gang_health(d: str, procs, hb_paths, start: float) -> None:
                 "hb_age_s": round(_hb_age(hb_paths[i], now - start), 3),
                 "last_collective": telemetry.lockstep_log_tail(d, i),
             }
+            if i in gone:
+                out[i]["evicted"] = True
         return out
 
     try:
@@ -282,6 +304,53 @@ def _hb_age(path: str, fallback_age: float) -> float:
         return max(0.0, time.time() - os.path.getmtime(path))
     except OSError:
         return fallback_age
+
+
+def _worker_env(d: str, i: int, n_processes: int, coord: str,
+                resil_path: str, pkg_root: str,
+                hb_path: str) -> Dict[str, str]:
+    """Environment for one gang worker — shared between the plain
+    spawner below and the elastic gang launcher (runtime/elastic.py),
+    so the two can never drift on what a worker inherits."""
+    env = dict(os.environ)
+    # workers join the active query span: the id usually rides
+    # os.environ already (query_span exports it), but a
+    # contextvar-only span still propagates here
+    try:
+        from bodo_tpu.utils import tracing
+        qid = tracing.current_query_id()
+        if qid:
+            env["BODO_TPU_QUERY_ID"] = qid
+    except Exception:  # pragma: no cover
+        pass
+    env.update({
+        "BODO_TPU_COORD": coord,
+        "BODO_TPU_NPROCS": str(n_processes),
+        "BODO_TPU_PROC_ID": str(i),
+        # stable gang identity: inherited when the spawner is itself a
+        # fleet gang (so sub-workers attribute to the owning gang),
+        # minted from the spawner pid otherwise — controller logs,
+        # /healthz and doctor output name gangs by this, never by
+        # pid/port
+        "BODO_TPU_GANG_ID":
+            os.environ.get("BODO_TPU_GANG_ID")
+            or f"gang-{os.getpid()}",
+        "BODO_TPU_RESIL_PATH": resil_path,
+        "BODO_TPU_HB_PATH": hb_path,
+        # lockstep side-channel logs share the gang temp dir (fresh
+        # per gang, so sequence numbers never collide with a previous
+        # gang's logs); the mode itself is armed via
+        # BODO_TPU_LOCKSTEP, inherited from the parent environment
+        "BODO_TPU_LOCKSTEP_DIR": d,
+        # trace shards ride the same gang-scoped side channel; the
+        # spawner merges them before the dir is cleaned up
+        "BODO_TPU_TRACE_SHARD_DIR": d,
+        "BODO_TPU_TRACING_LEVEL": str(_tracing_level()),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": pkg_root + os.pathsep +
+        env.get("PYTHONPATH", ""),
+    })
+    return env
 
 
 def run_spmd(fn: Callable[[int], object], n_processes: int = 2,
@@ -340,46 +409,8 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                 outs.append(out_path)
                 err_paths.append(err_path)
                 hb_paths.append(hb_path)
-                env = dict(os.environ)
-                # workers join the active query span: the id usually
-                # rides os.environ already (query_span exports it), but
-                # a contextvar-only span still propagates here
-                try:
-                    from bodo_tpu.utils import tracing
-                    qid = tracing.current_query_id()
-                    if qid:
-                        env["BODO_TPU_QUERY_ID"] = qid
-                except Exception:  # pragma: no cover
-                    pass
-                env.update({
-                    "BODO_TPU_COORD": coord,
-                    "BODO_TPU_NPROCS": str(n_processes),
-                    "BODO_TPU_PROC_ID": str(i),
-                    # stable gang identity: inherited when the spawner
-                    # is itself a fleet gang (so sub-workers attribute
-                    # to the owning gang), minted from the spawner pid
-                    # otherwise — controller logs, /healthz and doctor
-                    # output name gangs by this, never by pid/port
-                    "BODO_TPU_GANG_ID":
-                        os.environ.get("BODO_TPU_GANG_ID")
-                        or f"gang-{os.getpid()}",
-                    "BODO_TPU_RESIL_PATH": resil_path,
-                    "BODO_TPU_HB_PATH": hb_path,
-                    # lockstep side-channel logs share the gang temp
-                    # dir (fresh per gang, so sequence numbers never
-                    # collide with a previous gang's logs); the mode
-                    # itself is armed via BODO_TPU_LOCKSTEP, inherited
-                    # from the parent environment
-                    "BODO_TPU_LOCKSTEP_DIR": d,
-                    # trace shards ride the same gang-scoped side
-                    # channel; the spawner merges them before the dir
-                    # is cleaned up
-                    "BODO_TPU_TRACE_SHARD_DIR": d,
-                    "BODO_TPU_TRACING_LEVEL": str(_tracing_level()),
-                    "JAX_PLATFORMS": "cpu",
-                    "PYTHONPATH": pkg_root + os.pathsep +
-                    env.get("PYTHONPATH", ""),
-                })
+                env = _worker_env(d, i, n_processes, coord, resil_path,
+                                  pkg_root, hb_path)
                 # stderr goes to a file, not a pipe: the parent polls
                 # instead of blocking in communicate(), and a chatty
                 # worker can never deadlock on a full pipe buffer
@@ -389,16 +420,29 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                 procs.append(subprocess.Popen(
                     [sys.executable, worker_py, payload, out_path],
                     env=env, stdout=of, stderr=ef))
-            _register_gang_health(d, procs, hb_paths, start)
+            # shrink-evicted ranks (elastic layer) exit clean without a
+            # result and must read as reduced capacity, never as a gang
+            # failure — the marker file is the eviction record
+            def _evicted() -> set:
+                return {i for i in range(n_processes)
+                        if os.path.exists(os.path.join(d, f"evicted_{i}"))}
+
+            _register_gang_health(d, procs, hb_paths, start,
+                                  evicted=_evicted)
             reason, failing = _supervise(procs, hb_paths, start, timeout,
-                                         hb_timeout)
+                                         hb_timeout, evicted=_evicted)
             if reason is None:
                 results = []
+                gone = _evicted()
                 for i, out_path in enumerate(outs):
+                    if i in gone:
+                        continue
                     if not os.path.exists(out_path):
                         reason, failing = "missing result", {i}
                         break
                 else:
+                    outs = [o for i, o in enumerate(outs)
+                            if i not in gone]
                     for out_path in outs:
                         with open(out_path, "rb") as f:
                             results.append(pickle.load(f))
@@ -437,9 +481,15 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                     pass
             ranks: Dict[int, dict] = {}
             transient = bool(failing)
+            gone = _evicted()
             for i, p in enumerate(procs):
                 rc = p.poll()
-                if i in failing:
+                if i in gone:
+                    # exited clean after shrink-eviction: reduced
+                    # capacity, not a failed rank — the flight-recorder
+                    # manifest must not blame it for the gang failure
+                    state = "evicted"
+                elif i in failing:
                     state = ("hung" if reason == "hung worker" else
                              "timeout" if reason == "gang timeout" else
                              "dead")
@@ -475,28 +525,38 @@ def _run_gang(fn: Callable[[int], object], n_processes: int,
                 h.close()
 
 
-def _supervise(procs, hb_paths, start, timeout, hb_timeout):
+def _supervise(procs, hb_paths, start, timeout, hb_timeout,
+               evicted=None):
     """Wait on all ranks concurrently against one shared deadline.
     Returns (None, set()) when every rank exited 0, else
     (reason, failing_rank_set) at the FIRST failure — a dead rank is
-    noticed within one poll interval, not after earlier ranks finish."""
+    noticed within one poll interval, not after earlier ranks finish.
+
+    `evicted` is an optional callable returning the set of ranks the
+    elastic layer shrink-evicted: those exited (or were torn down)
+    deliberately, so they are excluded from the death/hang checks and
+    from the all-exited-clean completion condition — a rank that left
+    the mesh on purpose is not a rank that died."""
     deadline = start + timeout
     while True:
         now = time.monotonic()
+        gone = set(evicted()) if evicted is not None else set()
         rcs = [p.poll() for p in procs]
-        dead = {i for i, rc in enumerate(rcs) if rc not in (None, 0)}
+        dead = {i for i, rc in enumerate(rcs)
+                if rc not in (None, 0) and i not in gone}
         if dead:
             return "worker death", dead
-        if all(rc == 0 for rc in rcs):
+        if all(rc == 0 for i, rc in enumerate(rcs) if i not in gone) \
+                and all(rc is not None for rc in rcs):
             return None, set()
         hung = set()
         for i, rc in enumerate(rcs):
-            if rc is None and _hb_age(hb_paths[i],
-                                      now - start) > hb_timeout:
+            if rc is None and i not in gone and \
+                    _hb_age(hb_paths[i], now - start) > hb_timeout:
                 hung.add(i)
         if hung:
             return "hung worker", hung
         if now >= deadline:
             return "gang timeout", {i for i, rc in enumerate(rcs)
-                                    if rc is None}
+                                    if rc is None and i not in gone}
         time.sleep(_POLL_S)

@@ -63,12 +63,15 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # controller singleton, fault-injected gang deaths, process-wide
 # result-cache ownership env), so it runs alone; wall time is bounded
 # by the same per-group watchdog as every other group.
+# test_elastic.py spawns real elastic gangs with armed kill/raise
+# faults and asserts on the process-wide elastic serving state,
+# lockstep mesh epochs and resilience counters, so it runs alone too.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
              "test_telemetry.py", "test_device_decode.py",
              "test_comm_observatory.py", "test_fused_join.py",
              "test_result_cache.py", "test_scheduler.py",
-             "test_fleet.py")
+             "test_fleet.py", "test_elastic.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,643 @@
+"""Elastic gangs: stage-checkpointed shrink-grow recovery
+(runtime/elastic.py + the spawn/lockstep/telemetry/scheduler/doctor
+integration).
+
+Covers the two-phase CheckpointStore (atomic commit, gang-wide resume
+frontier, bounded retention), the recovery fault points in the
+resilience registry, THE chaos regression — a real 3-process gang loses
+rank 1 to an armed kill mid-pipeline and completes bit-identical on the
+2 survivors, with the report / /healthz capacity / doctor bundle all
+naming the evicted rank — lockstep coherence across the re-mesh
+(epoch-namespaced sequence logs written by real renumbered survivors),
+the fault-during-recovery fallback (a sabotaged re-mesh degrades to the
+gang-level retry with a typed ElasticError, never a wedge), the grow
+path (a replacement worker re-admitted at a stage boundary), the
+serving integration (scheduler resume-once on RankLost, query-boundary
+capacity restore, admission signals parsed from the /healthz elastic
+block), and the ``checkpoint-non-idempotent`` shardcheck lint rule.
+
+Runs ISOLATED (runtests.py): spawns real elastic gangs with armed
+kill/raise faults and asserts on the process-wide elastic serving
+state, lockstep mesh epochs and resilience counters. The four
+real-gang chaos tests carry ``@pytest.mark.slow`` (repo convention for
+multi-process tests) so the quick tier-1 gate stays inside its wall
+budget; runtests.py's full suite runs them in this file's isolated
+group.
+"""
+
+import glob
+import json
+import os
+import textwrap
+import time
+
+import pandas as pd
+import pytest
+
+from bodo_tpu.config import config, set_config
+from bodo_tpu import spawn
+from bodo_tpu.analysis import lint, lockstep
+from bodo_tpu.runtime import elastic, resilience
+from bodo_tpu.runtime import scheduler as sched_mod
+from bodo_tpu.runtime import telemetry
+from bodo_tpu.runtime.elastic import (
+    CheckpointStore,
+    ElasticError,
+    RankLost,
+    default_merge,
+    default_split,
+    is_resumable,
+    run_elastic,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_elastic():
+    elastic.reset()
+    resilience.disarm()
+    resilience.reset_stats()
+    sched_mod.reset()
+    yield
+    elastic.reset()
+    resilience.disarm()
+    sched_mod.reset()
+    set_config(elastic=True, elastic_grow=True, elastic_gang_retries=1,
+               flight_dir="", faults="", serve_admission=True)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointStore: two-phase commit, resume frontier, retention
+# ---------------------------------------------------------------------------
+
+
+def test_store_register_is_invisible_until_commit(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    tok = st.register(stage=0, epoch=0, worker=0, state=[1, 2, 3])
+    # registered but uncommitted: the .tmp staging file must not read
+    # as a usable checkpoint
+    assert st.scan() == {}
+    st.commit(tok)
+    assert st.scan() == {(0, 0): {0}}
+    assert st.load(0, 0, 0) == [1, 2, 3]
+    s = st.stats()
+    assert s["registered"] == 1 and s["committed"] == 1
+    assert s["bytes"] > 0
+
+
+def test_store_resume_point_is_common_frontier(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    for s in (0, 1, 2):
+        st.commit(st.register(stage=s, epoch=0, worker=0, state=s))
+    for s in (0, 1):
+        st.commit(st.register(stage=s, epoch=0, worker=1, state=s))
+    # the resume point is the highest stage EVERY worker committed —
+    # the slowest (or dead) rank's frontier, not the fastest's
+    assert st.complete_stage(0, [0, 1]) == 1
+    assert st.complete_stage(0, [0]) == 2
+    assert st.complete_stage(0, [0, 1, 2]) is None  # worker 2: nothing
+
+
+def test_store_prune_keeps_resume_point(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    for s in (0, 1, 2):
+        st.commit(st.register(stage=s, epoch=0, worker=0, state=s))
+    st.prune(0, 0, keep_from_stage=1)
+    assert st.scan()[(0, 0)] == {1, 2}
+    st.commit(st.register(stage=0, epoch=1, worker=0, state="new"))
+    st.prune_epochs_below(1, 0)
+    assert set(st.scan()) == {(1, 0)}
+    assert st.stats()["pruned"] == 3
+
+
+def test_store_budget_accounting(tmp_path):
+    st = CheckpointStore(str(tmp_path), budget_bytes=8)
+    st.commit(st.register(stage=0, epoch=0, worker=0,
+                          state=list(range(1000))))
+    s = st.stats()
+    assert s["bytes"] > s["budget_bytes"]
+    assert s["over_budget"] >= 1
+
+
+def test_store_reshard_n_to_n_minus_1(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    shards = [[0, 1, 2], [3, 4], [5, 6, 7]]
+    for w, sh in enumerate(shards):
+        st.commit(st.register(stage=1, epoch=0, worker=w, state=sh))
+    out = st.reshard(0, 1, [0, 1, 2], 2, default_merge, default_split)
+    assert len(out) == 2
+    assert [x for s in out for x in s] == list(range(8))
+
+
+def test_default_merge_split_shapes():
+    assert default_split(default_merge([[1, 2], [3]]), 2) == [[1, 2], [3]]
+    df = pd.DataFrame({"a": range(10)})
+    parts = default_split(df, 3)
+    assert [len(p) for p in parts] == [3, 4, 3]
+    pd.testing.assert_frame_equal(default_merge(parts), df)
+    assert default_merge([None, None]) is None
+    assert default_split(None, 2) == [None, None]
+    with pytest.raises(TypeError):
+        default_merge([{1}, {2}])
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: recovery fault points in the resilience registry
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_fault_points_registered():
+    for p in ("elastic.checkpoint", "elastic.remesh", "elastic.resume"):
+        assert p in resilience.POINTS
+    faults = resilience.parse_faults(
+        "elastic.checkpoint@1=kill:2,"
+        "elastic.remesh=raise:OSError:1:3,elastic.resume=latency:0.01")
+    assert len(faults) == 3
+
+
+def test_elastic_fault_point_fires():
+    resilience.arm("elastic.remesh=raise:OSError:1:1")
+    with pytest.raises(OSError):
+        resilience.maybe_inject("elastic.remesh")
+    resilience.maybe_inject("elastic.remesh")  # times=1: spent
+    assert resilience.stats()["faults_fired"]["elastic.remesh"] == 1
+
+
+def test_is_resumable_contract():
+    e = RankLost("lost", evicted=[1], epoch=2)
+    assert is_resumable(e) and e.evicted == [1] and e.epoch == 2
+    assert not is_resumable(RuntimeError("boom"))
+    marked = RuntimeError("rank gone")
+    marked.rank_lost = True
+    assert is_resumable(marked)
+    # lockstep divergence is a correctness bug, never resumed
+    assert not is_resumable(lockstep.LockstepError("diverged"))
+
+
+# ---------------------------------------------------------------------------
+# THE chaos regression: kill @rank mid-pipeline, complete on N-1
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_shrink_recovers_bit_identical(tmp_path, monkeypatch):
+    """Rank 1 of a real 3-process gang is killed at its 2nd stage
+    checkpoint. The gang must re-mesh onto the 2 survivors, resume from
+    the last complete checkpoint, and produce the bit-identical result
+    of a clean 3-rank run — while /healthz reports reduced capacity and
+    the flight bundle names the evicted rank."""
+    monkeypatch.setenv("BODO_TPU_FAULTS", "elastic.checkpoint@1=kill:2")
+    set_config(flight_dir=str(tmp_path / "fr"))
+
+    def init(rank, nprocs):
+        rows = list(range(30))
+        b = [round(i * 30 / nprocs) for i in range(nprocs + 1)]
+        return rows[b[rank]:b[rank + 1]]
+
+    def s0(state, ctx):
+        return [x * 2 for x in state]
+
+    def s1(state, ctx):
+        import time as _t
+        _t.sleep(0.2)
+        return [x + 1 for x in state]
+
+    def s2(state, ctx):
+        return [x * x for x in state]
+
+    run = run_elastic([s0, s1, s2], 3, init=init, timeout=120,
+                      grow=False)
+    whole = [x for sh in run.results for x in sh]
+    assert whole == [(x * 2 + 1) ** 2 for x in range(30)]
+    assert len(run.results) == 2
+
+    rep = run.report
+    assert rep["shrinks"] == 1 and rep["epochs"] == 1
+    assert rep["final_nprocs"] == 2 and rep["grows"] == 0
+    assert rep["evicted"] == {1: "dead"}
+    assert rep["mttr_s"] is not None and rep["mttr_s"] < 60.0
+    # the parent's view of the checkpoint store (commits happen in the
+    # workers; the parent scans, reshards and prunes)
+    assert set(rep["ckpt"]) >= {"registered", "committed", "pruned",
+                                "bytes", "budget_bytes"}
+
+    # serving state: the /healthz elastic block reports the shrink as
+    # reduced capacity the fleet admission twin rescales by
+    h = elastic.head()
+    assert h["epoch"] == 1 and h["evicted"] == [1]
+    assert h["capacity_frac"] == pytest.approx(2 / 3, abs=1e-3)
+    sig = sched_mod.signals_from_health({"elastic": h})
+    assert sig.gang_capacity_frac == pytest.approx(2 / 3, abs=1e-3)
+    assert sig.elastic_epoch == 1
+    # ...and the next query boundary restores full width (grow path)
+    assert elastic.note_query_boundary() is True
+    assert elastic.head()["capacity_frac"] == 1.0
+
+    # the shrink flight bundle names the evicted worker, in both the
+    # machine triage and the human rendering
+    from bodo_tpu import doctor
+    bundles = glob.glob(
+        os.path.join(str(tmp_path / "fr"), "*elastic_shrink_e1*"))
+    assert bundles, "no shrink flight bundle was dumped"
+    tri = doctor.triage(bundles[0])
+    assert tri["evicted_ranks"] == [1]
+    assert tri["elastic"]["evicted_workers"] == [1]
+    assert tri["elastic"]["survivors"] == [0, 2]
+    assert tri["elastic"]["resume_stage"] is not None
+    rendered = doctor.render(tri)
+    assert "EVICTED worker 1 (dead)" in rendered
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: lockstep coherence across the re-mesh (real 3 -> 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_lockstep_epoch_namespacing_across_shrink(monkeypatch):
+    """Survivors of a real 3 -> 2 shrink renumber contiguously and
+    restart lockstep under the new mesh epoch: fresh epoch-suffixed
+    logs, fresh sequence numbers, and peer cross-checking that passes
+    on the shrunk mesh (stale epoch-0 streams are never consulted)."""
+    monkeypatch.setenv("BODO_TPU_LOCKSTEP", "1")
+    monkeypatch.setenv("BODO_TPU_FAULTS", "elastic.checkpoint@1=kill:2")
+
+    def init(rank, nprocs):
+        return []
+
+    def mk(i):
+        def s(state, ctx):
+            import os as _os
+            import time as _t
+            from bodo_tpu.analysis import lockstep as ls
+            ls.pre_collective("psum")  # fingerprinted + cross-checked
+            if i == 1:
+                _t.sleep(0.2)
+            d = _os.environ.get("BODO_TPU_LOCKSTEP_DIR", "")
+            logs = sorted(n for n in _os.listdir(d)
+                          if n.startswith("lockstep"))
+            return state + [{"stage": i, "ls_epoch": ls.mesh_epoch(),
+                             "rank": ctx.rank, "nprocs": ctx.nprocs,
+                             "epoch": ctx.epoch, "logs": logs}]
+        return s
+
+    run = run_elastic([mk(0), mk(1), mk(2)], 3, init=init, timeout=120,
+                      grow=False)
+    assert run.report["shrinks"] == 1 and run.report["final_nprocs"] == 2
+    ev = [e for sh in run.results for e in sh]
+    assert ev, "no evidence came back from the survivors"
+    # everything that survived into the final state ran post-re-mesh:
+    # epoch 1, contiguous ranks {0, 1}, nprocs 2, lockstep epoch 1
+    assert all(e["epoch"] == 1 and e["nprocs"] == 2 and
+               e["ls_epoch"] == 1 for e in ev)
+    assert {e["rank"] for e in ev} == {0, 1}
+    # the final stage sees BOTH survivors' epoch-1 logs (the peer
+    # cross-check read them) alongside the epoch-0 logs they replaced
+    last = [e for e in ev if e["stage"] == 2]
+    for e in last:
+        assert "lockstep_e1_0.log" in e["logs"]
+        assert "lockstep_e1_1.log" in e["logs"]
+        assert "lockstep_0.log" in e["logs"]
+
+
+def test_lockstep_mesh_epoch_units(tmp_path):
+    lockstep.reset()
+    assert lockstep._log_name(0, 1) == "lockstep_1.log"
+    assert lockstep._log_name(2, 0) == "lockstep_e2_0.log"
+    lockstep.set_mesh_epoch(3)
+    assert lockstep.mesh_epoch() == 3
+    # epoch-suffixed log, epoch-prefixed fingerprint, seq from 1
+    c = lockstep.Checker(str(tmp_path), rank=0, nprocs=1, epoch=3)
+    c.check("psum", "f.py:1")
+    c.close()
+    log = tmp_path / "lockstep_e3_0.log"
+    assert log.exists()
+    first = log.read_text().splitlines()[0].split("\t")
+    assert first[0] == "1" and first[1] == "e3:psum@f.py:1"
+    lockstep.reset()
+    assert lockstep.mesh_epoch() == 0
+
+
+# ---------------------------------------------------------------------------
+# fault during recovery itself: fall back to gang retry, never wedge
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_remesh_fault_falls_back_to_gang_retry(monkeypatch):
+    """Kill rank 1, then sabotage every survivor's re-mesh adoption:
+    recovery fails, the outer loop burns its gang-level retry (which
+    re-fires both faults), and the caller gets a typed ElasticError
+    with recovery_failed=True — bounded, never a wedge."""
+    monkeypatch.setenv(
+        "BODO_TPU_FAULTS",
+        "elastic.checkpoint@1=kill:2,elastic.remesh=raise:OSError:1:99")
+    set_config(elastic_gang_retries=1)
+
+    def init(rank, nprocs):
+        return list(range(rank, 30, nprocs))
+
+    def s0(state, ctx):
+        return [x * 2 for x in state]
+
+    def s1(state, ctx):
+        import time as _t
+        _t.sleep(0.2)
+        return [x + 1 for x in state]
+
+    t0 = time.monotonic()
+    with pytest.raises(ElasticError) as ei:
+        run_elastic([s0, s1], 3, init=init, timeout=60, grow=False)
+    assert ei.value.recovery_failed
+    assert ei.value.reason == "worker death"
+    assert 1 in ei.value.ranks
+    assert time.monotonic() - t0 < 55.0
+    assert resilience.stats()["gang_retries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# grow: background re-admission of a replacement worker
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_grow_readmits_replacement_worker(monkeypatch):
+    monkeypatch.setenv("BODO_TPU_FAULTS", "elastic.checkpoint@1=kill:2")
+
+    def init(rank, nprocs):
+        rows = list(range(30))
+        b = [round(i * 30 / nprocs) for i in range(nprocs + 1)]
+        return rows[b[rank]:b[rank + 1]]
+
+    def mk(i):
+        def s(state, ctx):
+            import time as _t
+            _t.sleep(0.7)
+            return [x + i for x in state]
+        return s
+
+    run = run_elastic([mk(i) for i in range(6)], 3, init=init,
+                      timeout=120, grow=True)
+    whole = sorted(x for sh in run.results for x in sh)
+    assert whole == sorted(x + sum(range(6)) for x in range(30))
+    rep = run.report
+    assert rep["shrinks"] == 1 and rep["grows"] >= 1
+    assert rep["final_nprocs"] == 3
+    assert rep["evicted"] == {1: "dead"}
+
+
+# ---------------------------------------------------------------------------
+# straggler-eviction policy (checkpoint-frontier attribution)
+# ---------------------------------------------------------------------------
+
+
+def test_find_straggler_frontier_stall(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    for w in (0, 2):
+        for s in (0, 1):
+            st.commit(st.register(stage=s, epoch=0, worker=w, state=s))
+    st.commit(st.register(stage=0, epoch=0, worker=1, state=0))
+    seen = {}
+    rank_of = {0: 0, 1: 1, 2: 2}
+    # first observation only records the frontier — no instant verdict
+    assert elastic._find_straggler(str(tmp_path), st, 0, [0, 1, 2],
+                                   rank_of, seen, 0.05) is None
+    time.sleep(0.08)
+    assert elastic._find_straggler(str(tmp_path), st, 0, [0, 1, 2],
+                                   rank_of, seen, 0.05) == 1
+    # a frontier that is even across the gang is never a straggler
+    st.commit(st.register(stage=1, epoch=0, worker=1, state=1))
+    assert elastic._find_straggler(str(tmp_path), st, 0, [0, 1, 2],
+                                   rank_of, seen, 0.05) is None
+    # disabled policy short-circuits
+    assert elastic._find_straggler(str(tmp_path), st, 0, [0, 1, 2],
+                                   rank_of, {}, 0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: supervision + /healthz distinguish "evicted" from "died"
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+
+def _touch_hb(tmp_path, name):
+    p = tmp_path / name
+    p.write_text("hb")
+    return str(p)
+
+
+def test_supervise_excludes_evicted_ranks(tmp_path):
+    hb = [_touch_hb(tmp_path, f"hb_{i}") for i in range(2)]
+    now = time.monotonic()
+    # rank 1 exited non-zero but was shrink-evicted: not a death, and
+    # the gang completes when the survivors are done
+    reason, failing = spawn._supervise(
+        [_FakeProc(0), _FakeProc(1)], hb, now, 0.2, 15.0,
+        evicted=lambda: {1})
+    assert reason is None and failing == set()
+    # without the eviction marker the same exit IS a death
+    reason, failing = spawn._supervise(
+        [_FakeProc(0), _FakeProc(1)], hb, now, 0.2, 15.0)
+    assert reason == "worker death" and failing == {1}
+
+
+def test_healthz_reports_evicted_not_unhealthy(tmp_path):
+    hb = [_touch_hb(tmp_path, f"hb_{i}") for i in range(2)]
+    procs = [_FakeProc(None), _FakeProc(1)]
+    spawn._register_gang_health(str(tmp_path), procs, hb,
+                                time.monotonic(), evicted=lambda: {1})
+    try:
+        doc = telemetry.health()
+        assert doc["status"] == "ok"
+        assert doc["gang"]["1"]["evicted"] is True
+        assert doc["evicted_ranks"] == [1]
+        assert "unhealthy_ranks" not in doc
+        assert "elastic" in doc  # capacity block rides /healthz
+    finally:
+        spawn._clear_gang_health()
+    # the same dead rank WITHOUT the eviction marker degrades the gang
+    spawn._register_gang_health(str(tmp_path), procs, hb,
+                                time.monotonic())
+    try:
+        doc = telemetry.health()
+        assert doc["status"] == "degraded"
+        assert doc["unhealthy_ranks"] == [1]
+    finally:
+        spawn._clear_gang_health()
+
+
+# ---------------------------------------------------------------------------
+# serving state: shrink accounting, sample() block, scheduler resume
+# ---------------------------------------------------------------------------
+
+
+def test_serving_state_shrink_grow_accounting():
+    elastic._note_shrink([2], 3, 2)
+    h = elastic.head()
+    assert h["epoch"] == 1 and h["shrinks"] == 1
+    assert h["evicted"] == [2] and h["grow_pending"]
+    assert h["capacity_frac"] == pytest.approx(2 / 3, abs=1e-3)
+    elastic._note_grow()
+    h = elastic.head()
+    assert h["capacity_frac"] == 1.0 and h["evicted"] == []
+    assert not h["grow_pending"]
+    elastic.note_mttr(1.25)
+    elastic.note_resume()
+    h = elastic.head()
+    assert h["last_mttr_s"] == 1.25 and h["resumes"] == 1
+    # the telemetry sampler carries the block once recovery happened
+    samp = telemetry.sample()
+    assert samp["elastic"]["shrinks"] == 1
+
+
+def test_note_query_boundary_requires_pending_grow():
+    assert elastic.note_query_boundary() is False
+    elastic._note_shrink([1], 2, 1)
+    set_config(elastic_grow=False)
+    assert elastic.note_query_boundary() is False  # grow disabled
+    set_config(elastic_grow=True)
+    assert elastic.note_query_boundary() is True
+    assert elastic.note_query_boundary() is False  # one-shot
+
+
+def test_observe_stage_counts_checkpoint_anchors():
+    elastic.observe_stage(object(), 0.01)
+    elastic.observe_stage(object(), 0.02)
+    ck = elastic.head()["checkpoints"]
+    assert ck["registered"] == 2 and ck["committed"] == 2
+    set_config(elastic=False)
+    elastic.observe_stage(object(), 0.03)
+    set_config(elastic=True)
+    assert elastic.head()["checkpoints"]["registered"] == 2
+
+
+def test_scheduler_resumes_rank_loss_once():
+    """The scheduler fails nothing it can resume: a RankLost from an
+    elastic gang re-runs the thunk exactly once; the session future
+    gets the result, the resume is counted, a second loss fails typed."""
+    from bodo_tpu import serve
+    calls = {"n": 0}
+
+    def thunk():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RankLost("rank lost mid-query", evicted=[1], epoch=1)
+        return 42
+
+    # resume is under test, not admission: after a few hundred shared-
+    # process tests the governor occupancy legitimately sits near/over
+    # 1.0 and the admission twin would (correctly) shed these submits
+    set_config(serve_admission=False)
+    s = serve.session("elastic-resume")
+    assert s.run(thunk, timeout=60.0) == 42
+    assert calls["n"] == 2
+    assert sched_mod.stats()["resumed"] == 1
+    assert elastic.head()["resumes"] == 1
+
+    def always_lost():
+        raise RankLost("still losing ranks")
+
+    with pytest.raises(sched_mod.QueryFailed):
+        s.run(always_lost, timeout=60.0)
+
+
+# ---------------------------------------------------------------------------
+# doctor: triage of a shrink bundle (synthetic)
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_triage_elastic_bundle(tmp_path):
+    from bodo_tpu import doctor
+    b = tmp_path / "bundle_elastic"
+    b.mkdir()
+    (b / "manifest.json").write_text(json.dumps({
+        "reason": "elastic_shrink_e1", "iso_time": "2026-08-07T00:00:00",
+        "ranks": {"0": {"state": "running"},
+                  "1": {"state": "evicted", "returncode": 0,
+                        "evicted_reason": "straggler"},
+                  "2": {"state": "running"}}}))
+    (b / "remesh.json").write_text(json.dumps({
+        "epoch": 1, "prev_epoch": 0, "prev_workers": [0, 1, 2],
+        "workers": {"0": 0, "2": 1}, "evicted": [1],
+        "resume_stage": 2, "reason": "straggler",
+        "coord": "127.0.0.1:1", "ts": 0}))
+    tri = doctor.triage(str(b))
+    assert tri["evicted_ranks"] == [1]
+    assert tri["dead_ranks"] == []
+    el = tri["elastic"]
+    assert el["evicted_workers"] == [1] and el["survivors"] == [0, 2]
+    assert el["resume_stage"] == 2
+    assert el["evicted_reasons"] == {"1": "straggler"}
+    rendered = doctor.render(tri)
+    assert "EVICTED worker 1 (straggler)" in rendered
+    assert "(evicted: straggler)" in rendered
+    assert "resumed from stage 2" in rendered
+
+
+# ---------------------------------------------------------------------------
+# satellite 6: checkpoint-non-idempotent shardcheck rule
+# ---------------------------------------------------------------------------
+
+
+def _lint_src(tmp_path, source):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(source))
+    return lint.lint_file(str(p), root=str(tmp_path))
+
+
+class TestCheckpointLint:
+    def test_effect_inside_window_flagged(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def snap(store, sock, state):
+                tok = store.register(0, 0, 0, state)
+                sock.send(b"progress")
+                store.commit(tok)
+        """)
+        assert [f.rule for f in got] == ["checkpoint-non-idempotent"]
+        assert "replays" in got[0].message
+
+    def test_adjacent_register_commit_clean(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def snap(store, sock, state):
+                tok = store.register(0, 0, 0, state)
+                store.commit(tok)
+                sock.send(b"progress")
+        """)
+        assert got == []
+
+    def test_non_store_receiver_out_of_scope(self, tmp_path):
+        # .register on something that is not a checkpoint store does
+        # not open a window
+        got = _lint_src(tmp_path, """
+            def hook(bus, sock):
+                bus.register(on_event)
+                sock.send(b"x")
+        """)
+        assert got == []
+
+    def test_suppression_comment(self, tmp_path):
+        got = _lint_src(tmp_path, """
+            def snap(ckpt, f, state):
+                tok = ckpt.register(0, 0, 0, state)
+                f.write(b"x")  # shardcheck: ignore[checkpoint-non-idempotent]
+                ckpt.commit(tok)
+        """)
+        assert got == []
+
+    def test_nested_function_body_excluded(self, tmp_path):
+        # a callback DEFINED inside the window runs later, not inside it
+        got = _lint_src(tmp_path, """
+            def snap(store, state):
+                tok = store.register(0, 0, 0, state)
+                def later(f):
+                    f.write(b"x")
+                store.commit(tok)
+                return later
+        """)
+        assert got == []

@@ -31,7 +31,10 @@ Suites:
     repeat / 10% novel request mix with ~1% appends between rounds;
     headline is the repeat speedup over the cold wall (bar >= 20x),
     with hit rate, repeat p50 and the incremental-refresh ratio after
-    an append (bar <= 0.10) as independently-watched series.
+    an append (bar <= 0.10) as independently-watched series. Includes
+    a continuous-query phase: standing materialized views in a 2-level
+    DAG (bodo_tpu.views) under an append-heavy mix, watched via
+    view_refresh_ratio / view_staleness_p99_s / view_fanout_depth.
 
 Any suite accepts --compare to run the benchwatch trajectory check
 (python -m bodo_tpu.benchwatch) over the repo's BENCH_r*.json after
@@ -1708,6 +1711,127 @@ def _serve_multitenant(args, templates, novel_fn, data_dir) -> dict:
     return out
 
 
+def _serve_views(args, n_rows: int) -> dict:
+    """Continuous-query phase of --suite serve, driven through
+    bodo_tpu.views (runtime/views.py): K standing materialized views
+    forming a 2-level DAG (base scan -> daily aggregate -> weekly
+    rollup, plus a filtered sibling) under an append-heavy 90/10
+    read/append mix. A tenant session subscribes to the rollup; every
+    append must be detected by the scheduler's signature watcher and
+    the refreshed rollup delivered through the subscription's serve
+    future. Reports the maintained-refresh wall against the
+    cleared-cache full recompute (acceptance bar: ratio <= 0.10 at
+    benched scale; the refreshed frame is asserted bit-identical), the
+    p99 change->refresh staleness, and the DAG fan-out depth."""
+    import shutil
+
+    import numpy as np
+    import pandas as pd
+
+    import bodo_tpu
+    from bodo_tpu import pandas_api as bpd
+    from bodo_tpu import serve
+    from bodo_tpu.config import config, set_config
+    from bodo_tpu.plan.physical import _result_cache
+    from bodo_tpu.runtime import result_cache as rcache
+
+    views = bodo_tpu.views
+    data_dir = os.path.join(_REPO, ".bench_data", f"views_{n_rows}")
+    shutil.rmtree(data_dir, ignore_errors=True)
+    os.makedirs(data_dir)
+    rng = np.random.default_rng(11)
+    part_idx = [0]
+
+    def write_part(n: int) -> None:
+        pd.DataFrame({
+            "day": rng.integers(0, 28, n).astype(np.int64),
+            "v": rng.integers(0, 1_000_000, n).astype(np.int64),
+        }).to_parquet(os.path.join(
+            data_dir, f"part-{part_idx[0]:05d}.parquet"))
+        part_idx[0] += 1
+
+    for _ in range(8):
+        write_part(max(1000, n_rows // 8))
+    append_rows = max(200, n_rows // 100)
+
+    views.reset()
+    _result_cache.clear()
+    rcache.reset_stats()
+    base = bpd.read_parquet(data_dir)
+    views.create_view("bench_daily", base.groupby(
+        "day", as_index=False).agg(s=("v", "sum"), c=("v", "count")))
+    daily = views.read("bench_daily")
+    views.create_view("bench_weekly", daily.assign(
+        week=daily["day"] // 7).groupby("week", as_index=False).agg(
+        ws=("s", "sum"), wc=("c", "sum")))
+    hot = views.read("bench_daily")
+    views.create_view("bench_daily_hot", hot[hot["s"] > 0].groupby(
+        "day", as_index=False).agg(hs=("s", "max")))
+
+    old_poll = config.view_poll_s
+    set_config(view_poll_s=0.1)
+    serve.start()
+    sess = serve.session("views_client")
+    names = ["bench_weekly", "bench_daily", "bench_daily_hot"]
+    try:
+        # prime the DAG so base signatures exist before subscribing
+        for nm in names:
+            sess.run(lambda nm=nm: views.read(nm).to_pandas(),
+                     timeout=600)
+        sub = sess.subscribe("bench_weekly", max_staleness_s=2.0)
+
+        rounds = 2 if args.quick else 4
+        reads = appends = 0
+        for _ in range(rounds):
+            for j in range(10):       # 90/10 read/append mix
+                if j == 9:
+                    write_part(append_rows)
+                    appends += 1
+                    sub.next(timeout=300)   # watcher -> refresh -> us
+                else:
+                    nm = names[j % len(names)]
+                    sess.run(lambda nm=nm: views.read(nm).to_pandas(),
+                             timeout=600)
+                    reads += 1
+        sub.cancel()
+
+        # maintained refresh vs cleared-cache full recompute on one
+        # more append (outside the watcher: deterministic timing)
+        write_part(append_rows)
+        t0 = time.perf_counter()
+        maintained = views.read("bench_weekly").to_pandas()
+        maintained_s = time.perf_counter() - t0
+        _result_cache.clear()
+        t0 = time.perf_counter()
+        full = views.read("bench_weekly").to_pandas()
+        full_s = time.perf_counter() - t0
+        ratio = maintained_s / full_s if full_s > 0 else 1.0
+        pd.testing.assert_frame_equal(
+            maintained.sort_values("week").reset_index(drop=True),
+            full.sort_values("week").reset_index(drop=True),
+            check_exact=True)
+        vs = views.stats()
+        return {
+            "n_views": vs["n_views"],
+            "dag_depth": vs["dag_depth"],
+            "rounds": rounds, "reads": reads, "appends": appends,
+            "append_rows": append_rows,
+            "refreshes_incremental": vs["refreshes_incremental"],
+            "refreshes_full": vs["refreshes_full"],
+            "maintained_refresh_s": round(maintained_s, 4),
+            "full_recompute_s": round(full_s, 4),
+            "refresh_ratio": round(ratio, 4),
+            "staleness_p99_s": round(vs["staleness_p99_s"], 4),
+            "refresh_bit_identical": True,
+            "watcher": {k: vs.get(k, 0) for k in
+                        ("ticks", "detected_stale",
+                         "refresh_scheduled", "refresh_rejected")},
+        }
+    finally:
+        set_config(view_poll_s=old_poll)
+        views.reset()
+
+
 def _serve_fleet(args, n_rows: int) -> dict:
     """Fleet phases of --suite serve (``--gangs N``), driven through
     the bodo_tpu.fleet client surface (runtime/fleet.py):
@@ -2014,7 +2138,11 @@ def bench_serve(args, n_rows: int):
     recompute, bar <= 0.10, refreshed frame asserted bit-identical),
     plus serve_qps (qps, regresses down), serve_p50_s / serve_p99_s (s,
     regress up) and serve_isolation (hitrate: 1.0 = the isolation
-    assertion held)."""
+    assertion held). Part three (_serve_views) runs the
+    continuous-query phase — K standing materialized views in a 2-level
+    DAG under an append-heavy 90/10 mix — and contributes
+    view_refresh_ratio (frac), view_staleness_p99_s (s) and
+    view_fanout_depth (x)."""
     import shutil
 
     import jax
@@ -2134,6 +2262,7 @@ def bench_serve(args, n_rows: int):
 
     st = rcache.stats()  # single-tenant mix snapshot (phase 3 resets)
     mt = _serve_multitenant(args, templates, novel, data_dir)
+    vw = _serve_views(args, n_rows)
     fl = _serve_fleet(args, n_rows) if getattr(args, "gangs", 0) > 1 \
         else None
     detail = {
@@ -2160,6 +2289,7 @@ def bench_serve(args, n_rows: int):
                    "host_bytes", "budget_bytes")},
         "saved_wall_s": round(st["saved_wall_s"], 3),
         "multitenant": mt,
+        "views": vw,
         "fleet": fl,
         "probe": getattr(args, "probe", {"attempted": False}),
         # independently-watched series (benchwatch lifts these into
@@ -2190,6 +2320,19 @@ def bench_serve(args, n_rows: int):
                 "metric": "serve_isolation",
                 "value": 1.0 if mt["isolation"]["passed"] else 0.0,
                 "unit": "hitrate"},
+            # continuous-query phase: maintained refresh vs full
+            # recompute (frac, regresses up), change->refresh p99
+            # staleness (s, regresses up), and the DAG depth the bench
+            # actually exercised (x: a drop means a lost view level)
+            "view_refresh_ratio": {
+                "metric": "view_refresh_ratio",
+                "value": vw["refresh_ratio"], "unit": "frac"},
+            "view_staleness_p99": {
+                "metric": "view_staleness_p99_s",
+                "value": vw["staleness_p99_s"], "unit": "s"},
+            "view_fanout_depth": {
+                "metric": "view_fanout_depth",
+                "value": float(vw["dag_depth"]), "unit": "x"},
         },
     }
     if fl is not None:
@@ -2200,6 +2343,12 @@ def bench_serve(args, n_rows: int):
           f"1% append {incr_s:.4f}s vs full {full_s:.4f}s "
           f"(ratio {ratio:.3f}, incremental="
           f"{refreshed_incrementally})", file=sys.stderr)
+    print(f"serve views: {vw['n_views']} views depth "
+          f"{vw['dag_depth']} over {vw['appends'] + 1} appends; "
+          f"maintained refresh {vw['maintained_refresh_s']:.4f}s vs "
+          f"full {vw['full_recompute_s']:.4f}s "
+          f"(ratio {vw['refresh_ratio']:.3f}); staleness p99 "
+          f"{vw['staleness_p99_s']:.3f}s", file=sys.stderr)
     print(f"serve multitenant: {mt['clients']} clients sustained "
           f"{mt['qps']:.1f} qps (p50 {mt['p50_s']:.4f}s p99 "
           f"{mt['p99_s']:.4f}s); overload shed "

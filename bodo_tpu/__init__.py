@@ -72,4 +72,7 @@ def __getattr__(name):
     if name == "fleet":
         import bodo_tpu.fleet as m
         return m
+    if name == "views":
+        import bodo_tpu.views as m
+        return m
     raise AttributeError(f"module 'bodo_tpu' has no attribute {name!r}")

@@ -462,6 +462,26 @@ class Config:
     fleet_dead_scrapes: int = field(
         default_factory=lambda: _env_int("BODO_TPU_FLEET_DEAD_SCRAPES", 3)
     )
+    # -- materialized views (runtime/views.py) -------------------------------
+    # Base signature-watcher poll interval for continuous queries; a
+    # subscription's max_staleness_s tightens the effective interval
+    # (poll at most every max_staleness_s/4, floored at 50 ms).
+    view_poll_s: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_VIEW_POLL_S", 1.0)
+    )
+    # Weighted-fair priority of the system maintenance session view
+    # refreshes run under (tenants are not billed for shared refreshes;
+    # < 1.0 keeps maintenance from starving interactive traffic).
+    view_maintenance_weight: float = field(
+        default_factory=lambda: _env_float("BODO_TPU_VIEW_MAINT_WEIGHT",
+                                           0.5)
+    )
+    # Per-source-file contribution maps (partition-level invalidation)
+    # are built only for datasets of at most this many files — the map
+    # costs one extra pass over the dataset per materialization.
+    view_max_parts: int = field(
+        default_factory=lambda: _env_int("BODO_TPU_VIEW_MAX_PARTS", 64)
+    )
     # -- resilience (runtime/resilience.py) ----------------------------------
     # Armed fault-injection spec (see resilience module docstring for the
     # grammar, e.g. "io.read=raise:OSError,collective=raise:Internal:1:0").

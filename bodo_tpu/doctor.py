@@ -247,6 +247,35 @@ def _triage_fleet(telemetry: Optional[dict]) -> Optional[dict]:
     return out
 
 
+def _triage_views(telemetry: Optional[dict]) -> Optional[dict]:
+    """Materialized-view triage from the bundle's telemetry samples:
+    name the lagging view (worst staleness), surface the refresh mix
+    and any maintenance rejections — a subscriber observing stale
+    results traces back to either a rejected refresh (queue pressure)
+    or a watcher that stopped ticking."""
+    samples = (telemetry or {}).get("samples") or []
+    vws = [s.get("views") for s in samples if s.get("views")]
+    if not vws:
+        return None
+    last = vws[-1]
+    out: dict = {
+        "n_views": int(last.get("n_views", 0)),
+        "dag_depth": int(last.get("dag_depth", 0)),
+        "subscriptions": int(last.get("subscriptions", 0)),
+        "refreshes_incremental": int(
+            last.get("refreshes_incremental", 0)),
+        "refreshes_full": int(last.get("refreshes_full", 0)),
+        "refresh_ratio": float(last.get("refresh_ratio", 0.0)),
+        "staleness_p99_s": float(last.get("staleness_p99_s", 0.0)),
+    }
+    if last.get("lagging_view"):
+        out["lagging_view"] = last["lagging_view"]
+    stale_series = [float(v.get("staleness_p99_s", 0.0)) for v in vws]
+    if max(stale_series) > 0:
+        out["staleness_peak_s"] = round(max(stale_series), 4)
+    return out
+
+
 def _triage_elastic(bundle: str, manifest: dict,
                     telemetry: Optional[dict]) -> Optional[dict]:
     """Elastic shrink-grow triage: the bundle's ``remesh.json`` (copied
@@ -359,6 +388,7 @@ def triage(bundle: str) -> dict:
     telem = _read_json(os.path.join(bundle, "telemetry.json"))
     out["memory"] = _triage_memory(telem)
     out["fleet"] = _triage_fleet(telem)
+    out["views"] = _triage_views(telem)
     out["elastic"] = _triage_elastic(bundle, manifest, telem)
     out["xla"] = _triage_xla(bundle)
     slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
@@ -539,6 +569,24 @@ def render(t: dict) -> str:
             reason = f" ({g['reason']})" if g.get("reason") else ""
             lines.append(f"  UNHEALTHY GANG {g['gang']}: "
                          f"{g['state']}{reason}")
+    vw = t.get("views")
+    if vw:
+        lines.append("materialized views:")
+        lines.append(
+            f"  {vw['n_views']} views (DAG depth {vw['dag_depth']}), "
+            f"{vw['subscriptions']} subscriptions; refreshes: "
+            f"{vw['refreshes_incremental']} incremental / "
+            f"{vw['refreshes_full']} full "
+            f"(ratio {vw['refresh_ratio']:.2f})")
+        if vw.get("lagging_view") and (
+                vw.get("staleness_p99_s", 0.0) > 0
+                or vw.get("staleness_peak_s")):
+            peak = vw.get("staleness_peak_s",
+                          vw.get("staleness_p99_s", 0.0))
+            lines.append(
+                f"  LAGGING VIEW {vw['lagging_view']!r}: staleness "
+                f"p99 {vw['staleness_p99_s']:.3f}s "
+                f"(peak {peak:.3f}s across samples)")
     x = t.get("xla")
     if x:
         lines.append("xla observatory:")

@@ -66,6 +66,22 @@ def _dataset_files(path):
     return files
 
 
+def split_rg_fragment(path: str):
+    """Split a row-group fragment path ``file.parquet#rg=LO-HI`` into
+    (base_path, (lo, hi)) — or (path, None) for a plain path. Fragments
+    name the trailing row groups of an in-place grown file (see
+    classify_change): they flow through explicit file lists exactly like
+    paths, and every consumer that opens/stats a file strips them here."""
+    if isinstance(path, str) and "#rg=" in path:
+        base, _, spec = path.rpartition("#rg=")
+        lo, _, hi = spec.partition("-")
+        try:
+            return base, (int(lo), int(hi))
+        except ValueError:
+            return path, None
+    return path, None
+
+
 def _open_one(path: str):
     """File-like handle for local or fsspec-remote paths. Remote handles
     must be closed by the caller — prefer `_opened` below."""
@@ -108,7 +124,12 @@ _footer_lock = _threading.Lock()
 def file_signature(path: str):
     """(path, mtime_ns, size) identity of one file — the cache key and
     the stats-store fingerprint component. Remote paths resolve via
-    fsspec info (mtime falls back to a created/LastModified stamp)."""
+    fsspec info (mtime falls back to a created/LastModified stamp).
+    Row-group fragment paths stat the base file but keep the fragment in
+    the returned identity, so a delta scan signs distinctly from a full
+    scan of the same file."""
+    ident = path
+    path, _rg = split_rg_fragment(path)
     if _is_remote(path):
         info = _fs_of(path).info(path.split("://", 1)[1])
         stamp = info.get("mtime") or info.get("LastModified") \
@@ -119,16 +140,17 @@ def file_signature(path: str):
             stamp = int(float(stamp) * 1e9)
         except (TypeError, ValueError):
             stamp = 0
-        return (path, stamp, int(info.get("size") or 0))
+        return (ident, stamp, int(info.get("size") or 0))
     st = os.stat(path)
-    return (path, st.st_mtime_ns, st.st_size)
+    return (ident, st.st_mtime_ns, st.st_size)
 
 
 def footer_metadata(path: str, sig=None):
     """Cached parquet footer (pq.FileMetaData) for `path`, keyed on its
     current (path, mtime, size) signature — an overwritten file misses
-    and re-reads."""
+    and re-reads. Fragment paths share the base file's cache entry."""
     from bodo_tpu.runtime import io_pool
+    path, _rg = split_rg_fragment(path)
     if sig is None:
         sig = file_signature(path)
     with _footer_lock:
@@ -172,28 +194,89 @@ def dataset_signature(path):
     return tuple(file_signature(f) for f in _dataset_files(path))
 
 
+def _grown_file_delta(old_sig, new_sig):
+    """Detect an in-place GROWN file: same path, size strictly larger,
+    and the old footer's row groups a byte-identical prefix of the new
+    footer (row counts, byte sizes, and column-chunk offsets all equal).
+    Returns the ``path#rg=LO-HI`` fragment naming the new trailing row
+    groups, or None when growth cannot be proven — the caller then
+    treats the change as a mutate; never a stale partial.
+
+    Requires the OLD footer to still be cached under its old signature
+    (_footer_cache keeps footers per (path, mtime, size), so a prior
+    scan's footer survives the rewrite); without it there is nothing to
+    compare against and the answer is conservatively None."""
+    path = split_rg_fragment(old_sig[0])[0]
+    if new_sig[2] <= old_sig[2]:
+        return None  # shrunk or same size: not a pure tail-append
+    with _footer_lock:
+        old_md = _footer_cache.get(old_sig)
+    if old_md is None:
+        return None
+    try:
+        new_md = footer_metadata(path, sig=new_sig)
+    except Exception:
+        return None
+    o, n = old_md.num_row_groups, new_md.num_row_groups
+    if n <= o:
+        return None
+    for rg in range(o):
+        a, b = old_md.row_group(rg), new_md.row_group(rg)
+        if a.num_rows != b.num_rows or \
+                a.total_byte_size != b.total_byte_size or \
+                a.num_columns != b.num_columns:
+            return None
+        for ci in range(a.num_columns):
+            ca, cb = a.column(ci), b.column(ci)
+            if ca.path_in_schema != cb.path_in_schema or \
+                    ca.file_offset != cb.file_offset or \
+                    ca.total_compressed_size != cb.total_compressed_size:
+                return None
+    return f"{path}#rg={o}-{n}"
+
+
 def classify_change(old_sigs, new_sigs):
     """Classify the delta between two ``dataset_signature()`` results:
 
         ("same", ())       — byte-identical signatures
-        ("append", files)  — every old file's (path, mtime, size) is
-                             unchanged, only new files appeared; `files`
-                             are the added paths in the NEW scan order
-        ("mutate", ())     — anything else (rewrite, delete, touch)
+        ("append", files)  — old data is untouched and new rows only
+                             appeared AFTER it: added files and/or
+                             in-place grown files whose old row groups
+                             are a byte-identical prefix (those appear
+                             as ``path#rg=LO-HI`` fragments naming the
+                             new trailing row groups); `files` are in
+                             the NEW scan order
+        ("mutate", paths)  — anything else (rewrite, delete, touch);
+                             `paths` are the files that changed in
+                             place (empty when files were deleted),
+                             feeding partition-level invalidation in
+                             the result cache
 
     Drives the result cache's incremental append maintenance
     (runtime/result_cache.py): "append" means the cached result is still
     a correct partial and only the delta files need scanning."""
     old_by = {s[0]: s for s in old_sigs}
+    changed = []
+    grown = {}  # path -> "#rg=" delta fragment
     for s in new_sigs:
         prev = old_by.get(s[0])
         if prev is not None and prev != s:
-            return ("mutate", ())
+            frag = _grown_file_delta(prev, s)
+            if frag is None:
+                changed.append(s[0])
+            else:
+                grown[s[0]] = frag
     new_paths = {s[0] for s in new_sigs}
-    if any(p not in new_paths for p in old_by):
-        return ("mutate", ())
-    added = tuple(s[0] for s in new_sigs if s[0] not in old_by)
-    return ("append", added) if added else ("same", ())
+    deleted = any(p not in new_paths for p in old_by)
+    if changed or deleted:
+        return ("mutate", tuple(changed) if not deleted else ())
+    delta = []
+    for s in new_sigs:
+        if s[0] not in old_by:
+            delta.append(s[0])
+        elif s[0] in grown:
+            delta.append(grown[s[0]])
+    return ("append", tuple(delta)) if delta else ("same", ())
 
 
 def dataset_nbytes(path) -> int:
@@ -219,10 +302,12 @@ def _attach_footer_ranges(t, files, row_groups=None) -> None:
     ranges: dict = {}
     try:
         for f in files:
+            f, rg_win = split_rg_fragment(f)
             if row_groups is not None and f not in row_groups:
                 continue
             md = footer_metadata(f)
             rgs = (row_groups[f] if row_groups is not None
+                   else range(*rg_win) if rg_win is not None
                    else range(md.num_row_groups))
             for rg in rgs:
                 g = md.row_group(rg)
@@ -289,12 +374,18 @@ def _device_decode_enabled() -> bool:
 
 def _scan_units(files):
     """(file, row_group, total_byte_size) scan units, footers from the
-    cache (each file's footer parsed at most once per mtime)."""
+    cache (each file's footer parsed at most once per mtime). A
+    ``#rg=LO-HI`` fragment restricts its file to that row-group window;
+    units always carry the BASE path so every downstream consumer
+    (decode, device route, stats attach) opens real files."""
     units = []
     for f in files:
-        md = footer_metadata(f)
-        units.extend((f, rg, md.row_group(rg).total_byte_size)
-                     for rg in range(md.num_row_groups))
+        base, rg_win = split_rg_fragment(f)
+        md = footer_metadata(base)
+        rgs = range(md.num_row_groups) if rg_win is None else \
+            range(max(rg_win[0], 0), min(rg_win[1], md.num_row_groups))
+        units.extend((base, rg, md.row_group(rg).total_byte_size)
+                     for rg in rgs)
     return units
 
 
@@ -382,7 +473,7 @@ def _read_parquet_once(path, columns, process_index, process_count) -> Table:
     elif units:  # fewer units than processes: empty slice, schema kept
         at = _decode_row_group(units[0], columns).slice(0, 0)
     else:
-        with _opened(files[0]) as src:
+        with _opened(split_rg_fragment(files[0])[0]) as src:
             at = pq.read_table(src, columns=list(columns) if columns
                                else None).slice(0, 0)
     if t is None:

@@ -44,11 +44,12 @@ class ReadParquet(Node):
     def __init__(self, path, columns: Optional[Sequence[str]] = None):
         import pyarrow.parquet as pq
 
-        from bodo_tpu.io.parquet import _dataset_files, _opened
+        from bodo_tpu.io.parquet import (_dataset_files, _opened,
+                                         split_rg_fragment)
         self.path = tuple(path) if isinstance(path, (list, tuple)) \
             else path
         self.children = []
-        f = _dataset_files(self.path)[0]
+        f = split_rg_fragment(_dataset_files(self.path)[0])[0]
         with _opened(f) as src:
             arrow_schema = pq.read_schema(src)
         names = list(columns) if columns else arrow_schema.names
@@ -59,6 +60,27 @@ class ReadParquet(Node):
 
     def key(self):
         return ("read_parquet", self.path, tuple(self.columns))
+
+
+class ViewScan(Node):
+    """Scan of a named materialized view (runtime/views.py). A leaf: the
+    view's current materialization is served from the result cache at
+    execution time, so downstream plans compose over views exactly like
+    over base tables. key() carries only the NAME: a consumer plan keeps
+    a stable fingerprint across view refreshes, and the result cache
+    signs it with the view's BASE source signatures — so a refresh
+    supersedes (and drops) the consumer's old entry instead of orphaning
+    it. `version` is the view's maintenance generation at construction
+    (introspection only)."""
+
+    def __init__(self, name: str, schema: Schema, version: int = 0):
+        self.name = name
+        self.children = []
+        self.schema = dict(schema)
+        self.version = int(version)
+
+    def key(self):
+        return ("view_scan", self.name)
 
 
 class ReadCsv(Node):

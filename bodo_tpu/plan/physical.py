@@ -382,6 +382,9 @@ def _exec_inner(node: L.Node) -> Table:
             parse_dates=list(node.parse_dates) or None))
     if isinstance(node, L.FromPandas):
         return _maybe_shard(node.table)
+    if isinstance(node, L.ViewScan):
+        from bodo_tpu.runtime import views as _views
+        return _maybe_shard(_views.materialized_table(node.name))
     if isinstance(node, L.Projection):
         return apply_projection(_exec(node.child), node.exprs)
     if isinstance(node, L.Filter):

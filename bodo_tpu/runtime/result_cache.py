@@ -99,6 +99,7 @@ _HIDDEN_CNT = "__rc_c__"
 _INCR_AGG_OPS = {"sum", "count", "min", "max", "mean"}
 _MERGE_OP = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
 _MAX_ENTRIES = 512         # entry-count backstop on top of the byte budget
+_PIN_TIER = 1e9            # score floor per live view dependent (_score)
 _AUTO_FRACTION = 0.125     # auto byte budget: slice of the derived budget
 _AUTO_FLOOR = 64 << 20
 _AUTO_DEFAULT = 256 << 20  # when no governor budget can be derived
@@ -144,6 +145,14 @@ def _sources_of(node):
             s = (("csv", node.path),)
         elif isinstance(node, L.FromPandas):
             s = (("mem", node._id),)
+        elif isinstance(node, L.ViewScan):
+            # a view scan signs as its view's BASE sources (resolved
+            # transitively through the view DAG): a consumer's key then
+            # rolls over exactly when the underlying data changes, even
+            # though the consumer reads the cached materialization
+            import sys
+            vw = sys.modules.get("bodo_tpu.runtime.views")
+            s = vw.base_sources(node.name) if vw is not None else None
         else:
             s = None
     else:
@@ -413,7 +422,19 @@ def _classify_append(old_sigs, new_sigs):
         changed = True
         delta.extend(files)
         if tuple(nsig[:len(osig)]) != tuple(osig):
-            tail_only = False
+            # an in-place grown file keeps its old rows where they were;
+            # the growth is tail-ordered only when the grown file is the
+            # LAST old file in scan order (its new row groups then follow
+            # every cached row, so a concat splice stays row-ordered)
+            grown = {str(f).rpartition("#rg=")[0] for f in files
+                     if "#rg=" in str(f)}
+            prefix_ok = all(a == b for a, b in zip(osig[:-1], nsig)) \
+                if osig else False
+            last_o = osig[-1] if osig else None
+            last_n = nsig[len(osig) - 1] if osig else None
+            if not (prefix_ok and last_o[0] == last_n[0]
+                    and last_o[0] in grown):
+                tail_only = False
     if not changed or not delta:
         return None
     return tuple(delta), tail_only
@@ -447,7 +468,8 @@ def _current_session() -> str:
 class _Entry:
     __slots__ = ("key", "raw", "kind", "table", "host", "dist", "nbytes",
                  "host_nbytes", "saved_wall_s", "hits", "last_use",
-                 "sources", "visible", "incr", "session")
+                 "sources", "visible", "incr", "session", "parts",
+                 "parts_nbytes")
 
     def __init__(self, key, raw, kind):
         self.key, self.raw, self.kind = key, raw, kind
@@ -463,6 +485,12 @@ class _Entry:
         self.visible = None
         self.incr = None
         self.session = "-"
+        # partition-level invalidation: per-source-file host partials of
+        # the exec-root output ({file path -> pandas}), so a mutate of
+        # ONE file re-runs one delta plan and re-merges instead of
+        # nuking the whole entry (see _try_partition_refresh)
+        self.parts = None
+        self.parts_nbytes = 0
 
 
 class ResultCache:
@@ -484,6 +512,10 @@ class ResultCache:
         self._budget_at = 0.0
         self._c: Dict[str, int] = {}
         self._sess: Dict[str, Dict[str, int]] = {}  # session -> counters
+        # plan fingerprint -> live dependent count (downstream views +
+        # subscribers); weights eviction benefit so a view DAG root is
+        # not evicted under its own fan-out (runtime/views.py maintains)
+        self._view_pins: Dict[str, int] = {}
         self._owner_pid = _os.getpid()
         self._owner_gang = _gang_id()
 
@@ -536,9 +568,34 @@ class ResultCache:
 
     def _score(self, e: _Entry) -> float:
         """Benefit = saved wall × hit recency: evicting min keeps the
-        entries that keep earning their memory."""
+        entries that keep earning their memory. A view materialization
+        serving N live dependents (downstream views + subscribers) is
+        guaranteed future reuse on a schedule LRU cannot see (the next
+        maintenance pass, not the next user query), so pinned entries
+        rank a whole tier above every unpinned candidate — saved wall
+        can be milliseconds on a warm gang and no multiplier of it
+        reliably beats a freshly-recorded scan. Within the pinned
+        tier, more dependents and saved wall still order victims; the
+        eviction loop can still reclaim pinned entries once they are
+        the only candidates left, so the budget always wins."""
+        if e.kind == "q" and self._view_pins:
+            deps = self._view_pins.get(e.key[1], 0)
+            if deps:
+                return _PIN_TIER * deps + e.saved_wall_s * (1.0 + e.hits)
         age = max(self._now() - e.last_use, 0.0)
         return (e.saved_wall_s * (1.0 + e.hits)) / (age + 1.0)
+
+    def set_view_pin(self, fp: str, deps: int) -> None:
+        """Declare fp's live dependent count (0 clears the pin)."""
+        with self._mu:
+            if deps > 0:
+                self._view_pins[fp] = int(deps)
+            else:
+                self._view_pins.pop(fp, None)
+
+    def clear_view_pins(self) -> None:
+        with self._mu:
+            self._view_pins.clear()
 
     def _sync_grant_locked(self) -> None:
         """Keep one persistent governor grant sized to the device
@@ -595,6 +652,9 @@ class ResultCache:
         if e.host is not None:
             self.host_bytes -= e.host_nbytes
             e.host, e.host_nbytes = None, 0
+        if e.parts is not None:
+            self.host_bytes -= e.parts_nbytes
+            e.parts, e.parts_nbytes = None, 0
         self._entries.pop(e.key, None)
         ks = self._by_raw.get(e.raw)
         if ks is not None:
@@ -770,6 +830,144 @@ class ResultCache:
             self.saved_wall_s += e.saved_wall_s
             return t
 
+    def attach_parts(self, key, parts) -> bool:
+        """Attach (or replace) an entry's per-source-file contribution
+        map; partials are host pandas, charged to the host tier."""
+        try:
+            nb = sum(int(df.memory_usage(deep=True).sum())
+                     for df in parts.values())
+        except Exception:  # noqa: BLE001
+            return False
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                return False
+            if e.parts is not None:
+                self.host_bytes -= e.parts_nbytes
+            e.parts = dict(parts)
+            e.parts_nbytes = nb
+            self.host_bytes += nb
+            return True
+
+    def build_parts(self, key, run, max_parts: Optional[int] = None) \
+            -> bool:
+        """Build the contribution map for an incrementalizable cached
+        entry: one delta plan per source file, partials in NEW-scan-order
+        merge form. Skipped (False) past ``max_parts`` files — the map
+        costs one pass over the dataset, paid once per materialization."""
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None or e.incr is None or not e.sources:
+                return False
+            if len(e.sources) != 1 or e.sources[0][0] != "pq":
+                return False
+            files = [s[0] for s in e.sources[0][2]]
+            incr = e.incr
+        if not files or (max_parts is not None
+                         and len(files) > max_parts):
+            return False
+        parts = {}
+        try:
+            for f in files:
+                droot = _rebuild(incr["template"], scan_files=(f,))
+                parts[f] = run(droot).to_pandas()
+        except Exception:  # noqa: BLE001 - the map is an optimization
+            return False
+        self.count("parts_built", len(files))
+        return self.attach_parts(key, parts)
+
+    def _merge_parts(self, parts, order, incr):
+        """Merge per-file partials (NEW scan order) through the same
+        kernels a splice uses — same dtypes, same distribution policy."""
+        import pandas as pd
+
+        from bodo_tpu import relational as R
+        from bodo_tpu.table.table import Table
+        df = pd.concat([parts[f] for f in order], ignore_index=True)
+        t = Table.from_pandas(df)
+        shape = incr["shape"]
+        if shape == "concat":
+            from bodo_tpu.plan import physical
+            return physical._maybe_shard(t)
+        merge = [(out, _MERGE_OP[op], out)
+                 for _c, op, out in incr["aggs"] if op != "mean"]
+        if shape == "agg":
+            merged = R.groupby_agg(t, incr["keys"], merge)
+            if incr["means"]:
+                merged = _refinalize_means(merged, incr, t)
+            return merged.select(incr["order"])
+        scalars = R.reduce_table(t, merge)
+        for out, s_name, c_name in incr["means"]:
+            cnt = int(scalars[c_name])
+            scalars[out] = float(scalars[s_name]) / cnt if cnt \
+                else float("nan")
+        df2 = pd.DataFrame({k: [scalars[k]] for k in incr["order"]})
+        return Table.from_pandas(df2)
+
+    def _try_partition_refresh(self, root, prev, qi, run):
+        """Partition-level invalidation: when the superseded entry
+        carries a contribution map and the change mutated/added SOME
+        files in place (no deletions), re-run delta plans for only those
+        files and re-merge — unaffected partitions re-serve their cached
+        partials without recompute. Any ambiguity (deleted file, partial
+        missing from the map, merge failure) returns None and the caller
+        falls back to full invalidation — never a stale partial."""
+        if prev.incr is None or not prev.sources or prev.parts is None:
+            return None
+        if len(prev.sources) != 1 or len(qi.sigs) != 1:
+            return None
+        (ok_, oid, osig), (nk, nid, nsig) = prev.sources[0], qi.sigs[0]
+        if ok_ != "pq" or nk != "pq" or oid != nid:
+            return None
+        old_by = {s[0]: s for s in osig}
+        new_by = {s[0]: s for s in nsig}
+        if any(p not in new_by for p in old_by):
+            return None  # deletion: no partial split can be trusted
+        changed = [s[0] for s in nsig
+                   if s[0] in old_by and old_by[s[0]] != s]
+        added = [s[0] for s in nsig if s[0] not in old_by]
+        if not changed and not added:
+            return None
+        if any(p not in prev.parts for p in changed):
+            return None
+        t0 = time.perf_counter()
+        try:
+            parts = dict(prev.parts)
+            for f in changed + added:
+                droot = _rebuild(prev.incr["template"], scan_files=(f,))
+                droot._explain_path = getattr(root, "_explain_path",
+                                              None)
+                parts[f] = run(droot).to_pandas()
+            order = [s[0] for s in nsig]
+            merged = self._merge_parts(parts, order, prev.incr)
+        except Exception as e:  # noqa: BLE001 - never a stale partial
+            self.count("incremental_fallbacks")
+            log(1, f"result cache: partition refresh failed "
+                   f"({type(e).__name__}: {e}); falling back to full "
+                   f"invalidation")
+            return None
+        wall = time.perf_counter() - t0
+        self.count("partition_refresh")
+        self.count("parts_reused",
+                   len(order) - len(changed) - len(added))
+        self.record(qi.key, qi.raw, merged, prev.saved_wall_s, kind="q",
+                    sources=qi.sigs, visible=prev.visible,
+                    incr=prev.incr)
+        self.attach_parts(qi.key, parts)
+        with self._mu:
+            if self._entries.get(prev.key) is prev:
+                self._drop_locked(prev)
+            self._sync_grant_locked()
+        log(1, f"result cache: partition refresh over "
+               f"{len(changed) + len(added)} of {len(order)} file(s) "
+               f"in {wall:.3f}s")
+        _explain_rcache(root, merged,
+                        {"event": "partition_refresh",
+                         "changed_files": len(changed) + len(added),
+                         "wall_s": round(wall, 6)})
+        vis = prev.visible
+        return merged.select(vis) if vis else merged
+
     def _materialize(self, e: _Entry):
         """Device table for an entry the caller already holds (no hit
         accounting) — None when it vanished or cannot rehydrate."""
@@ -835,6 +1033,9 @@ class ResultCache:
                 prev = self._entries.get(pk) if pk is not None else None
             if prev is not None and prev.key != qi.key:
                 out = self._try_incremental(root, prev, qi, run)
+                if out is None:
+                    out = self._try_partition_refresh(root, prev, qi,
+                                                      run)
                 if out is not None:
                     return out
                 # same plan over changed data and no clean splice: the
@@ -1016,6 +1217,16 @@ class ResultCache:
                 self._c["invalidations_remote"] = \
                     self._c.get("invalidations_remote", 0) + dropped
             self._sync_grant_locked()
+        # fleet-wide VIEW invalidation rides the same broadcast: any
+        # registered view whose base sources intersect the mutated
+        # paths goes stale on this gang too (best-effort, lazy-module)
+        import sys
+        vw = sys.modules.get("bodo_tpu.runtime.views")
+        if vw is not None:
+            try:
+                vw.note_invalidated_paths(pset)
+            except Exception:  # noqa: BLE001
+                pass
         return dropped
 
     def _notify_invalidated(self, prev) -> None:
@@ -1109,7 +1320,8 @@ class ResultCache:
                       "incremental_fallbacks", "spills", "rehydrations",
                       "rejected", "sig_uncacheable", "pressure_sheds",
                       "peer_hits", "peer_misses", "peer_serves",
-                      "invalidations_remote"):
+                      "invalidations_remote", "partition_refresh",
+                      "parts_built", "parts_reused"):
                 d.setdefault(k, 0)
             dev = sum(1 for e in self._entries.values()
                       if e.table is not None)
@@ -1123,6 +1335,7 @@ class ResultCache:
                      saved_wall_s=round(self.saved_wall_s, 6),
                      q_hit_rate=(qh / (qh + qm)) if (qh + qm) else 0.0,
                      enabled=bool(config.result_cache),
+                     view_pins=len(self._view_pins),
                      owner_pid=self._owner_pid,
                      owner_gang=self._owner_gang)
             by_dev = self._sess_dev_locked()

@@ -263,6 +263,30 @@ def _mod(name: str):
     return sys.modules.get(name)
 
 
+def _maintenance_due() -> bool:
+    """True when the view signature watcher wants a poll slot. Called
+    by idle workers while HOLDING the scheduler lock, so it must stay
+    lock-free (plain attribute reads in runtime/views.py) and cheap."""
+    vw = _mod("bodo_tpu.runtime.views")
+    if vw is None:
+        return False
+    try:
+        return vw.maintenance_due()
+    except Exception:  # noqa: BLE001 - a broken watcher must not wedge
+        return False
+
+
+def _run_maintenance_tick(sched) -> None:
+    """One watcher poll: detect changed base tables and schedule view
+    refreshes as weighted-fair work on the system maintenance session."""
+    vw = _mod("bodo_tpu.runtime.views")
+    if vw is not None:
+        try:
+            vw.maintenance_tick(sched)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def local_signals() -> AdmissionSignals:
     """In-process signals: the same document /healthz serves, plus a
     direct governor read (occupancy without a /metrics scrape). Every
@@ -440,6 +464,19 @@ class Session:
     def run(self, fn: Callable, timeout: Optional[float] = None):
         """Submit and block for the result."""
         return self.submit(fn).result(timeout=timeout)
+
+    def subscribe(self, view: str,
+                  max_staleness_s: Optional[float] = None):
+        """Register a standing query against a materialized view
+        (runtime/views.py): returns a Subscription whose ``next()``
+        delivers every refreshed result through an ordinary serve
+        future. The refresh work itself runs on the system maintenance
+        session, not billed to this tenant; ``max_staleness_s`` bounds
+        how far behind a base-table change the delivered result may be
+        (it tightens the scheduler's signature poll interval)."""
+        from bodo_tpu.runtime import views as _views
+        return _views.subscribe(view, session=self,
+                                max_staleness_s=max_staleness_s)
 
     def close(self) -> None:
         self._sched.close_session(self)
@@ -676,16 +713,30 @@ class Scheduler:
 
     def _worker(self, stop: threading.Event) -> None:
         while True:
+            tick = False
             with self._cv:
                 req = None
                 while not stop.is_set():
                     req = self._pick_locked()
                     if req is not None:
                         break
+                    # between queue drains: the view signature watcher
+                    # gets a poll slot. maintenance_due() is lock-free
+                    # attribute reads — it must never block under _cv.
+                    if _maintenance_due():
+                        tick = True
+                        break
                     self._cv.wait(0.1)
-                if req is None:
+                if req is None and not tick:
                     return
-                self._running += 1
+                if req is not None:
+                    self._running += 1
+            if req is None:
+                # the tick runs OUTSIDE the lock: view maintenance
+                # submits refresh work back into this scheduler, which
+                # re-acquires _cv
+                _run_maintenance_tick(self)
+                continue
             try:
                 self._execute(req)
             finally:

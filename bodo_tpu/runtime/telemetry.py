@@ -186,6 +186,26 @@ def sample() -> dict:
                 }
         except Exception:
             pass
+    vw = _mod("bodo_tpu.runtime.views")
+    if vw is not None:
+        try:
+            vs = vw.stats()
+            if vs.get("n_views"):
+                s["views"] = {
+                    "n_views": int(vs.get("n_views", 0)),
+                    "dag_depth": int(vs.get("dag_depth", 0)),
+                    "subscriptions": int(vs.get("subscriptions", 0)),
+                    "refreshes_incremental":
+                        int(vs.get("refreshes_incremental", 0)),
+                    "refreshes_full": int(vs.get("refreshes_full", 0)),
+                    "refresh_ratio":
+                        round(float(vs.get("refresh_ratio", 0.0)), 4),
+                    "staleness_p99_s":
+                        round(float(vs.get("staleness_p99_s", 0.0)), 4),
+                    "lagging_view": vs.get("lagging_view"),
+                }
+        except Exception:
+            pass
     fz = _mod("bodo_tpu.plan.fusion")
     if fz is not None:
         try:
@@ -541,6 +561,27 @@ def health() -> dict:
                     "running": int(ss.get("running", 0)),
                     "decisions": {k: int(v) for k, v in
                                   ss.get("decisions", {}).items()},
+                }
+        except Exception:
+            pass
+    vw = _mod("bodo_tpu.runtime.views")
+    if vw is not None:
+        try:
+            vs = vw.stats()
+            if vs.get("n_views"):
+                # like result_cache: a lagging view is maintenance
+                # load, not ill health — doctor triage names the view
+                doc["views"] = {
+                    "n_views": int(vs.get("n_views", 0)),
+                    "dag_depth": int(vs.get("dag_depth", 0)),
+                    "subscriptions": int(vs.get("subscriptions", 0)),
+                    "refresh_ratio":
+                        round(float(vs.get("refresh_ratio", 0.0)), 4),
+                    "staleness_p99_s":
+                        round(float(vs.get("staleness_p99_s", 0.0)), 4),
+                    "lagging_view": vs.get("lagging_view"),
+                    "refresh_rejected":
+                        int(vs.get("refresh_rejected", 0)),
                 }
         except Exception:
             pass

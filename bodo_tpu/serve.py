@@ -48,6 +48,10 @@ from bodo_tpu.runtime.scheduler import (  # noqa: F401 - public re-exports
     signals_from_health,
     signals_from_metrics,
 )
+from bodo_tpu.runtime.views import (  # noqa: F401 - continuous queries
+    MAINTENANCE_SESSION,
+    Subscription,
+)
 
 __all__ = [
     "start", "stop", "drain", "session", "submit", "stats",
@@ -55,6 +59,7 @@ __all__ = [
     "BackOff", "QueryFailed", "AdmissionSignals", "AdmissionController",
     "Decision", "current_session", "session_scope", "local_signals",
     "signals_from_health", "signals_from_metrics", "scheduler",
+    "Subscription", "MAINTENANCE_SESSION",
 ]
 
 

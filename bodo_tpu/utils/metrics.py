@@ -838,6 +838,39 @@ def sync_engine_metrics() -> None:
                 gsb.labels(session=sid).set(row.get("device_bytes", 0))
         except Exception:  # pragma: no cover
             pass
+    # -- materialized views (lazy-module rule: a registry only exists
+    # once views were created) -----------------------------------------------
+    vw = sys.modules.get("bodo_tpu.runtime.views")
+    if vw is not None:
+        try:
+            vs_ = vw.stats()
+            if vs_.get("n_views"):
+                g = _gang_gauge("bodo_tpu_view_events_total",
+                                "materialized-view maintenance events",
+                                ("event",))
+                for k in ("refreshes_incremental", "refreshes_full",
+                          "ticks", "detected_stale", "flagged_stale",
+                          "refresh_scheduled", "refresh_rejected"):
+                    g.labels(event=k).set(vs_.get(k, 0))
+                _gang_gauge("bodo_tpu_view_count",
+                            "registered materialized views").set(
+                    vs_.get("n_views", 0))
+                _gang_gauge("bodo_tpu_view_subscriptions",
+                            "live continuous-query subscriptions").set(
+                    vs_.get("subscriptions", 0))
+                _gang_gauge("bodo_tpu_view_fanout_depth",
+                            "depth of the materialized-view DAG").set(
+                    vs_.get("dag_depth", 0))
+                _gang_gauge("bodo_tpu_view_refresh_ratio",
+                            "incremental refresh wall relative to "
+                            "full-recompute wall").set(
+                    vs_.get("refresh_ratio", 0.0))
+                _gang_gauge("bodo_tpu_view_staleness_p99_seconds",
+                            "p99 change-to-refresh staleness across "
+                            "views").set(vs_.get("staleness_p99_s",
+                                                 0.0))
+        except Exception:  # pragma: no cover
+            pass
     # -- sql plan cache (sql/plan_cache.py is stdlib-safe) -------------------
     try:
         from bodo_tpu.sql import plan_cache

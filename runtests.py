@@ -66,12 +66,16 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # test_elastic.py spawns real elastic gangs with armed kill/raise
 # faults and asserts on the process-wide elastic serving state,
 # lockstep mesh epochs and resilience counters, so it runs alone too.
+# test_views.py owns the process-wide materialized-view registry,
+# mutates datasets on disk, starts/stops the serving scheduler for the
+# continuous-query paths and asserts on process-wide cache counters
+# (partition_refresh / parts_reused / view_pins), so it runs alone.
 _ISOLATED = ("test_tpch.py", "test_adaptive.py", "test_io_pipeline.py",
              "test_query_profiler.py", "test_fusion.py",
              "test_telemetry.py", "test_device_decode.py",
              "test_comm_observatory.py", "test_fused_join.py",
              "test_result_cache.py", "test_scheduler.py",
-             "test_fleet.py", "test_elastic.py")
+             "test_fleet.py", "test_elastic.py", "test_views.py")
 _N_GROUPS = 4
 
 # Per-group watchdog. pytest's builtin faulthandler plugin installs

@@ -1,0 +1,521 @@
+"""Materialized views & continuous queries (runtime/views.py).
+
+Covers the 2-level view DAG maintained across an append (bit-identical
+to a cleared-cache full recompute per distribution mode), partition-
+level invalidation (a mutate of one source file re-merges only that
+file's contribution — the counters prove the other partials were
+reused), the in-place grown-file append classification (regression
+with a pandas oracle, footer-prefix proof), benefit eviction weighted
+by live view dependents, subscription delivery through the serving
+stack with maintenance attributed to the system session, the registry's
+DAG discipline, and the observability surfaces (stats / telemetry /
+doctor).
+
+Runs ISOLATED (runtests.py): mutates datasets on disk, registers views
+in the process-wide registry, starts/stops the serving scheduler, and
+asserts on process-wide cache counters.
+"""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import bodo_tpu
+import bodo_tpu.pandas_api as bpd
+from bodo_tpu.config import config, set_config
+from bodo_tpu.plan import physical
+from bodo_tpu.runtime import result_cache as rcache
+from bodo_tpu.runtime import views as rviews
+from tests.utils import MODES, _mode
+
+
+@pytest.fixture(autouse=True)
+def _fresh(mesh8):
+    rviews.reset()
+    physical._result_cache.clear()
+    rcache.reset_stats()
+    yield
+    rviews.reset()
+    physical._result_cache.clear()
+    set_config(result_cache=True, result_cache_bytes=0,
+               result_cache_host_spill=True)
+
+
+class _Dataset:
+    """Multi-file parquet dataset with append / mutate / grow helpers.
+    Part filenames sort after the existing ones, so a new file is
+    always a tail append in scan order."""
+
+    def __init__(self, d: str, n_parts: int = 4, rows: int = 500):
+        self.dir = d
+        self.rows = rows
+        self._i = 0
+        self._rng = np.random.default_rng(3)
+        os.makedirs(d, exist_ok=True)
+        for _ in range(n_parts):
+            self.append(rows)
+
+    def _frame(self, n: int) -> pd.DataFrame:
+        return pd.DataFrame({
+            "k": self._rng.integers(0, 8, n).astype(np.int64),
+            "v": self._rng.integers(-50, 1000, n).astype(np.int64),
+        })
+
+    def append(self, n: int = 100) -> None:
+        self._frame(n).to_parquet(
+            os.path.join(self.dir, f"part-{self._i:05d}.parquet"))
+        self._i += 1
+
+    def mutate(self) -> str:
+        # different row count -> different size: never aliases the old
+        # signature even on coarse-mtime filesystems
+        path = sorted(glob.glob(os.path.join(self.dir, "*.parquet")))[0]
+        self._frame(self.rows + 37).to_parquet(path)
+        return path
+
+    def grow_in_place(self, n: int = 123) -> str:
+        """Rewrite the FIRST part so its old row groups are a
+        byte-identical prefix and ``n`` new rows ride a new trailing
+        row group — the in-place grown-file append."""
+        path = sorted(glob.glob(os.path.join(self.dir, "*.parquet")))[0]
+        old = pa.Table.from_pandas(pd.read_parquet(path),
+                                   preserve_index=False)
+        extra = pa.Table.from_pandas(self._frame(n),
+                                     preserve_index=False)
+        with pq.ParquetWriter(path, old.schema) as w:
+            w.write_table(old)       # row group 0: the old bytes
+            w.write_table(extra)     # row group 1: the appended rows
+        return path
+
+    def pandas(self) -> pd.DataFrame:
+        paths = sorted(glob.glob(os.path.join(self.dir, "*.parquet")))
+        return pd.concat([pd.read_parquet(p) for p in paths],
+                         ignore_index=True)
+
+
+@pytest.fixture
+def ds(tmp_path):
+    return _Dataset(str(tmp_path / "ds"))
+
+
+def _norm(df: pd.DataFrame, key: str = "k") -> pd.DataFrame:
+    return df.sort_values(key).reset_index(drop=True)
+
+
+def _make_dag(d: str):
+    """base scan -> "daily" aggregate -> "weekly" rollup (depth 2)."""
+    df = bpd.read_parquet(d)
+    bodo_tpu.views.create_view("daily", df.groupby(
+        "k", as_index=False).agg(s=("v", "sum"), c=("v", "count")))
+    daily = bodo_tpu.views.read("daily")
+    bodo_tpu.views.create_view("weekly", daily.assign(
+        wk=daily["k"] // 4).groupby("wk", as_index=False).agg(
+        ws=("s", "sum"), wc=("c", "sum")))
+
+
+def _weekly_oracle(full: pd.DataFrame) -> pd.DataFrame:
+    daily = full.groupby("k", as_index=False).agg(
+        s=("v", "sum"), c=("v", "count"))
+    daily["wk"] = daily["k"] // 4
+    return daily.groupby("wk", as_index=False).agg(
+        ws=("s", "sum"), wc=("c", "sum"))
+
+
+# ---------------------------------------------------------------------------
+# the 2-level DAG maintained across an append
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_view_dag_append_bit_identical(ds, mode):
+    """Acceptance: base scan -> daily aggregate -> weekly rollup,
+    maintained across an append, must be BIT-identical to the cleared-
+    cache full recompute in every distribution mode — and the daily
+    leaf must have refreshed by splicing, not recomputing."""
+    with _mode(mode):
+        _make_dag(ds.dir)
+        first = bodo_tpu.views.read("weekly").to_pandas()
+        ds.append(137)
+        before = rcache.stats()["q_incremental"]
+        maintained = bodo_tpu.views.read("weekly").to_pandas()
+        assert rcache.stats()["q_incremental"] == before + 1
+        physical._result_cache.clear()
+        full = bodo_tpu.views.read("weekly").to_pandas()
+    pd.testing.assert_frame_equal(_norm(maintained, "wk"),
+                                  _norm(full, "wk"), check_exact=True)
+    oracle = _weekly_oracle(ds.pandas())
+    pd.testing.assert_frame_equal(_norm(maintained, "wk"),
+                                  _norm(oracle, "wk"),
+                                  check_exact=True, check_dtype=False)
+    assert not _norm(first, "wk").equals(_norm(maintained, "wk"))
+    vs = bodo_tpu.views.stats()
+    assert vs["dag_depth"] == 2
+    assert vs["by_view"]["daily"]["refreshes_incremental"] >= 1
+
+
+def test_view_composition_serves_from_cache(ds):
+    """A downstream read over unchanged data re-serves both levels from
+    the semantic cache — no recomputation, versions stable."""
+    _make_dag(ds.dir)
+    bodo_tpu.views.read("weekly").to_pandas()
+    v0 = bodo_tpu.views.stats()["by_view"]
+    before = rcache.stats()
+    again = bodo_tpu.views.read("weekly").to_pandas()
+    st = rcache.stats()
+    assert st["q_misses"] == before["q_misses"]
+    assert st["q_hits"] > before["q_hits"]
+    v1 = bodo_tpu.views.stats()["by_view"]
+    assert v1["daily"]["version"] == v0["daily"]["version"]
+    assert v1["weekly"]["version"] == v0["weekly"]["version"]
+    oracle = _weekly_oracle(ds.pandas())
+    pd.testing.assert_frame_equal(_norm(again, "wk"),
+                                  _norm(oracle, "wk"),
+                                  check_exact=True, check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# partition-level invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_partition_mutate_reuses_unaffected_partials(ds):
+    """Acceptance: a mutate of ONE source file flips only that
+    partition's slice — the counters prove the other files' partials
+    were merged without re-scanning, and the merged result is exact."""
+    _make_dag(ds.dir)
+    bodo_tpu.views.read("weekly").to_pandas()
+    ds.mutate()
+    before = rcache.stats()
+    out = bodo_tpu.views.read("weekly").to_pandas()
+    st = rcache.stats()
+    assert st["partition_refresh"] == before["partition_refresh"] + 1
+    # 4 part files, 1 mutated: the other 3 partials must be reused
+    assert st["parts_reused"] >= before["parts_reused"] + 3
+    oracle = _weekly_oracle(ds.pandas())
+    pd.testing.assert_frame_equal(_norm(out, "wk"),
+                                  _norm(oracle, "wk"),
+                                  check_exact=True, check_dtype=False)
+
+
+def test_partition_mutate_never_stale_on_delete(ds):
+    """Deleting a file is ambiguous for partition refresh — it must
+    fall back to full invalidation, never serve a partial."""
+    _make_dag(ds.dir)
+    bodo_tpu.views.read("weekly").to_pandas()
+    paths = sorted(glob.glob(os.path.join(ds.dir, "*.parquet")))
+    os.remove(paths[1])
+    out = bodo_tpu.views.read("weekly").to_pandas()
+    oracle = _weekly_oracle(ds.pandas())
+    pd.testing.assert_frame_equal(_norm(out, "wk"),
+                                  _norm(oracle, "wk"),
+                                  check_exact=True, check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# in-place grown file => append (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_change_grown_file_is_append(ds):
+    """Regression: a file rewritten in place with its old row groups a
+    byte-identical prefix and new trailing row groups used to classify
+    as a mutate (full invalidation). It must classify as an append of
+    the ``#rg=`` tail fragment."""
+    from bodo_tpu.io import parquet as iop
+    old_sigs = iop.dataset_signature(ds.dir)
+    for f in sorted(glob.glob(os.path.join(ds.dir, "*.parquet"))):
+        iop.footer_metadata(f)    # a prior scan cached the old footers
+    grown = ds.grow_in_place(123)
+    new_sigs = iop.dataset_signature(ds.dir)
+    verdict, delta = iop.classify_change(old_sigs, new_sigs)
+    assert verdict == "append"
+    assert delta == (f"{grown}#rg=1-2",)
+
+
+def test_grown_file_splices_end_to_end(ds):
+    """The grown-file append must ride the same splice path as a new
+    part file: cached groupby + in-place grow -> one q_incremental,
+    result bit-identical to the pandas oracle."""
+    def q():
+        df = bpd.read_parquet(ds.dir)
+        return df.groupby("k", as_index=False).agg(
+            s=("v", "sum"), c=("v", "count")).to_pandas()
+
+    q()
+    ds.grow_in_place(211)
+    before = rcache.stats()
+    out = q()
+    st = rcache.stats()
+    assert st["q_incremental"] == before["q_incremental"] + 1
+    assert st["incremental_fallbacks"] == before["incremental_fallbacks"]
+    oracle = ds.pandas().groupby("k", as_index=False).agg(
+        s=("v", "sum"), c=("v", "count"))
+    pd.testing.assert_frame_equal(_norm(out), _norm(oracle),
+                                  check_exact=True, check_dtype=False)
+
+
+def test_grown_file_with_changed_prefix_is_mutate(ds):
+    """Growth without a byte-identical prefix (old rows rewritten too)
+    must stay a mutate — never a stale splice."""
+    from bodo_tpu.io import parquet as iop
+    old_sigs = iop.dataset_signature(ds.dir)
+    for f in sorted(glob.glob(os.path.join(ds.dir, "*.parquet"))):
+        iop.footer_metadata(f)
+    path = sorted(glob.glob(os.path.join(ds.dir, "*.parquet")))[0]
+    old = pd.read_parquet(path)
+    old["v"] = old["v"] + 1          # prefix rows changed
+    grownf = pd.concat([old, ds._frame(99)], ignore_index=True)
+    tbl = pa.Table.from_pandas(grownf, preserve_index=False)
+    with pq.ParquetWriter(path, tbl.schema) as w:
+        w.write_table(tbl)
+    verdict, _ = iop.classify_change(old_sigs,
+                                     iop.dataset_signature(ds.dir))
+    assert verdict == "mutate"
+
+
+# ---------------------------------------------------------------------------
+# benefit eviction weighted by live dependents (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _big_query(path, cutoff):
+    """~1 MiB result per distinct cutoff (distinct fingerprints)."""
+    df = bpd.read_parquet(path)
+    return df[df["v"] > cutoff].to_pandas()
+
+
+def test_eviction_prefers_view_dependents(tmp_path):
+    """A view materialization with live dependents must outlive colder
+    same-shape entries under pressure — WITHOUT accumulating hits; the
+    dependent-count pin alone carries it (pins eviction order)."""
+    big = _Dataset(str(tmp_path / "big"), n_parts=2, rows=40_000)
+    set_config(result_cache_bytes=4 << 20,
+               result_cache_host_spill=False)
+    cache = rcache.cache()
+    # pin THIS test's entry: stray serve sessions (an earlier module's
+    # scheduler workers) may record their own q entries concurrently
+    seen = {e.key for e in cache._entries.values()}
+    _big_query(big.dir, -100)                 # the pinned entry, 1 run
+    fp = next(e.key[1] for e in cache._entries.values()
+              if e.kind == "q" and e.key not in seen)
+    cache.set_view_pin(fp, 3)                 # 3 live dependents
+    for cutoff in (-99, -98, -97, -96):       # pressure: cold entries
+        _big_query(big.dir, cutoff)
+    assert rcache.stats()["evictions"] >= 1
+    assert any(e.key[1] == fp and e.kind == "q" and e.table is not None
+               for e in cache._entries.values()), \
+        "view-pinned entry was evicted by colder entries"
+    before = rcache.stats()
+    _big_query(big.dir, -100)
+    st = rcache.stats()
+    assert st["q_hits"] >= before["q_hits"] + 1, \
+        "view-pinned entry did not serve the repeat"
+    assert st["view_pins"] == 1
+
+
+def test_view_pin_released_on_drop(ds):
+    _make_dag(ds.dir)
+    bodo_tpu.views.read("weekly").to_pandas()
+    assert rcache.stats()["view_pins"] >= 1
+    bodo_tpu.views.drop_view("weekly")
+    bodo_tpu.views.drop_view("daily")
+    assert rcache.stats()["view_pins"] == 0
+
+
+# ---------------------------------------------------------------------------
+# continuous queries through the serving stack
+# ---------------------------------------------------------------------------
+
+
+def test_subscription_refresh_within_staleness_bound(ds):
+    """Acceptance: a subscriber observes the refresh through the serve
+    surface after a base append, the refresh runs on the system
+    maintenance session (tenants not billed), and per-view staleness
+    is tracked."""
+    from bodo_tpu import serve
+    old_poll = config.view_poll_s
+    old_adm = config.serve_admission
+    # admission reads AMBIENT governor occupancy — earlier modules in a
+    # shared tier-1 process can leave it shedding; not under test here
+    set_config(view_poll_s=0.1, serve_admission=False)
+    serve.start()
+    try:
+        _make_dag(ds.dir)
+        sess = serve.session("tenant-sub")
+        sess.run(lambda: bodo_tpu.views.read("weekly").to_pandas(),
+                 timeout=300)
+        tenant_served0 = sess.stats()["served_s"]
+        sub = sess.subscribe("weekly", max_staleness_s=2.0)
+        ds.append(137)
+        t0 = time.monotonic()
+        refreshed = sub.next(timeout=120)
+        waited = time.monotonic() - t0
+        assert waited < 60.0
+        oracle = _weekly_oracle(ds.pandas())
+        pd.testing.assert_frame_equal(
+            _norm(refreshed.to_pandas(), "wk"), _norm(oracle, "wk"),
+            check_exact=True, check_dtype=False)
+        st = serve.scheduler().stats()["by_session"]
+        maint = st.get(rviews.MAINTENANCE_SESSION)
+        assert maint is not None and maint["served_s"] > 0, \
+            "refresh was not attributed to the maintenance session"
+        assert maint["weight"] == pytest.approx(
+            float(config.view_maintenance_weight))
+        # the subscriber's own session was NOT billed for the refresh
+        assert sess.stats()["served_s"] == pytest.approx(
+            tenant_served0, abs=1e-6)
+        vs = bodo_tpu.views.stats()
+        assert vs["subscriptions"] == 1
+        assert vs["detected_stale"] >= 1
+        assert vs["staleness_p99_s"] > 0.0
+        sub.cancel()
+        assert bodo_tpu.views.stats()["subscriptions"] == 0
+    finally:
+        set_config(view_poll_s=old_poll, serve_admission=old_adm)
+        serve.stop()
+
+
+def test_subscription_next_timeout(ds):
+    from bodo_tpu import serve
+    old_adm = config.serve_admission
+    set_config(serve_admission=False)   # ambient occupancy: see above
+    serve.start()
+    try:
+        _make_dag(ds.dir)
+        sess = serve.session("tenant-t")
+        sess.run(lambda: bodo_tpu.views.read("daily").to_pandas(),
+                 timeout=300)
+        sub = sess.subscribe("daily")
+        with pytest.raises(TimeoutError):
+            sub.next(timeout=0.3)     # nothing changed: no refresh
+        sub.cancel()
+    finally:
+        set_config(serve_admission=old_adm)
+        serve.stop()
+
+
+# ---------------------------------------------------------------------------
+# registry discipline
+# ---------------------------------------------------------------------------
+
+
+def test_registry_dag_discipline(ds):
+    df = bpd.read_parquet(ds.dir)
+    agg = df.groupby("k", as_index=False).agg(s=("v", "sum"))
+    bodo_tpu.views.create_view("a", agg)
+    with pytest.raises(rviews.ViewError):
+        bodo_tpu.views.create_view("a", agg)       # duplicate
+    with pytest.raises(rviews.ViewError):
+        bodo_tpu.views.read("nope")                # unknown
+    av = bodo_tpu.views.read("a")
+    bodo_tpu.views.create_view(
+        "b", av.groupby("k", as_index=False).agg(m=("s", "max")))
+    with pytest.raises(rviews.ViewError):
+        bodo_tpu.views.drop_view("a")              # has dependents
+    bodo_tpu.views.drop_view("b")
+    bodo_tpu.views.drop_view("a")
+    assert bodo_tpu.views.list_views() == []
+
+
+def test_base_sources_resolve_transitively(ds):
+    _make_dag(ds.dir)
+    srcs = bodo_tpu.views.base_sources("weekly")
+    assert srcs == (("pq", ds.dir),)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_stats_telemetry_doctor_and_metrics(ds):
+    _make_dag(ds.dir)
+    bodo_tpu.views.read("weekly").to_pandas()
+    ds.append(101)
+    bodo_tpu.views.read("weekly").to_pandas()
+
+    vs = bodo_tpu.views.stats()
+    assert vs["n_views"] == 2 and vs["dag_depth"] == 2
+    assert vs["refreshes_incremental"] >= 1
+
+    from bodo_tpu.runtime import telemetry
+    samp = telemetry.sample()
+    assert samp["views"]["dag_depth"] == 2
+
+    from bodo_tpu.doctor import _triage_views
+    tri = _triage_views({"samples": [samp]})
+    assert tri["n_views"] == 2 and tri["dag_depth"] == 2
+
+    from bodo_tpu.utils import metrics
+    metrics.sync_engine_metrics()
+    text = metrics.expose_text()
+    assert "bodo_tpu_view_fanout_depth" in text
+    assert "bodo_tpu_view_refresh_ratio" in text
+    assert "bodo_tpu_view_staleness_p99_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# cross-gang view staleness (live 2-gang fleet)
+# ---------------------------------------------------------------------------
+
+
+def _view_thunk(d: str):
+    """Create-or-read the 2-level DAG inside the executing gang
+    process; every gang builds its own registry over the shared
+    dataset."""
+    def q(d=d):
+        import bodo_tpu
+        import bodo_tpu.pandas_api as bpd
+        if "xd" not in bodo_tpu.views.list_views():
+            df = bpd.read_parquet(d)
+            bodo_tpu.views.create_view("xd", df.groupby(
+                "k", as_index=False).agg(s=("v", "sum"),
+                                         c=("v", "count")))
+            daily = bodo_tpu.views.read("xd")
+            bodo_tpu.views.create_view("xw", daily.assign(
+                wk=daily["k"] // 4).groupby("wk", as_index=False).agg(
+                ws=("s", "sum"), wc=("c", "sum")))
+        return bodo_tpu.views.read("xw").to_pandas()
+    return q
+
+
+@pytest.mark.slow
+def test_cross_gang_view_staleness(tmp_path):
+    """Acceptance: mutate a base part file and EVERY gang in a 2-gang
+    fleet must serve post-invalidation view results (vs the pandas
+    oracle) — the invalidation broadcast flags remote views stale, and
+    each gang's own signature check backstops it."""
+    from bodo_tpu import fleet
+    d = str(tmp_path / "xds")
+    ds = _Dataset(d, n_parts=3, rows=400)
+    q = _view_thunk(d)
+    ctl = fleet.start(gangs=2, timeout=240.0)
+    try:
+        s = fleet.session("xviews")
+        # warm the view DAG on BOTH gangs via ring-routed keys
+        keys = {}
+        for gid in list(ctl._gangs):
+            keys[gid] = next(f"V{i}" for i in range(1000)
+                             if ctl._ring.owner(f"V{i}") == gid)
+        warm = {gid: s.run(q, key=k, timeout=180.0)
+                for gid, k in keys.items()}
+
+        ds.mutate()
+        results = {gid: s.run(q, key=k, timeout=180.0)
+                   for gid, k in keys.items()}
+        oracle = _weekly_oracle(ds.pandas())
+        for gid, got in results.items():
+            pd.testing.assert_frame_equal(
+                _norm(got, "wk"), _norm(oracle, "wk"),
+                check_exact=True, check_dtype=False)
+            assert not _norm(got, "wk").equals(
+                _norm(warm[gid], "wk")), gid
+        assert ctl.stats()["invalidations_broadcast"] >= 1
+    finally:
+        fleet.stop()

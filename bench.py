@@ -602,6 +602,16 @@ def bench_scan(args, n_rows: int):
               "io_stream": {k: (round(v, 4) if isinstance(v, float) else v)
                             for k, v in stream_stats.items()},
               "probe": getattr(args, "probe", {"attempted": False})}
+    if "dict" in enc_results:
+        # dictionary-encoded decode is the Pallas dict_gather kernel's
+        # hot path — tracked as its own benchwatch series (vs_baseline
+        # anchors the reference's 50 MB/s single-host dict-scan figure)
+        detail["suites"] = {"dict_scan": {
+            "metric": "dict_scan_mb_per_s",
+            "value": enc_results["dict"]["mb_per_s"],
+            "unit": "MB/s",
+            "vs_baseline": round(
+                enc_results["dict"]["mb_per_s"] / 50.0, 3)}}
     print(json.dumps({
         "metric": "scan_mb_per_s",
         "value": round(hot_mbps, 1),
@@ -1295,6 +1305,212 @@ def bench_fusion(args, n_rows: int):
     return 0
 
 
+def _stream_sync_probe(quick: bool) -> dict:
+    """Double-buffered streaming sync economics: push B sharded batches
+    through the 1D groupby accumulator and report host syncs per batch
+    from plan/streaming.py's stream_stats ledger. The dispatch-free
+    streaming redesign keeps the steady state at O(B/W) batched window
+    reads (plus log-many growth syncs), so the ratio must sit well
+    under 1.0 — the `stream_dispatch_per_batch` benchwatch series
+    regresses UP if a per-batch host sync ever creeps back into the
+    push loop. Result correctness is asserted against pandas so a
+    sync-free but wrong stream can never post a good number."""
+    import numpy as np
+    import pandas as pd
+
+    from bodo_tpu.plan import streaming as S
+    from bodo_tpu.plan.streaming_sharded import (
+        ShardedGroupbyAccumulator, table_batches_sharded)
+    from bodo_tpu.table.table import Table
+
+    n = 16_384 if quick else 65_536
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({"k": rng.integers(0, 512, n),
+                       "v": rng.normal(size=n)})
+    t = Table.from_pandas(df).shard()
+    S.reset_stream_stats()
+    acc = ShardedGroupbyAccumulator(["k"], [("v", "sum", "s"),
+                                            ("v", "count", "c")])
+    nb = 0
+    t0 = time.perf_counter()
+    for b in table_batches_sharded(t, 64):
+        acc.push(b)
+        nb += 1
+    out = acc.finish()
+    wall = time.perf_counter() - t0
+    syncs = int(S.stream_stats["host_syncs"])
+    got = out.to_pandas().sort_values("k").reset_index(drop=True)
+    exp = df.groupby("k", as_index=False).agg(s=("v", "sum"),
+                                              c=("v", "count")) \
+        .sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                  check_dtype=False, atol=1e-9)
+    return {"rows": n, "batches": nb, "host_syncs": syncs,
+            "resolve_window": ShardedGroupbyAccumulator.RESOLVE_WINDOW,
+            "overflow_replays": int(acc.n_retries),
+            "wall_s": round(wall, 4),
+            "dispatch_per_batch": round(syncs / nb, 4) if nb else 0.0,
+            "rows_per_s": round(n / wall, 1) if wall > 0 else 0.0}
+
+
+def _clear_pallas_gate_caches():
+    """Drop every compiled program that may have baked in a gate-off
+    Pallas routing decision, so a FORCE_INTERPRET flip actually
+    retraces. jax memoizes jaxprs on the UNDERLYING function + avals —
+    clearing the repo's KernelCaches alone still replays the old trace
+    through a fresh jit wrapper, hence the jax.clear_caches()."""
+    import jax
+
+    from bodo_tpu import relational as R
+    from bodo_tpu.io import device_decode as dd
+    from bodo_tpu.ops import hashtable as HT
+    from bodo_tpu.ops import join as J
+    from bodo_tpu.ops import sort as SRT
+    from bodo_tpu.parallel import shuffle as SH
+    from bodo_tpu.plan import fusion, physical
+    from bodo_tpu.plan import streaming_sharded as SS
+
+    for mod in (HT, J, SRT, SH, SS, R):
+        for nm in dir(mod):
+            c = getattr(getattr(mod, nm, None), "cache", None)
+            if c is not None and hasattr(c, "clear"):
+                c.clear()
+    R._jit_cache.clear()
+    dd.clear_programs()
+    fusion.clear_programs()
+    physical._result_cache.clear()
+    jax.clear_caches()
+
+
+def _pallas_partition_subprocess(n: int) -> dict:
+    """partition/range kernels only trace inside shard_map shuffles,
+    which need a >1-shard mesh — a 1-device bench mesh (--cpu default)
+    cannot shard at all. Re-run the distributed-sort leg in a
+    subprocess with 8 forced host devices and return that process's
+    positive per-family trace-count deltas."""
+    code = r'''
+import json, sys
+import numpy as np, pandas as pd
+from bodo_tpu import relational as R
+from bodo_tpu.config import set_config
+from bodo_tpu.ops import pallas_kernels as PK
+from bodo_tpu.plan import physical
+from bodo_tpu.table.table import Table
+n = int(sys.argv[1])
+PK.FORCE_INTERPRET = True
+set_config(shard_min_rows=0)
+rng = np.random.default_rng(13)
+sdf = pd.DataFrame({"k": rng.integers(0, 1 << 30, n),
+                    "v": rng.normal(size=n)})
+st = physical._maybe_shard(Table.from_pandas(sdf))
+srt = R.sort_table(st, ["k"]).to_pandas()
+assert (srt["k"].to_numpy() == np.sort(sdf["k"].to_numpy())).all()
+print(json.dumps({k: int(v) for k, v in PK.trace_counts.items() if v}))
+'''
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        out = subprocess.run([sys.executable, "-c", code, str(n)],
+                             capture_output=True, text=True, timeout=600,
+                             env=env, cwd=_REPO)
+        if out.returncode != 0:
+            print("pallas partition subprocess failed: "
+                  + out.stderr.strip()[-300:], file=sys.stderr)
+            return {}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 - probe is best-effort
+        print(f"pallas partition subprocess error: {e}", file=sys.stderr)
+        return {}
+
+
+def _pallas_family_probe(quick: bool) -> dict:
+    """Interpret-mode sweep engaging each Pallas kernel family on the
+    REAL operator pipelines — hash-probe (join), range/partition
+    (distributed sort), dict-gather/hybrid-expand (parquet device
+    decode) — and reporting per-family trace-count deltas. A positive
+    delta per family is the artifact's proof that the use_pallas()
+    routing reaches every operator, not just the groupby matmul; each
+    leg's result is checked against its host/XLA oracle."""
+    import numpy as np
+    import pandas as pd
+
+    import bodo_tpu
+    from bodo_tpu import relational as R
+    from bodo_tpu.config import config as _cfg, set_config
+    from bodo_tpu.io import read_parquet
+    from bodo_tpu.io.parquet import clear_footer_cache
+    from bodo_tpu.ops import pallas_kernels as PK
+    from bodo_tpu.plan import physical
+    from bodo_tpu.table.table import Table
+
+    n = 4_000 if quick else 20_000
+    rng = np.random.default_rng(13)
+    before = {k: int(v) for k, v in PK.trace_counts.items()}
+    prev = PK.FORCE_INTERPRET
+    PK.FORCE_INTERPRET = True
+    _clear_pallas_gate_caches()
+    old_dd = (_cfg.device_decode, _cfg.device_decode_min_bytes)
+    old_shard = _cfg.shard_min_rows
+    try:
+        # probe family: wide sparse int64 keys defeat the dense-LUT
+        # perfect-hash bypass, forcing the open-addressing probe kernel
+        keys = np.unique(rng.integers(-10**12, 10**12, 200))
+        left = pd.DataFrame({"k": rng.choice(keys, n),
+                             "v": rng.normal(size=n)})
+        right = pd.DataFrame({"k": keys, "d": rng.normal(size=len(keys))})
+        got = R.join_tables(Table.from_pandas(left),
+                            Table.from_pandas(right),
+                            ["k"], ["k"], "inner").to_pandas()
+        exp = left.merge(right, on="k", how="inner")
+        assert len(got) == len(exp), (len(got), len(exp))
+
+        # range + partition families: distributed sample sort
+        set_config(shard_min_rows=0)
+        sdf = pd.DataFrame({"k": rng.integers(0, 1 << 30, n),
+                            "v": rng.normal(size=n)})
+        st = physical._maybe_shard(Table.from_pandas(sdf))
+        srt = R.sort_table(st, ["k"]).to_pandas()
+        assert (srt["k"].to_numpy() == np.sort(sdf["k"].to_numpy())).all()
+
+        # decode family: dict strings + bools through the device decoder
+        data_dir = os.path.join(_REPO, ".bench_data")
+        os.makedirs(data_dir, exist_ok=True)
+        pqp = os.path.join(data_dir, "pallas_probe_dict.parquet")
+        ddf = pd.DataFrame({
+            "s": rng.choice(["alpha", "beta", "gamma", "delta"], n),
+            "b": rng.integers(0, 2, n).astype(bool)})
+        ddf.to_parquet(pqp, index=False)
+        set_config(device_decode=True, device_decode_min_bytes=0)
+        clear_footer_cache()
+        dec = read_parquet(pqp).to_pandas()
+        pd.testing.assert_frame_equal(dec, ddf)
+    finally:
+        PK.FORCE_INTERPRET = prev
+        set_config(device_decode=old_dd[0],
+                   device_decode_min_bytes=old_dd[1],
+                   shard_min_rows=old_shard)
+        clear_footer_cache()
+        _clear_pallas_gate_caches()
+    fams = {k: int(v) - before.get(k, 0)
+            for k, v in PK.trace_counts.items()
+            if int(v) - before.get(k, 0) > 0}
+    res = {"rows": n, "families_traced": fams}
+    if fams.get("partition", 0) <= 0:
+        import jax
+        if jax.device_count() == 1:
+            sub = {k: v for k, v in _pallas_partition_subprocess(n).items()
+                   if k in ("partition", "range") and v > 0}
+            if sub:
+                fams.update(sub)
+                res["partition_via_subprocess_mesh8"] = True
+    res["probe_partition_decode_ok"] = all(
+        fams.get(f, 0) > 0 for f in ("probe", "partition", "decode"))
+    return res
+
+
 def _join_pallas_probe(quick: bool) -> dict:
     """Interpret-mode probe proving the Pallas matmul_gather kernel
     sits inside the dense-join probe body: contiguous small-range keys
@@ -1492,6 +1708,34 @@ def bench_join(args, n_rows: int):
         detail["pallas_probe"] = {"error": f"{type(e).__name__}: "
                                            f"{str(e)[:300]}"}
         print(f"join pallas probe FAILED: {e}", file=sys.stderr)
+    try:
+        detail["stream"] = _stream_sync_probe(args.quick)
+        print(f"stream: {detail['stream']['host_syncs']} syncs / "
+              f"{detail['stream']['batches']} batches "
+              f"(window {detail['stream']['resolve_window']}, "
+              f"{detail['stream']['dispatch_per_batch']} per batch)",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - probe is reported, not fatal
+        detail["stream"] = {"error": f"{type(e).__name__}: "
+                                     f"{str(e)[:300]}"}
+        print(f"stream sync probe FAILED: {e}", file=sys.stderr)
+    try:
+        detail["pallas_families"] = _pallas_family_probe(args.quick)
+        print("pallas families traced: "
+              f"{detail['pallas_families']['families_traced']}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - probe is reported, not fatal
+        detail["pallas_families"] = {"error": f"{type(e).__name__}: "
+                                              f"{str(e)[:300]}"}
+        print(f"pallas family probe FAILED: {e}", file=sys.stderr)
+    if "dispatch_per_batch" in detail.get("stream", {}):
+        # promoted to its own benchwatch series ("ratio" = lower-better:
+        # the series regresses when per-batch dispatch syncs creep back)
+        detail["suites"] = {"stream_dispatch": {
+            "metric": "stream_dispatch_per_batch",
+            "value": detail["stream"]["dispatch_per_batch"],
+            "unit": "ratio",
+            "vs_baseline": detail["stream"]["dispatch_per_batch"]}}
     print(json.dumps({
         "metric": "join_mrows_per_s",
         "value": round(mrows, 3),

@@ -69,6 +69,16 @@ Rules:
       rank wedges exactly like a skipped collective (the
       rank-divergent-collective rule's host-side twin).
 
+  stream-sync-unannotated
+      A host sync (`jax.device_get` / `.block_until_ready()`) inside a
+      streaming accumulator module (plan/streaming*.py) without a
+      `# dispatch-boundary` comment on the call or an adjacent line.
+      Streaming steps are dispatch-free by design — syncs per stage
+      must stay O(1)-O(log batches), so every deliberate sync site is
+      annotated and counted in `stream_stats`; an unannotated sync is
+      either an accidental pipeline stall (O(batches) regression) or
+      an uncounted one the bench can't regress on.
+
 Suppressions: `# shardcheck: ignore[rule]` (or bare
 `# shardcheck: ignore` for all rules) on the finding's line or the
 line directly above. Grandfathered findings live in
@@ -113,6 +123,9 @@ RULES = {
         "RNG seeded from process/shard identity",
     "divergent-host-sync":
         "host sync of device arrays under rank-dependent control flow",
+    "stream-sync-unannotated":
+        "host sync in a streaming step body without a "
+        "dispatch-boundary annotation",
 }
 
 # names that identify process/shard identity in a branch condition
@@ -158,6 +171,12 @@ _HOST_SYNC_NAMES = {"device_get", "to_pandas", "device_put",
 # host syncs that are cross-host transfers for sharded arrays — under
 # rank-divergent control flow they wedge like a skipped collective
 _DIVERGENT_SYNC_NAMES = {"device_get", "block_until_ready"}
+
+# streaming accumulator modules: every host sync in a step body must be
+# a deliberate, annotated dispatch boundary (plan/streaming.py's
+# host-sync accounting contract)
+_STREAMING_FILE_RE = re.compile(r"(^|[/\\])plan[/\\]streaming[^/\\]*\.py$")
+_DISPATCH_BOUNDARY_RE = re.compile(r"#\s*dispatch-boundary")
 
 # RNG seeding entry points (numpy + jax.random)
 _RNG_SEED_NAMES = {"seed", "default_rng", "PRNGKey", "RandomState"}
@@ -359,10 +378,14 @@ def _calls_in_order(fn: ast.AST) -> List[ast.Call]:
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, src_lines: List[str],
-                 info: _ModuleInfo):
+                 info: _ModuleInfo,
+                 dispatch_lines: Optional[Set[int]] = None):
         self.rel = rel
         self.lines = src_lines
         self.info = info
+        self.dispatch_lines = dispatch_lines or set()
+        self._stream_mod = bool(
+            _STREAMING_FILE_RE.search(rel.replace(os.sep, "/")))
         self.findings: List[Finding] = []
         self._func: List[str] = []       # qualname stack
         self._div_depth = 0              # rank-divergent control flow
@@ -529,6 +552,19 @@ class _Checker(ast.NodeVisitor):
                 f"sharded array is a cross-host transfer — ranks that "
                 f"took the other branch never participate, wedging "
                 f"this rank like a skipped collective")
+        if self._stream_mod and self._func and \
+                t in _DIVERGENT_SYNC_NAMES:
+            lo = getattr(node, "lineno", 1) - 1
+            hi = getattr(node, "end_lineno", lo + 1) + 1
+            if not any(ln in self.dispatch_lines
+                       for ln in range(lo, hi + 1)):
+                self._add(
+                    "stream-sync-unannotated", node,
+                    f"{t!r} in a streaming step body without a "
+                    f"`# dispatch-boundary` annotation: streaming "
+                    f"stages budget O(1)-O(log batches) syncs — mark "
+                    f"the site deliberate (and _note_sync() it) or "
+                    f"hoist the fetch out of the per-batch path")
         if t in _RNG_SEED_NAMES and (node.args or node.keywords) and \
                 any(_test_is_rank_divergent(a)
                     for a in list(node.args) +
@@ -689,6 +725,22 @@ class _Checker(ast.NodeVisitor):
 # suppressions / baseline
 # ---------------------------------------------------------------------------
 
+def _dispatch_boundary_lines(source: str) -> Set[int]:
+    """Lines carrying a `# dispatch-boundary` comment (tokenize-based,
+    so the marker inside a string/docstring does not count)."""
+    out: Set[int] = set()
+    try:
+        tokens = tokenize.generate_tokens(
+            iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT and \
+                    _DISPATCH_BOUNDARY_RE.search(tok.string):
+                out.add(tok.start[0])
+    except tokenize.TokenError:
+        pass
+    return out
+
+
 def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> suppressed rule set (None = all rules). A comment
     suppresses its own line and the line below it."""
@@ -764,7 +816,8 @@ def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
                         text="", message=str(e))]
     info = _ModuleInfo()
     info.visit_Module(tree)
-    checker = _Checker(path, rel, source.splitlines(), info)
+    checker = _Checker(path, rel, source.splitlines(), info,
+                       dispatch_lines=_dispatch_boundary_lines(source))
     checker.visit(tree)
     supp = _suppressions(source)
     kept = []

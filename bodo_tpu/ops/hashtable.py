@@ -236,6 +236,15 @@ def probe_slots(build_codes: Tuple, owner, probe_codes: Tuple, ok,
     step = (_fmix64(h ^ _GOLD) | np.uint64(1)) & mask
     h = h & mask
 
+    # pallas route: the whole probe walk as one kernel (slot gather +
+    # 64-bit key compare on the MXU). Gate read at trace time — tests
+    # flipping FORCE_INTERPRET clear probe_slots.cache.
+    from bodo_tpu.ops import pallas_kernels as PK
+    res = PK.hash_probe(build_codes, owner, probe_codes, ok, h, step,
+                        T, max_rounds)
+    if res is not None:
+        return res
+
     def cond(state):
         r, idx, active = state
         return (r < max_rounds) & jnp.any(active)

@@ -46,6 +46,23 @@ _runtime_disabled = False
 # _pallas_proof run). Exported as pallas_traced_into_pipeline.
 trace_count = 0
 
+# per-kernel-family trace engagement (same trace-time semantics as
+# trace_count; bench.py surfaces these as pallas:<family> counters so
+# the probe/partition/decode kernels each prove engagement separately)
+trace_counts = {"groupby": 0, "gather": 0, "probe": 0, "partition": 0,
+                "decode": 0, "range": 0}
+
+
+def _engage(family: str) -> None:
+    global trace_count
+    trace_count += 1
+    trace_counts[family] = trace_counts.get(family, 0) + 1
+
+
+def reset_trace_counts() -> None:
+    for k in trace_counts:
+        trace_counts[k] = 0
+
 
 def disable_runtime(reason: str) -> None:
     global _runtime_disabled
@@ -209,8 +226,7 @@ def matmul_gather(codes, lut, interpret: Optional[bool] = None):
     gate, not here)."""
     interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
     if (use_pallas() or interp) and lut.shape[0] <= MAX_MATMUL_SLOTS:
-        global trace_count
-        trace_count += 1
+        _engage("gather")
         return _matmul_gather_kernel(codes, lut, lut.shape[0],
                                      interpret=interp)
     return lut[codes]
@@ -228,8 +244,7 @@ def bucket_counts(dest, ok, num_buckets: int,
     interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
     if ((use_pallas() or interp) and num_buckets <= MAX_MATMUL_SLOTS
             and dest.shape[0] < MAX_GATHER_VALUE):
-        global trace_count
-        trace_count += 1
+        _engage("partition")
         vals = ok.astype(jnp.float32)[:, None]
         sums = matmul_groupby_sum(dest.astype(jnp.int32), vals,
                                   num_buckets, 1, interpret=interp)
@@ -248,8 +263,7 @@ def dense_accumulate(codes, cols: Sequence, ok_masks: Sequence,
     list of f32/f64 [n_slots] arrays aligned with `cols`."""
     interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
     if (use_pallas() or interp) and n_slots <= MAX_MATMUL_SLOTS:
-        global trace_count
-        trace_count += 1
+        _engage("groupby")
         vals = jnp.stack(
             [jnp.where(ok, c, 0).astype(jnp.float32)
              for c, ok in zip(cols, ok_masks)], axis=1)
@@ -259,3 +273,443 @@ def dense_accumulate(codes, cols: Sequence, ok_masks: Sequence,
     return [jax.ops.segment_sum(jnp.where(ok, c, 0).astype(jnp.float64),
                                 codes, num_segments=n_slots)
             for c, ok in zip(cols, ok_masks)]
+
+
+# ---------------------------------------------------------------------------
+# hash-probe loop (open-addressing slot search on the MXU)
+# ---------------------------------------------------------------------------
+
+def _split_u64_planes(codes: Sequence) -> jax.Array:
+    """Split uint64 code columns into f32 16-bit planes [N, 4*len].
+
+    Two uint64s are equal iff all four of their 16-bit planes are equal,
+    and every plane value (< 2^16) is exact in f32 — so a one-hot MXU
+    gather of the planes supports exact 64-bit key comparison."""
+    planes = []
+    for c in codes:
+        for k in range(4):
+            planes.append(((c >> np.uint64(16 * k))
+                           & np.uint64(0xFFFF)).astype(jnp.float32))
+    return jnp.stack(planes, axis=1)
+
+
+# shardcheck: ignore[unregistered-jit]
+@functools.partial(jax.jit, static_argnames=("T", "n_planes",
+                                             "max_rounds", "interpret"))
+def _hash_probe_kernel(h_m, step_m, probe_planes, active0, slot_tab,
+                       T: int, n_planes: int, max_rounds: int,
+                       interpret: bool = False):
+    """Open-addressing probe loop in one kernel: each round gathers the
+    probed slot's (owner, key planes) row with a single one-hot MXU
+    matmul and resolves hits/misses in registers — the whole double-hash
+    walk stays on-chip instead of one XLA gather dispatch per round.
+
+    h_m/step_m: int32 [N] hash and step already reduced mod T (the probe
+    sequence (h + r*step) mod T only needs the low bits, so int32
+    arithmetic is exact). slot_tab: f32 [T, 1+n_planes] — column 0 is
+    the owning build row per slot (-1 empty), the rest are the slot
+    key's 16-bit planes. Returns (idx f32 [N,1], still_active f32
+    [N,1])."""
+    from jax.experimental import pallas as pl
+
+    n = h_m.shape[0]
+    k_pad = _round_up(max(T, 128), 128)
+    c_pad = _round_up(max(1 + n_planes, 128), 128)
+    p_pad = _round_up(max(n_planes, 128), 128)
+    n_pad = _round_up(max(n, _BLK), _BLK)
+
+    def pad_rows(a):
+        if a.shape[0] == n_pad:
+            return a
+        return jnp.concatenate(
+            [a, jnp.zeros((n_pad - a.shape[0],) + a.shape[1:], a.dtype)])
+
+    h2 = pad_rows(h_m[:, None])
+    s2 = pad_rows(step_m[:, None])
+    pp = pad_rows(jnp.pad(probe_planes,
+                          ((0, 0), (0, p_pad - n_planes))))
+    act = pad_rows(active0.astype(jnp.float32)[:, None])
+    tab = jnp.zeros((k_pad, c_pad), jnp.float32)
+    tab = tab.at[:T, :1 + n_planes].set(slot_tab)
+    maskT = np.int32(T - 1)
+
+    def kernel(hm_ref, sm_ref, pp_ref, act_ref, tab_ref, idx_ref,
+               unres_ref):
+        hm = hm_ref[:]
+        sm = sm_ref[:]
+        ppb = pp_ref[:]
+
+        def cond(st):
+            r, idx, active = st
+            return (r < max_rounds) & jnp.any(active > 0)
+
+        def body(st):
+            r, idx, active = st
+            p = jnp.bitwise_and(hm + r * sm, maskT)         # [BLK, 1]
+            onehot = (p == jax.lax.broadcasted_iota(
+                jnp.int32, (1, k_pad), 1)).astype(jnp.float32)
+            g = jax.lax.dot_general(
+                onehot, tab_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)        # [BLK, C]
+            o = g[:, 0:1]
+            eq = o >= 0
+            for j in range(n_planes):
+                eq = eq & (g[:, 1 + j:2 + j] == ppb[:, j:j + 1])
+            live = active > 0
+            hit = live & eq
+            miss = live & (o < 0)
+            idx = jnp.where(hit, o, idx)
+            active = jnp.where(hit | miss, 0.0, active)
+            return r + np.int32(1), idx, active
+
+        idx0 = jnp.full(hm.shape, -1.0, jnp.float32)
+        _r, idx, active = jax.lax.while_loop(
+            cond, body, (np.int32(0), idx0, act_ref[:]))
+        idx_ref[:] = idx
+        unres_ref[:] = active
+
+    # shardcheck: ignore[unregistered-jit]
+    idx, unres = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLK,),
+        in_specs=[
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLK, p_pad), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((k_pad, c_pad), lambda i: (_I0, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(h2, s2, pp, act, tab)
+    return idx[:n, 0], unres[:n, 0]
+
+
+def hash_probe(build_codes: Sequence, owner, probe_codes: Sequence, ok,
+               h, step, T: int, max_rounds: int,
+               interpret: Optional[bool] = None):
+    """Pallas route for ops/hashtable.probe_slots: the open-addressing
+    slot search as ONE kernel (per-round slot gather + 64-bit key
+    compare on the MXU via 16-bit planes). `h`/`step` are the caller's
+    uint64 double-hash sequence parameters. Returns (idx int32 [N],
+    unresolved bool) or None when the gate is closed (caller keeps its
+    XLA while_loop)."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    if not ((use_pallas() or interp) and T <= MAX_MATMUL_SLOTS
+            and T // 2 < MAX_GATHER_VALUE):
+        return None
+    _engage("probe")
+    maskT = np.uint64(T - 1)
+    h_m = (h & maskT).astype(jnp.int32)
+    step_m = (step & maskT).astype(jnp.int32)
+    # slot table: owner + the slot key's planes (gathered once, XLA)
+    osafe = jnp.maximum(owner, 0)
+    slot_planes = _split_u64_planes([c[osafe] for c in build_codes])
+    slot_tab = jnp.concatenate(
+        [owner.astype(jnp.float32)[:, None], slot_planes], axis=1)
+    probe_planes = _split_u64_planes(list(probe_codes))
+    idx, unres = _hash_probe_kernel(
+        h_m, step_m, probe_planes, ok, slot_tab, T,
+        4 * len(probe_codes), max_rounds, interpret=interp)
+    return idx.astype(jnp.int32), jnp.any(unres > 0)
+
+
+# ---------------------------------------------------------------------------
+# bucket partition scatter (stable in-bucket rank without a sort)
+# ---------------------------------------------------------------------------
+
+# shardcheck: ignore[unregistered-jit]
+@functools.partial(jax.jit, static_argnames=("num_buckets", "interpret"))
+def _partition_rank_kernel(dest, ok, num_buckets: int,
+                           interpret: bool = False):
+    """Stable in-bucket rank per row + per-bucket counts in one grid
+    pass: a block's in-block exclusive rank is a strict-lower-triangular
+    matmul against the block's one-hot destination matrix, and a running
+    per-bucket base rides in VMEM scratch across blocks (sequential
+    grid). Replaces the stable sort the XLA fallback uses to derive
+    scatter positions. Exact while ranks stay under the f32 mantissa
+    (callers gate rows < MAX_GATHER_VALUE)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = dest.shape[0]
+    k_pad = _round_up(max(num_buckets, 128), 128)
+    n_pad = _round_up(max(n, _BLK), _BLK)
+    if n_pad != n:
+        dest = jnp.concatenate(
+            [dest, jnp.zeros((n_pad - n,), dest.dtype)])
+        ok = jnp.concatenate([ok, jnp.zeros((n_pad - n,), bool)])
+    dest2 = dest.astype(jnp.int32)[:, None]
+    ok2 = ok.astype(jnp.float32)[:, None]
+
+    def kernel(dest_ref, ok_ref, rank_ref, cnt_ref, base_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            base_ref[:] = jnp.zeros_like(base_ref)
+
+        d = dest_ref[:]                                   # [BLK, 1]
+        okf = ok_ref[:]                                   # [BLK, 1]
+        onehot = (d == jax.lax.broadcasted_iota(
+            jnp.int32, (1, k_pad), 1)).astype(jnp.float32) * okf
+        row = jax.lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (_BLK, _BLK), 1)
+        tri = (row > col).astype(jnp.float32)
+        # earlier in-block rows per bucket, then select own column
+        prefix = jax.lax.dot_general(
+            tri, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)          # [BLK, K]
+        rank_in = jnp.sum(prefix * onehot, axis=1, keepdims=True)
+        base_at = jax.lax.dot_general(
+            onehot, base_ref[:].T,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)          # [BLK, 1]
+        rank_ref[:] = jnp.where(okf > 0, rank_in + base_at, -1.0)
+        base_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+
+        @pl.when(step == pl.num_programs(0) - 1)
+        def _flush():
+            cnt_ref[:] = base_ref[:]
+
+    # shardcheck: ignore[unregistered-jit]
+    rank, cnt = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLK,),
+        in_specs=[
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+            pl.BlockSpec((1, k_pad), lambda i: (_I0, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, k_pad), jnp.float32)],
+        interpret=interpret,
+    )(dest2, ok2)
+    return (rank[:n, 0].astype(jnp.int32),
+            cnt[0, :num_buckets].astype(jnp.int32))
+
+
+def partition_rank(dest, ok, num_buckets: int,
+                   interpret: Optional[bool] = None):
+    """Pallas route for the bucket-partition scatter: per-row stable
+    in-bucket rank plus per-bucket counts (parallel/shuffle.bucket_rows
+    derives scatter positions from this instead of a stable sort; the
+    sort sample-partition step shares it). Returns (rank int32 [N],
+    counts int32 [num_buckets]) or None when the gate is closed."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    if not ((use_pallas() or interp) and num_buckets <= MAX_MATMUL_SLOTS
+            and dest.shape[0] < MAX_GATHER_VALUE):
+        return None
+    _engage("partition")
+    return _partition_rank_kernel(dest.astype(jnp.int32), ok,
+                                  num_buckets, interpret=interp)
+
+
+# ---------------------------------------------------------------------------
+# RLE/bit-packed hybrid run expansion + dictionary gather (device decode)
+# ---------------------------------------------------------------------------
+
+# run-table bound for the in-kernel searchsorted (a [BLK, R] compare)
+MAX_EXPAND_RUNS = 2048
+
+
+# shardcheck: ignore[unregistered-jit]
+@functools.partial(jax.jit, static_argnames=("bw", "n_bucket", "n_runs",
+                                             "interpret"))
+def _hybrid_expand_kernel(data, starts, is_rle, vals, bits, bw: int,
+                          n_bucket: int, n_runs: int,
+                          interpret: bool = False):
+    """Hybrid RLE/bit-packed run expansion in one kernel: output index →
+    owning run via an in-register compare-count over the (small) run
+    table, run fields gathered by one-hot MXU matmul, bit-packed values
+    extracted through a 4-byte little-endian gather window. The byte
+    gathers use dynamic indexing (jnp.take) — interpret-proven; a
+    backend that rejects it falls back via disable_runtime."""
+    from jax.experimental import pallas as pl
+
+    r_pad = _round_up(max(n_runs, 128), 128)
+    c_pad = 128
+    n_pad = _round_up(max(n_bucket, _BLK), _BLK)
+    nb = data.shape[0]
+    sentinel = np.float32(n_bucket + 1)
+    st = jnp.full((1, r_pad), sentinel, jnp.float32).at[0, :n_runs].set(
+        starts.astype(jnp.float32))
+    tab = jnp.zeros((r_pad, c_pad), jnp.float32)
+    tab = tab.at[:n_runs, 0].set(starts.astype(jnp.float32))
+    tab = tab.at[:n_runs, 1].set(is_rle.astype(jnp.float32))
+    tab = tab.at[:n_runs, 2].set(vals.astype(jnp.float32))
+    tab = tab.at[:n_runs, 3].set(bits.astype(jnp.float32))
+    data2 = data.astype(jnp.uint32)[:, None]
+
+    def kernel(data_ref, st_ref, tab_ref, out_ref):
+        step = pl.program_id(0)
+        i = (step * _BLK + jax.lax.broadcasted_iota(
+            jnp.int32, (_BLK, 1), 0)).astype(jnp.float32)
+        # searchsorted(starts, i, 'right') - 1 == count(starts <= i) - 1
+        cnt = jnp.sum((st_ref[:] <= i).astype(jnp.float32), axis=1,
+                      keepdims=True)
+        r = jnp.clip(cnt - 1.0, 0.0, np.float32(n_runs - 1))
+        onehot = (r == jax.lax.broadcasted_iota(
+            jnp.float32, (1, r_pad), 1)).astype(jnp.float32)
+        g = jax.lax.dot_general(
+            onehot, tab_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)          # [BLK, C]
+        start_r = g[:, 0:1]
+        isrle_r = g[:, 1:2]
+        val_r = g[:, 2:3]
+        bit_r = g[:, 3:4]
+        if bw > 0:
+            rel = i - start_r
+            bp = (bit_r + rel * np.float32(bw)).astype(jnp.int32)
+            byte0 = bp >> 3
+            dat = data_ref[:]                              # [nb, 1]
+            w = jnp.take(dat, jnp.clip(byte0, 0, nb - 1),
+                         axis=0)[:, :, 0]
+            w = w | (jnp.take(dat, jnp.clip(byte0 + 1, 0, nb - 1),
+                              axis=0)[:, :, 0] << 8)
+            w = w | (jnp.take(dat, jnp.clip(byte0 + 2, 0, nb - 1),
+                              axis=0)[:, :, 0] << 16)
+            w = w | (jnp.take(dat, jnp.clip(byte0 + 3, 0, nb - 1),
+                              axis=0)[:, :, 0] << 24)
+            packed = ((w >> jnp.bitwise_and(bp, 7).astype(jnp.uint32))
+                      & np.uint32((1 << bw) - 1)).astype(jnp.float32)
+        else:
+            packed = jnp.zeros((_BLK, 1), jnp.float32)
+        out_ref[:] = jnp.where(isrle_r > 0, val_r, packed)
+
+    # shardcheck: ignore[unregistered-jit]
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLK,),
+        in_specs=[
+            pl.BlockSpec((nb, 1), lambda i: (_I0, _I0)),
+            pl.BlockSpec((1, r_pad), lambda i: (_I0, _I0)),
+            pl.BlockSpec((r_pad, c_pad), lambda i: (_I0, _I0)),
+        ],
+        out_specs=pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        interpret=interpret,
+    )(data2, st, tab)
+    return out[:n_bucket, 0].astype(jnp.int32)
+
+
+def hybrid_expand(data, starts, is_rle, vals, bits, bw: int,
+                  n_bucket: int, interpret: Optional[bool] = None):
+    """Pallas route for io/device_decode's hybrid run expansion (the
+    RLE/bit-packed decode inner loop — dict index streams, RLE booleans,
+    definition levels). Inputs are the already-padded device run tables.
+    Returns int32 [n_bucket] expanded values, or None when the gate is
+    closed (caller keeps the XLA searchsorted body)."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    n_runs = starts.shape[0]
+    if not ((use_pallas() or interp) and n_runs <= MAX_EXPAND_RUNS
+            and n_bucket < MAX_GATHER_VALUE
+            and data.shape[0] * 8 < MAX_GATHER_VALUE and 0 <= bw <= 24):
+        return None
+    _engage("decode")
+    return _hybrid_expand_kernel(data, starts, is_rle, vals, bits, bw,
+                                 n_bucket, n_runs, interpret=interp)
+
+
+def dict_gather(codes, lut, interpret: Optional[bool] = None):
+    """Pallas dictionary gather for decode: ``lut[codes]`` through the
+    one-hot MXU kernel (the string-dict rank remap and small numeric
+    dictionaries route here). LUT values must fit the f32 mantissa —
+    rank LUTs always do (ranks < dictionary length). Returns int32 [N]
+    or None when the gate is closed."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    if not ((use_pallas() or interp)
+            and lut.shape[0] <= MAX_MATMUL_SLOTS):
+        return None
+    _engage("decode")
+    return _matmul_gather_kernel(codes, lut, lut.shape[0],
+                                 interpret=interp)
+
+
+# ---------------------------------------------------------------------------
+# radix/range partition step (uint64 keys via 16-bit planes; ops/sort.py)
+# ---------------------------------------------------------------------------
+
+# shardcheck: ignore[unregistered-jit]
+@functools.partial(jax.jit, static_argnames=("n_spl", "interpret"))
+def _range_partition_kernel(pk_planes, spl_planes, spl_valid, n_spl: int,
+                            interpret: bool = False):
+    """dest = #(splitters <= pk) by lexicographic 16-bit-plane compare
+    (the radix step of the sample sort's range partition): uint64 order
+    decided plane-by-plane from the high radix digit down, all in f32
+    vector compares — no uint64 arithmetic in the kernel."""
+    from jax.experimental import pallas as pl
+
+    n = pk_planes.shape[0]
+    s_pad = _round_up(max(n_spl, 128), 128)
+    n_pad = _round_up(max(n, _BLK), _BLK)
+    if n_pad != n:
+        pk_planes = jnp.concatenate(
+            [pk_planes, jnp.zeros((n_pad - n, 4), pk_planes.dtype)])
+    spl = jnp.zeros((4, s_pad), jnp.float32)
+    spl = spl.at[:, :n_spl].set(spl_planes.T)
+    sv = jnp.zeros((1, s_pad), jnp.float32).at[0, :n_spl].set(
+        spl_valid.astype(jnp.float32))
+
+    def kernel(pp_ref, spl_ref, sv_ref, out_ref):
+        pp = pp_ref[:]                                    # [BLK, 4]
+        gt = jnp.zeros((_BLK, s_pad), jnp.float32)
+        eq = jnp.ones((_BLK, s_pad), jnp.float32)
+        for k in (3, 2, 1, 0):                            # high plane first
+            pkk = pp[:, k:k + 1]                          # [BLK, 1]
+            sk = spl_ref[k:k + 1, :]                      # [1, S]
+            gt = jnp.maximum(gt, eq * (pkk > sk).astype(jnp.float32))
+            eq = eq * (pkk == sk).astype(jnp.float32)
+        ge = jnp.maximum(gt, eq) * sv_ref[:]              # pk >= splitter
+        out_ref[:] = jnp.sum(ge, axis=1, keepdims=True)
+
+    # shardcheck: ignore[unregistered-jit]
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // _BLK,),
+        in_specs=[
+            pl.BlockSpec((_BLK, 4), lambda i: (i, _I0)),
+            pl.BlockSpec((4, s_pad), lambda i: (_I0, _I0)),
+            pl.BlockSpec((1, s_pad), lambda i: (_I0, _I0)),
+        ],
+        out_specs=pl.BlockSpec((_BLK, 1), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        interpret=interpret,
+    )(pk_planes, spl, sv)
+    return out[:n, 0].astype(jnp.int32)
+
+
+def range_partition(pk, splitters, interpret: Optional[bool] = None):
+    """Pallas route for the sample sort's destination assignment:
+    ``searchsorted(splitters, pk, side='right')`` over uint64 partition
+    keys, decided by 16-bit radix planes in-kernel. Returns int32 [N]
+    destinations or None when the gate is closed."""
+    interp = bool(interpret) if interpret is not None else FORCE_INTERPRET
+    n_spl = splitters.shape[0]
+    if not ((use_pallas() or interp) and 0 < n_spl <= MAX_MATMUL_SLOTS):
+        return None
+    _engage("range")
+    pk_planes = _split_u64_planes([pk])
+    spl_planes = _split_u64_planes([splitters])
+    return _range_partition_kernel(pk_planes, spl_planes,
+                                   jnp.ones((n_spl,), bool), n_spl,
+                                   interpret=interp)

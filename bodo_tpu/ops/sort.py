@@ -106,8 +106,14 @@ def _build_sort_sharded(mesh_key, num_arrays: int, num_keys: int,
         spl_idx = (jnp.arange(1, S) * jnp.maximum(nvalid, 1)) // S
         splitters = s_sorted[jnp.clip(spl_idx, 0, S * k - 1)]
 
-        # 2. range shuffle (dest = #splitters < pk)
-        dest = jnp.searchsorted(splitters, pk, side="right").astype(jnp.int32)
+        # 2. range shuffle (dest = #splitters < pk): the Pallas radix
+        # partition kernel decides uint64 order by 16-bit planes on the
+        # VPU; XLA searchsorted when the gate is closed
+        from bodo_tpu.ops import pallas_kernels as PK
+        dest = PK.range_partition(pk, splitters)
+        if dest is None:
+            dest = jnp.searchsorted(splitters, pk,
+                                    side="right").astype(jnp.int32)
         flat: List = []
         slots = []
         for d, v in arrays:

@@ -54,6 +54,28 @@ def bucket_rows(dest, arrays: Sequence, count, num_shards: int,
     cap = dest.shape[0]
     padmask = K.row_mask(count, cap)
     d = jnp.where(padmask, dest, num_shards).astype(jnp.int32)
+    live = padmask & (d < num_shards)
+    # bucket partition scatter: the Pallas partition_rank kernel derives
+    # every row's stable in-bucket rank AND the per-bucket histogram in
+    # one grid pass (triangular-matmul prefix + VMEM running base), so
+    # the XLA stable sort below never runs when the gate is open
+    res = PK.partition_rank(d, live, num_shards)
+    if res is not None:
+        rank, counts = res
+        ok = live & (rank >= 0) & (rank < bucket_cap)
+        overflow = jnp.any(live & (rank >= bucket_cap))
+        scatter_idx = jnp.where(ok, d * bucket_cap + rank,
+                                num_shards * bucket_cap)
+        packed = []
+        for a in arrays:
+            if a is None:
+                packed.append(None)
+                continue
+            z = jnp.zeros((num_shards * bucket_cap,) + a.shape[1:],
+                          dtype=a.dtype)
+            packed.append(z.at[scatter_idx].set(a, mode="drop"))
+        send_counts = jnp.minimum(counts.astype(jnp.int64), bucket_cap)
+        return packed, send_counts, overflow
     # stable sort rows by destination
     d_s, perm = lax.sort((d, jnp.arange(cap)), num_keys=1, is_stable=True)
     pos = jnp.arange(cap)

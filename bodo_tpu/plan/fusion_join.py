@@ -41,18 +41,30 @@ boundaries:
                     ONE compaction for the whole region, or zero when
                     a left join has no filters.
 
-  in-program        a terminal decomposable Aggregate over a 1D probe
-  shuffle           traces the whole two-phase groupby INSIDE the
-                    shard_map body: per-shard partial agg
-                    (ops/groupby.groupby_local) -> fixed-capacity
-                    bucket shuffle (parallel/shuffle.shuffle_partials,
-                    whose `lax.all_to_all` now lives inside the
-                    compiled program, with the Pallas one-hot MXU
-                    bucket histogram when the kernel gate is open) ->
-                    combine + finalize. The overflow flag collapses
-                    into the group's single host count sync; the host
-                    grows the bucket capacity and recompiles on
-                    overflow (×4 up to the always-safe bound).
+  in-program        a decomposable Aggregate over a 1D probe traces the
+  shuffle           whole two-phase groupby INSIDE the shard_map body:
+                    per-shard partial agg (ops/groupby.groupby_local)
+                    -> fixed-capacity bucket shuffle
+                    (parallel/shuffle.shuffle_partials, whose
+                    `lax.all_to_all` now lives inside the compiled
+                    program, with the Pallas one-hot MXU bucket
+                    histogram when the kernel gate is open) -> combine
+                    + finalize. The aggregate need NOT be terminal:
+                    [Filter|Projection] members ABOVE it (the `post`
+                    chain) trace over the finalized groups inside the
+                    same program, so the shuffle sits mid-program. The
+                    overflow flag collapses into the group's single
+                    host count sync; the host grows the bucket capacity
+                    and recompiles on overflow (×4 up to the
+                    always-safe bound).
+
+  1D build sides    a genuinely big 1D build (broadcast decision says
+                    no host gather) no longer falls back: each shard
+                    `lax.all_gather`s the build key/emit columns inside
+                    the program and builds the claim table as
+                    replicated compute; the dup-keys/claim-exhausted
+                    flag folds into the group's one sync, and the
+                    manifest declares the in-program ``all_gather``.
 
   lockstep / comm   the group manifest declares its in-program
                     collectives (`register_fusion_manifest(...,
@@ -112,7 +124,8 @@ from bodo_tpu.utils.logging import log
 # only be imported INSIDE functions here.
 
 _stats = {"groups_planned": 0, "groups_executed": 0, "partial": 0,
-          "fallbacks": 0, "agg_inprogram": 0, "shuffle_retries": 0}
+          "fallbacks": 0, "agg_inprogram": 0, "shuffle_retries": 0,
+          "post_chain_fused": 0, "build_gather_inprogram": 0}
 
 # device-resident build cache accounting (process-wide)
 _cstats = {"hits": 0, "misses": 0, "builds": 0, "negative": 0,
@@ -137,14 +150,20 @@ def reset_stats() -> None:
 # ---------------------------------------------------------------------------
 
 class JoinGroup:
-    """One fusable [chain -> Join -> chain -> agg?] region.
+    """One fusable [chain -> Join -> chain -> agg? -> chain?] region.
 
     below    [Filter|Projection] members UNDER the join's probe (left)
              child, bottom-up (below[0] consumes the input node)
     join     the L.Join member (how in inner/left, hash-probe eligible)
     above    [Filter|Projection] members over the joined schema,
              bottom-up
-    agg      optional terminal Aggregate (group root when present)
+    agg      optional Aggregate (group root unless a post chain sits
+             over it)
+    post     [Filter|Projection] members OVER the aggregate's output
+             schema, bottom-up — the non-terminal-shuffle extension:
+             the in-program bucket shuffle is no longer forced to sit
+             at the group root; the post chain traces over the
+             finalized groups inside the SAME program
     input    plan node feeding the below chain (executed normally)
     build    the join's right child (executed normally — its table is
              the build side, cached device-resident, NOT a member)
@@ -154,16 +173,21 @@ class JoinGroup:
     `fusion._finish_group` handles both.
     """
 
-    __slots__ = ("below", "join", "above", "agg", "root", "input",
-                 "build", "donate_ok")
+    __slots__ = ("below", "join", "above", "agg", "post", "root",
+                 "input", "build", "donate_ok")
 
-    def __init__(self, below, join, above, agg, input_node):
+    def __init__(self, below, join, above, agg, input_node, post=()):
         self.below = list(below)
         self.join = join
         self.above = list(above)
         self.agg = agg
-        self.root = agg if agg is not None else (
-            self.above[-1] if self.above else join)
+        self.post = list(post)
+        if self.post:
+            self.root = self.post[-1]
+        elif agg is not None:
+            self.root = agg
+        else:
+            self.root = self.above[-1] if self.above else join
         self.input = input_node
         self.build = join.right
         # fused join programs never donate: an unresolved-probe fallback
@@ -173,7 +197,9 @@ class JoinGroup:
     @property
     def members(self):
         """Members root-first (display order)."""
-        out = [self.agg] if self.agg is not None else []
+        out = list(reversed(self.post))
+        if self.agg is not None:
+            out.append(self.agg)
         out.extend(reversed(self.above))
         out.append(self.join)
         out.extend(reversed(self.below))
@@ -183,15 +209,30 @@ class JoinGroup:
         return tuple(type(m).__name__ for m in self.members)
 
 
+def _post_agg_claimable(aggnode: L.Node, parents) -> bool:
+    """Plan-time gate for claiming chain members ABOVE an aggregate
+    (the non-terminal-shuffle shape): only worth it when the aggregate
+    can decompose into the in-program shuffle — runtime still checks
+    the probe distribution; when this returns False the plain chain
+    grouper keeps the post chain and the terminal-agg shape applies."""
+    from bodo_tpu.ops.groupby import DECOMPOSE
+    if aggnode._cached is not None or not F._agg_fusable(aggnode):
+        return False
+    if parents.get(id(aggnode), 0) != 1:
+        return False
+    return all(op in DECOMPOSE for _, op, _ in aggnode.aggs)
+
+
 def try_join_group(node: L.Node, parents, claimed) -> Optional[JoinGroup]:
-    """Claim a [below-chain -> Join -> above-chain -> agg?] region
-    rooted at `node`, or None when no join-crossing group forms here
-    (the caller then tries the plain chain grouper). Same interior
-    rules as fusion._try_group: members must be single-parent and
-    unmaterialized."""
+    """Claim a [below-chain -> Join -> above-chain -> agg? ->
+    post-chain?] region rooted at `node`, or None when no join-crossing
+    group forms here (the caller then tries the plain chain grouper).
+    Same interior rules as fusion._try_group: members must be
+    single-parent and unmaterialized."""
     if not (config.fusion and config.fusion_join):
         return None
     agg = None
+    post_td: List[L.Node] = []  # top-down while walking
     top = node
     if isinstance(node, L.Aggregate):
         if not F._agg_fusable(node) or node._cached is not None:
@@ -208,6 +249,24 @@ def try_join_group(node: L.Node, parents, claimed) -> Optional[JoinGroup]:
             break
         above_td.append(cur)
         cur = cur.child
+    if agg is None and above_td and isinstance(cur, L.Aggregate) and \
+            _post_agg_claimable(cur, parents):
+        # the walked members sit ABOVE a decomposable aggregate: they
+        # become the POST chain (traced over the finalized groups, after
+        # the in-program shuffle) and the above-join chain walk restarts
+        # under the aggregate
+        agg = cur
+        post_td = above_td
+        above_td = []
+        cur = cur.child
+        if parents.get(id(cur), 0) != 1 or cur._cached is not None:
+            return None
+        while isinstance(cur, (L.Filter, L.Projection)) and \
+                cur._cached is None and F._node_fusable(cur):
+            if parents.get(id(cur), 0) != 1:
+                break
+            above_td.append(cur)
+            cur = cur.child
     if not isinstance(cur, L.Join):
         return None
     join = cur
@@ -237,7 +296,8 @@ def try_join_group(node: L.Node, parents, claimed) -> Optional[JoinGroup]:
     if agg is None and not above_td and not below_td:
         return None  # a lone join fuses nothing
     g = JoinGroup(list(reversed(below_td)), join,
-                  list(reversed(above_td)), agg, cur)
+                  list(reversed(above_td)), agg, cur,
+                  list(reversed(post_td)))
     if any(id(m) in claimed for m in g.members):
         return None  # defensive: overlapping walk already claimed one
     _stats["groups_planned"] += 1
@@ -440,6 +500,41 @@ def _flatten_tree(cur, names):
     return tuple(flat)
 
 
+def _make_build_gather(right_on, need, null_cols, null_equal, T, S,
+                       cap_shard, ax):
+    """In-program build over a 1D build side: each shard all_gathers the
+    build's key/emit columns (rank-order concat; padding resolved by
+    the gathered per-shard counts) and builds the claim table as
+    replicated compute — the collective lives INSIDE the compiled
+    program, replacing the host gather the per-node broadcast join
+    would do. Returns (gathered tree, codes, owner LUT, bad flag);
+    `bad` (duplicate keys / claim rounds exhausted) folds into the
+    group's single host sync."""
+
+    @F.fusion_stage
+    def gather_build(btree, bcounts):
+        allc = C.all_gather_rows(bcounts, ax)            # [S]
+        row = jnp.arange(S * cap_shard)
+        ok = (row % cap_shard) < allc[row // cap_shard]
+        gathered = {}
+        for n in need:
+            d, v = btree[n]
+            gd = C.all_gather_rows(d, ax)
+            gv = None if v is None else C.all_gather_rows(v, ax)
+            gathered[n] = (gd, gv)
+        keys = [gathered[k] for k in right_on]
+        codes, null_ok = HT.encode_columns_aligned(keys, null_cols,
+                                                   null_equal)
+        bok = ok if null_ok is None else (ok & null_ok)
+        slot, owner, _r, unresolved = HT.claim_slots(codes, bok, T)
+        cnt = jnp.zeros(T, jnp.int32).at[
+            jnp.where(slot >= 0, slot, T)].add(1, mode="drop")
+        bad = jnp.any(cnt > 1) | unresolved
+        return gathered, codes, owner, bad
+
+    return gather_build
+
+
 # ---------------------------------------------------------------------------
 # group execution (called from physical._exec_inner)
 # ---------------------------------------------------------------------------
@@ -484,13 +579,18 @@ def execute_join_group(group: JoinGroup, exec_child) -> Optional[Table]:
         _stats["groups_executed"] += 1
         F._finish_group(group, t, out)
         info = group.root._fusion_info
-        if info is not None and getattr(out, "_fusion_join_inprogram",
-                                        False):
-            # the program subsumed the bucket shuffle too: surface it in
-            # EXPLAIN ANALYZE next to the absorbed plan members, and name
-            # the collective the manifest declares for this group
-            info["members"] = tuple(info["members"]) + ("Shuffle",)
-            info["in_program_collectives"] = ("all_to_all",)
+        if info is not None:
+            # surface the collectives the program subsumed in EXPLAIN
+            # ANALYZE next to the absorbed plan members, matching what
+            # the manifest declares for this group
+            coll = []
+            if getattr(out, "_fusion_build_gather", False):
+                coll.append("all_gather")
+            if getattr(out, "_fusion_join_inprogram", False):
+                info["members"] = tuple(info["members"]) + ("Shuffle",)
+                coll.append("all_to_all")
+            if coll:
+                info["in_program_collectives"] = tuple(coll)
         if ev is not None:
             ev["rows"] = out.nrows
     return out
@@ -539,14 +639,19 @@ def _run_join_group(t: Table, b: Table, group: JoinGroup) -> Table:
         raise F.FusionFallback("empty schema")
     if not config.hash_join:
         raise F.FusionFallback("hash join disabled")
+    build_inprogram = False
     if b.distribution == ONED:
         # same runtime broadcast decision as the per-node path: a small
         # sharded build side replicates (one gather) so the probe never
-        # shuffles; a genuinely big 1D build needs shuffle-both-sides
+        # shuffles; a genuinely big 1D build over a 1D probe gathers
+        # INSIDE the program (lax.all_gather in the shard_map body) and
+        # builds the claim table as replicated compute
         from bodo_tpu.plan import adaptive
         if adaptive.join_broadcast_decision(b, t):
             b = b.gather()
-    if b.distribution != REP:
+        elif t.distribution == ONED and t.num_shards > 1:
+            build_inprogram = True
+    if b.distribution != REP and not build_inprogram:
         raise F.FusionFallback("1D build side")
     if b.nrows == 0:
         raise F.FusionFallback("empty build side")
@@ -560,7 +665,8 @@ def _run_join_group(t: Table, b: Table, group: JoinGroup) -> Table:
               F._steps_sig(group.below), F._steps_sig(group.above),
               tuple(left_on), tuple(right_on), how, null_equal,
               t.distribution,
-              (tuple(agg.keys), tuple(agg.aggs)) if agg else None)
+              (tuple(agg.keys), tuple(agg.aggs)) if agg else None,
+              F._steps_sig(group.post), build_inprogram)
     if fp_sig in F._failed:
         raise F.FusionFallback("negative-cached")
 
@@ -610,11 +716,14 @@ def _run_join_group(t: Table, b: Table, group: JoinGroup) -> Table:
         F._failed.add(fp_sig)
         raise F.FusionFallback(str(e)) from e
 
-    built = build_hash_table(b, right_on, null_cols, null_equal)
-    if built is None:
-        raise F.FusionFallback("duplicate build keys")
-    bcodes, owner = built
     T = HT.table_size(b.capacity)
+    if build_inprogram:
+        bcodes = owner = None  # built inside the program
+    else:
+        built = build_hash_table(b, right_on, null_cols, null_equal)
+        if built is None:
+            raise F.FusionFallback("duplicate build keys")
+        bcodes, owner = built
 
     agg_plan = None
     if agg is not None:
@@ -625,22 +734,62 @@ def _run_join_group(t: Table, b: Table, group: JoinGroup) -> Table:
             if missing:
                 agg_plan = None
 
+    post_meta = post_names = post_schema = post_dicts = None
+    if group.post:
+        if agg_plan is None:
+            # the post chain was claimed on the promise of the
+            # in-program aggregate; without it (REP probe, gate miss)
+            # the per-node path owns the region. Data-dependent — no
+            # negative cache.
+            raise F.FusionFallback(
+                "post-agg chain without in-program aggregate")
+        agg_schema = dict(agg.schema)
+        agg_dicts = {k: out_dicts[k] for k in agg_plan["kn"]
+                     if k in out_dicts}
+        try:
+            (post_meta, post_names, post_schema, post_dicts,
+             _post_compose) = F._chain_meta_from(agg_schema, agg_dicts,
+                                                 group.post)
+        except Exception as e:  # noqa: BLE001 - build failure -> unfused
+            F._failed.add(fp_sig)
+            raise F.FusionFallback(str(e)) from e
+
     in_names = list(t.names)
     body = _make_probe_body(below_meta, in_names, left_on, null_cols,
                             null_equal, T, how, lmap, below_names,
                             build_emit, rmap, above_meta)
-    bvals = b.select(build_emit).device_data()
     fp = F._group_fp(fp_sig)
     multi = t.distribution == ONED and t.num_shards > 1
 
-    if agg_plan is not None:
-        out = _dispatch_agg(t, b, group, body, bvals, bcodes, owner,
-                            agg_plan, out_schema, out_dicts, fp, fp_sig,
-                            multi)
+    if build_inprogram:
+        bneed = list(dict.fromkeys(right_on + build_emit))
+        gb = _make_build_gather(right_on, bneed, null_cols, null_equal,
+                                T, t.num_shards, b.shard_capacity,
+                                config.data_axis)
+        probe_body = body
+
+        @F.fusion_stage
+        def body(ptree, pcount, btree, bcounts):
+            bvals_g, bcodes_g, owner_g, bbad = gb(btree, bcounts)
+            cur2, mask2, p_unres = probe_body(ptree, pcount, bvals_g,
+                                              bcodes_g, owner_g)
+            return cur2, mask2, p_unres | bbad
+
+        bargs = (b.select(bneed).device_data(), b.counts_device())
+        bspecs = (P(config.data_axis), P(config.data_axis))
     else:
-        chained = _dispatch_chain(t, b, group, body, bvals, bcodes,
-                                  owner, out_names, out_schema,
-                                  out_dicts, fp, fp_sig, multi)
+        bargs = (b.select(build_emit).device_data(), bcodes, owner)
+        bspecs = (P(), P(), P())
+
+    if agg_plan is not None:
+        out = _dispatch_agg(t, b, group, body, bargs, bspecs, agg_plan,
+                            out_schema, out_dicts, post_meta,
+                            post_names, post_schema, post_dicts, fp,
+                            fp_sig, multi, build_inprogram)
+    else:
+        chained = _dispatch_chain(t, b, group, body, bargs, bspecs,
+                                  out_names, out_schema, out_dicts, fp,
+                                  fp_sig, multi, build_inprogram)
         if agg is not None:
             # partial fusion: the chain+probe fused, the aggregate (REP
             # input, non-decomposable op, or gate miss) finishes per-op
@@ -651,19 +800,22 @@ def _run_join_group(t: Table, b: Table, group: JoinGroup) -> Table:
                 setattr(out, attr, getattr(chained, attr, False))
         else:
             out = chained
+    if build_inprogram:
+        _stats["build_gather_inprogram"] += 1
     return out
 
 
 def _register_manifest(group: JoinGroup, fp: str, multi: bool,
-                       inprogram: bool) -> None:
+                       inprogram: bool, gather: bool = False) -> None:
     ops = (F._member_kinds(group.below) + ("join",)
            + F._member_kinds(group.above,
                              group.agg if inprogram else None))
     if inprogram:
-        ops = ops + ("shuffle",)
-    lockstep.register_fusion_manifest(
-        fp, ops, 1 if multi else 0,
-        in_program=("all_to_all",) if inprogram else ())
+        ops = ops + ("shuffle",) + F._member_kinds(group.post)
+    coll = (("all_gather",) if gather else ()) + \
+        (("all_to_all",) if inprogram else ())
+    lockstep.register_fusion_manifest(fp, ops, 1 if multi else 0,
+                                      in_program=coll)
 
 
 def _pre_dispatch(fp: str, multi: bool) -> float:
@@ -677,8 +829,9 @@ def _pre_dispatch(fp: str, multi: bool) -> float:
     return lockstep.pre_fused(fp)
 
 
-def _dispatch_chain(t, b, group, body, bvals, bcodes, owner, out_names,
-                    out_schema, out_dicts, fp, fp_sig, multi) -> Table:
+def _dispatch_chain(t, b, group, body, bargs, bspecs, out_names,
+                    out_schema, out_dicts, fp, fp_sig, multi,
+                    build_inprogram) -> Table:
     """Chain-exit variant: fused program returns the joined/filtered
     columns (one compaction, or zero for a filter-less left join)."""
     from bodo_tpu import relational as R
@@ -694,15 +847,15 @@ def _dispatch_chain(t, b, group, body, bvals, bcodes, owner, out_names,
            R._sig(b.select(rorder)), F._steps_sig(group.below),
            F._steps_sig(group.above), tuple(group.join.left_on),
            tuple(group.join.right_on), group.join.how,
-           group.join.null_equal, t.distribution, compact_needed)
+           group.join.null_equal, t.distribution, compact_needed,
+           build_inprogram)
     fn = F._programs.lookup(sig)
     compiled = fn is None
     if compiled:
         F._budget_compile(sig)
 
-        def fused(ptree, pcount, bvals_, bcodes_, owner_):
-            cur2, mask2, p_unres = body(ptree, pcount, bvals_, bcodes_,
-                                        owner_)
+        def fused(ptree, pcount, bargs_):
+            cur2, mask2, p_unres = body(ptree, pcount, *bargs_)
             flat = _flatten_tree(cur2, out_names)
             if compact_needed:
                 out, cnt = K.compact(mask2, flat)
@@ -713,29 +866,29 @@ def _dispatch_chain(t, b, group, body, bvals, bcodes, owner, out_names,
         if t.distribution == ONED:
             ax = config.data_axis
 
-            def sharded(ptree, pcounts, bvals_, bcodes_, owner_):
-                out, cnt, unres = fused(ptree, pcounts[0], bvals_,
-                                        bcodes_, owner_)
+            def sharded(ptree, pcounts, bargs_):
+                out, cnt, unres = fused(ptree, pcounts[0], bargs_)
                 return out, cnt[None], unres[None]
             fn = jax.jit(C.smap(
-                sharded, in_specs=(P(ax), P(ax), P(), P(), P()),
+                sharded, in_specs=(P(ax), P(ax), bspecs),
                 out_specs=(P(ax), P(ax), P(ax)), mesh=m))
         else:
             fn = jax.jit(fused)
-        _register_manifest(group, fp, multi, inprogram=False)
+        _register_manifest(group, fp, multi, inprogram=False,
+                           gather=build_inprogram)
 
     w = _pre_dispatch(fp, multi)
     t0 = _time.perf_counter()
     try:
         if t.distribution == ONED:
             out, cnts, unres = fn(t.device_data(), t.counts_device(),
-                                  bvals, bcodes, owner)
+                                  bargs)
             cnts_h, unres_h = jax.device_get((cnts, unres))
             counts = np.asarray(cnts_h).reshape(-1).astype(np.int64)
             bad = bool(np.asarray(unres_h).any())
         else:
             out, cnt, unres = fn(t.device_data(), jnp.asarray(t.nrows),
-                                 bvals, bcodes, owner)
+                                 bargs)
             cnt_h, unres_h = jax.device_get((cnt, unres))
             counts = None
             nrows = int(cnt_h)
@@ -747,10 +900,15 @@ def _dispatch_chain(t, b, group, body, bvals, bcodes, owner, out_names,
     if compiled:
         F._programs[sig] = fn
         F._programs.record_compile("fused_join", dt_s)
+    if multi and build_inprogram:
+        from bodo_tpu.parallel import comm
+        comm.record_in_program(fp, bytes_in=comm.table_bytes(b),
+                               wall_s=dt_s, wait_s=w)
     if bad:
-        # data-dependent probe-round exhaustion: the sort join owns this
-        # (no negative cache — a different batch may resolve fine)
-        raise F.FusionFallback("probe rounds exhausted")
+        # data-dependent: probe-round exhaustion (sort join owns it) or
+        # a bad in-program build (duplicate keys / claim exhaustion) —
+        # no negative cache, a different batch may resolve fine
+        raise F.FusionFallback("probe unresolved or bad build")
 
     cols: Dict[str, Column] = {}
     for i, n in enumerate(out_names):
@@ -763,19 +921,25 @@ def _dispatch_chain(t, b, group, body, bvals, bcodes, owner, out_names,
     res._fusion_compiled = compiled  # type: ignore[attr-defined]
     res._fusion_compile_s = dt_s if compiled else 0.0
     res._fusion_donated = False  # type: ignore[attr-defined]
+    res._fusion_build_gather = build_inprogram  # type: ignore[attr-defined]
     return R.rebucket(res)
 
 
-def _dispatch_agg(t, b, group, body, bvals, bcodes, owner, agg_plan,
-                  out_schema, out_dicts, fp, fp_sig, multi) -> Table:
+def _dispatch_agg(t, b, group, body, bargs, bspecs, agg_plan,
+                  out_schema, out_dicts, post_meta, post_names,
+                  post_schema, post_dicts, fp, fp_sig, multi,
+                  build_inprogram) -> Table:
     """Fully-fused variant over a 1D probe: the two-phase aggregate —
     partial agg, fixed-capacity bucket shuffle (`lax.all_to_all` INSIDE
     the shard_map body), combine, finalize — traces into the same
-    program as the chain+probe. One host sync carries (group counts,
+    program as the chain+probe, and a non-empty POST chain (the
+    non-terminal-shuffle shape) continues over the finalized groups
+    inside that program too. One host sync carries (group counts,
     shuffle overflow, probe unresolved); on overflow the host grows the
     bucket capacity ×4 (to the always-safe bound) and recompiles."""
     from bodo_tpu import relational as R
-    from bodo_tpu.ops.groupby import DECOMPOSE, groupby_local
+    from bodo_tpu.ops.groupby import (DECOMPOSE, agg_dtype,
+                                      groupby_local)
     from bodo_tpu.parallel.shuffle import (_finalize, _mesh_key,
                                            shuffle_partials)
     import types as _types
@@ -804,7 +968,8 @@ def _dispatch_agg(t, b, group, body, bvals, bcodes, owner, agg_plan,
                 R._sig(b.select(rorder)), F._steps_sig(group.below),
                 F._steps_sig(group.above), tuple(group.join.left_on),
                 tuple(group.join.right_on), group.join.how,
-                group.join.null_equal, tuple(kn), tuple(agg.aggs))
+                group.join.null_equal, tuple(kn), tuple(agg.aggs),
+                F._steps_sig(group.post), build_inprogram)
 
     while True:
         final_cap = S * bucket_cap
@@ -816,9 +981,8 @@ def _dispatch_agg(t, b, group, body, bvals, bcodes, owner, agg_plan,
             bc_static, fc_static = bucket_cap, final_cap
 
             @F.fusion_stage
-            def sharded(ptree, pcounts, bvals_, bcodes_, owner_):
-                cur2, mask2, p_unres = body(ptree, pcounts[0], bvals_,
-                                            bcodes_, owner_)
+            def sharded(ptree, pcounts, bargs_):
+                cur2, mask2, p_unres = body(ptree, pcounts[0], *bargs_)
                 flat = _flatten_tree(cur2, need)
                 packed, cnt = K.compact(mask2, flat)
                 pairs = {n: (packed[2 * i], packed[2 * i + 1])
@@ -842,20 +1006,48 @@ def _dispatch_agg(t, b, group, body, bvals, bcodes, owner, agg_plan,
                     finals.append(_finalize(
                         op, fv[off:off + nparts],
                         jnp.dtype(value_dtypes[i])))
-                return ((fk, tuple(finals)), ng2[None], ovf[None],
-                        p_unres[None])
+                if post_meta is None:
+                    return ((fk, tuple(finals)), ng2[None], ovf[None],
+                            p_unres[None])
+                # non-terminal shuffle: cast the finalized groups to
+                # their logical dtypes (same rules as the host exit
+                # path / relational._agg_out_col — no decimals, the
+                # plan gate rejects them) and run the post chain over
+                # them, all still inside the program
+                tree = {}
+                for kname, (kd, kv) in zip(kn, fk):
+                    kdt = out_schema[kname]
+                    if kdt is dt.STRING:
+                        kd = kd.astype(jnp.int32)
+                    elif kdt.kind == "b":
+                        kd = kd.astype(bool)
+                    elif kd.dtype != kdt.numpy:
+                        kd = kd.astype(kdt.numpy)
+                    tree[kname] = (kd, kv)
+                for (cname, op, oname), (vd, vv) in zip(agg.aggs,
+                                                        finals):
+                    rdt = agg_dtype(op, out_schema[cname])
+                    if vd.dtype != rdt.numpy:
+                        vd = vd.astype(rdt.numpy)
+                    tree[oname] = (vd, vv)
+                gmask = K.row_mask(ng2, fc_static)
+                cur3, mask3 = F._chain_body_masked(post_meta, tree,
+                                                   gmask)
+                outp, ng3 = K.compact(mask3,
+                                      _flatten_tree(cur3, post_names))
+                return (outp, ng3[None], ovf[None], p_unres[None])
 
             fn = jax.jit(C.smap(
-                sharded, in_specs=(P(ax), P(ax), P(), P(), P()),
+                sharded, in_specs=(P(ax), P(ax), bspecs),
                 out_specs=(P(ax), P(ax), P(ax), P(ax)), mesh=m))
-            _register_manifest(group, fp, multi, inprogram=True)
+            _register_manifest(group, fp, multi, inprogram=True,
+                               gather=build_inprogram)
 
         w = _pre_dispatch(fp, multi)
         t0 = _time.perf_counter()
         try:
-            (fk, finals), ngs, ovf, unres = fn(
-                t.device_data(), t.counts_device(), bvals, bcodes,
-                owner)
+            res_out, ngs, ovf, unres = fn(
+                t.device_data(), t.counts_device(), bargs)
             ngs_h, ovf_h, unres_h = jax.device_get((ngs, ovf, unres))
         except Exception as e:  # noqa: BLE001 - classified below
             F._classify_dispatch_error(e, fp_sig, compiled)
@@ -869,7 +1061,7 @@ def _dispatch_agg(t, b, group, body, bvals, bcodes, owner, agg_plan,
             comm.record_in_program(fp, bytes_in=comm.table_bytes(t),
                                    wall_s=dt_s, wait_s=w)
         if bool(np.asarray(unres_h).any()):
-            raise F.FusionFallback("probe rounds exhausted")
+            raise F.FusionFallback("probe unresolved or bad build")
         if bool(np.asarray(ovf_h).any()):
             if bucket_cap >= safe_cap:
                 raise F.FusionFallback(
@@ -882,24 +1074,32 @@ def _dispatch_agg(t, b, group, body, bvals, bcodes, owner, agg_plan,
     _stats["agg_inprogram"] += 1
     counts = np.asarray(ngs_h).reshape(-1).astype(np.int64)
     cols: Dict[str, Column] = {}
-    for kname, (kd, kv) in zip(kn, fk):
-        kdt = out_schema[kname]
-        if kdt is dt.STRING:
-            kd = kd.astype(np.int32)
-        elif kdt.kind == "b":
-            kd = kd.astype(bool)
-        elif kd.dtype != kdt.numpy:
-            kd = kd.astype(kdt.numpy)
-        cols[kname] = Column(kd, kv, kdt, out_dicts.get(kname))
-    for (cname, op, oname), (vd, vv) in zip(agg.aggs, finals):
-        src = _types.SimpleNamespace(dtype=out_schema[cname],
-                                     dictionary=out_dicts.get(cname))
-        cols[oname] = R._agg_out_col(src, op, vd, vv)
+    if post_meta is not None:
+        _stats["post_chain_fused"] += 1
+        for i, n in enumerate(post_names):
+            cols[n] = Column(res_out[2 * i], res_out[2 * i + 1],
+                             post_schema[n], post_dicts.get(n))
+    else:
+        fk, finals = res_out
+        for kname, (kd, kv) in zip(kn, fk):
+            kdt = out_schema[kname]
+            if kdt is dt.STRING:
+                kd = kd.astype(np.int32)
+            elif kdt.kind == "b":
+                kd = kd.astype(bool)
+            elif kd.dtype != kdt.numpy:
+                kd = kd.astype(kdt.numpy)
+            cols[kname] = Column(kd, kv, kdt, out_dicts.get(kname))
+        for (cname, op, oname), (vd, vv) in zip(agg.aggs, finals):
+            src = _types.SimpleNamespace(dtype=out_schema[cname],
+                                         dictionary=out_dicts.get(cname))
+            cols[oname] = R._agg_out_col(src, op, vd, vv)
     res = R.shrink_to_fit(Table(cols, int(counts.sum()), ONED, counts))
     res._fusion_compiled = compiled  # type: ignore[attr-defined]
     res._fusion_compile_s = dt_s if compiled else 0.0
     res._fusion_donated = False  # type: ignore[attr-defined]
     res._fusion_join_inprogram = True  # type: ignore[attr-defined]
+    res._fusion_build_gather = build_inprogram  # type: ignore[attr-defined]
     # the in-program shuffle's bucket histogram routes through the
     # Pallas one-hot MXU accumulate when the kernel gate is open
     if (PK.use_pallas() or PK.FORCE_INTERPRET) and \

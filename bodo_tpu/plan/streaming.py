@@ -45,6 +45,7 @@ from bodo_tpu.parallel.shuffle import _finalize, _plan_decomposition
 from bodo_tpu.plan import logical as L
 from bodo_tpu.table import dtypes as dt
 from bodo_tpu.table.table import (Column, REP, Table, round_capacity)
+from bodo_tpu.utils.kernel_cache import cached_builder
 from bodo_tpu.utils.logging import log
 
 
@@ -55,6 +56,32 @@ def _bucket_cap(n: int) -> int:
     while c < n:
         c <<= 1
     return c
+
+
+# ---------------------------------------------------------------------------
+# host-sync accounting
+# ---------------------------------------------------------------------------
+# Every `jax.device_get`/`block_until_ready` inside a streaming step body
+# stalls the pipeline: the host waits for the device instead of decoding
+# the next batch. The accumulators below are written so syncs per stage
+# are O(1)–O(log batches), not O(batches); each legitimate sync site is
+# annotated `# dispatch-boundary` (shardcheck lints unannotated ones) and
+# counted here so the bench can regress on syncs-per-batch.
+
+stream_stats: Dict[str, int] = {"host_syncs": 0, "batches": 0}
+
+
+def _note_sync(n: int = 1) -> None:
+    stream_stats["host_syncs"] += n
+
+
+def _note_batch(n: int = 1) -> None:
+    stream_stats["batches"] += n
+
+
+def reset_stream_stats() -> None:
+    for k in stream_stats:
+        stream_stats[k] = 0
 
 
 def _with_capacity(t: Table, cap: int) -> Table:
@@ -255,10 +282,17 @@ class GroupbyAccumulator:
     BOUNDS, so no host sync sits between batches. The device works on
     batch k's merge while the host decodes batch k+1 (the reference gets
     the same overlap from IncrementalShuffleState's async sends,
-    bodo/libs/streaming/_shuffle.h:777). Every SYNC_EVERY merges the
-    actual group count is synced once to re-tighten capacities."""
+    bodo/libs/streaming/_shuffle.h:777).
 
-    SYNC_EVERY = 4
+    Sync schedule is GEOMETRIC: the k-th capacity-tightening sync lands
+    after SYNC_EVERY·2^k merges, so a B-batch stream costs O(log B) host
+    round-trips total (a fixed interval would cost O(B)). Between syncs
+    the host bound creeps by at most the interval's batch rows, and each
+    sync snaps both the bound and the state capacity back to the actual
+    group count — capacity stays within one doubling of what a per-batch
+    sync would keep."""
+
+    SYNC_EVERY = 4  # first sync interval; doubles after every sync
 
     def __init__(self, keys: Sequence[str], aggs: Sequence[Tuple]):
         self.keys = list(keys)
@@ -273,6 +307,7 @@ class GroupbyAccumulator:
         self._n_state_dev = None            # device scalar (deferred sync)
         self._bound = 0                     # host upper bound on n_state
         self._since_sync = 0
+        self._sync_interval = self.SYNC_EVERY
         self._queue: List = []              # dispatched, unmerged partials
         self._template: Optional[Table] = None  # schema source
         self._grant = None                  # governor admission (lazy)
@@ -280,8 +315,10 @@ class GroupbyAccumulator:
     @property
     def n_state(self) -> int:
         self._drain_all()
-        return int(jax.device_get(self._n_state_dev)) \
-            if self._n_state_dev is not None else 0
+        if self._n_state_dev is None:
+            return 0
+        _note_sync()
+        return int(jax.device_get(self._n_state_dev))  # dispatch-boundary
 
     def _partial_names(self) -> List[str]:
         return [f"__p{i}" for i in range(len(self.partial_specs))]
@@ -366,12 +403,15 @@ class GroupbyAccumulator:
         # count — the true count lives on device until the next sync
         st = Table(cols, self._bound, REP, None)
 
-        if self._since_sync >= self.SYNC_EVERY:
-            # periodic sync: tighten the bound (and the state capacity)
-            # to the actual group count so capacities don't creep
-            n = int(jax.device_get(ng2))
+        if self._since_sync >= self._sync_interval:
+            # geometric sync: tighten the bound (and the state capacity)
+            # to the actual group count, then double the interval so a
+            # B-batch stream pays O(log B) of these round-trips total
+            _note_sync()
+            n = int(jax.device_get(ng2))  # dispatch-boundary
             self._bound = n
             self._since_sync = 0
+            self._sync_interval *= 2
             st = Table(cols, n, REP, None)
             tight = _bucket_cap(max(n, 1))
             if tight * 2 <= st.capacity:
@@ -555,10 +595,105 @@ class MixedGroupbyStream:
         return out
 
 
+_MOMENT_OPS = ("mean", "var", "std", "var0", "std0")
+
+
+def _sum_acc_dtype(d):
+    """Widened accumulation dtype for a sum over `d` (exact in the
+    widened source family — matches relational.reduce_table)."""
+    if jnp.issubdtype(d, jnp.floating):
+        return jnp.float64
+    if jnp.issubdtype(d, jnp.unsignedinteger):
+        return jnp.uint64
+    return jnp.int64
+
+
+def _minmax_identity(dtype, op: str):
+    if np.issubdtype(dtype, np.floating):
+        return np.array(np.inf if op == "min" else -np.inf, dtype)
+    if dtype == np.bool_:
+        return np.array(op == "min", np.bool_)
+    info = np.iinfo(dtype)
+    return np.array(info.max if op == "min" else info.min, dtype)
+
+
+@cached_builder("streaming")
+def _build_reduce_step(sig: Tuple, cap: int, donate: bool):
+    """One streamed-reduce step: per-batch masked partials folded into
+    the running device carry (sums/counts add, min/max fold through
+    their identities, moments combine with the exact delta-form Chan
+    update). `sig` is one (op, dtype_str, has_valid) per agg; the carry
+    is a flat tuple of 0-d device scalars, DONATED back to the step on
+    accelerator backends so the state never holds two buffers."""
+    from bodo_tpu.ops import kernels as K
+
+    def step(carry, arrays, count):
+        padmask = K.row_mask(count, cap)
+        out: List = []
+        ci = 0
+        for (op, dstr, _hv), (d, v) in zip(sig, arrays):
+            ok = K.value_ok(d, v, padmask)
+            if op in _MOMENT_OPS:
+                x = d.astype(jnp.float64)
+                n_b = jnp.sum(ok).astype(jnp.int64)
+                s_b = jnp.sum(jnp.where(ok, x, 0.0))
+                nbf = jnp.maximum(n_b, 1).astype(jnp.float64)
+                dd = jnp.where(ok, x - s_b / nbf, 0.0)
+                m2_b = jnp.sum(dd * dd)
+                n_a, s_a, m2_a = carry[ci], carry[ci + 1], carry[ci + 2]
+                naf = jnp.maximum(n_a, 1).astype(jnp.float64)
+                both = (n_a > 0) & (n_b > 0)
+                delta = s_b / nbf - s_a / naf
+                nf = n_a.astype(jnp.float64) + n_b.astype(jnp.float64)
+                term = jnp.where(
+                    both,
+                    delta * delta * n_a.astype(jnp.float64)
+                    * n_b.astype(jnp.float64) / jnp.maximum(nf, 1.0),
+                    0.0)
+                out += [n_a + n_b, s_a + s_b, m2_a + m2_b + term]
+                ci += 3
+            elif op in ("sum", "sumnull"):
+                acc = carry[ci]
+                x = d.astype(acc.dtype)
+                s_b = jnp.sum(jnp.where(ok, x, jnp.zeros((), x.dtype)))
+                out.append(acc + s_b)
+                ci += 1
+                if op == "sumnull":
+                    out.append(carry[ci] + jnp.sum(ok).astype(jnp.int64))
+                    ci += 1
+            elif op in ("count", "size"):
+                src = ok if op == "count" else padmask
+                out.append(carry[ci] + jnp.sum(src).astype(jnp.int64))
+                ci += 1
+            elif op in ("min", "max"):
+                ident = jnp.asarray(_minmax_identity(np.dtype(dstr), op))
+                f = jnp.minimum if op == "min" else jnp.maximum
+                red = jnp.min if op == "min" else jnp.max
+                out.append(f(carry[ci], red(jnp.where(ok, d, ident))))
+                out.append(carry[ci + 1] + jnp.sum(ok).astype(jnp.int64))
+                ci += 2
+            elif op == "prod":
+                p_b = jnp.prod(jnp.where(ok, d.astype(jnp.float64), 1.0))
+                out.append(carry[ci] * p_b)
+                ci += 1
+        return tuple(out)
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 class ReduceAccumulator:
-    """Streaming whole-column reductions: per-batch device partials, Chan
-    pairwise combine on host (reference: the streaming accumulate path of
-    groupby with no keys)."""
+    """Streaming whole-column reductions with a DEVICE-RESIDENT carry.
+
+    The old shape — per-batch `reduce_table` → host scalars → Python
+    combine — forced one device round-trip per batch, serializing decode
+    and compute. Now each push dispatches ONE jitted step that folds the
+    batch's masked partials into the running carry on device (Chan
+    delta-form combine for the moments; reference:
+    bodo/libs/groupby/_groupby_update.cpp var_combine), and the carry is
+    DONATED back to the step on accelerator backends
+    (`donate_argnums=(0,)`) so the state never occupies two buffers.
+    The host reads nothing until finish(): host syncs per stage are
+    O(1), was O(batches), and decode(n+1) overlaps compute(n)."""
 
     _SUPPORTED = {"sum", "sumnull", "count", "size", "min", "max", "mean",
                   "var", "std", "var0", "std0", "prod"}
@@ -568,88 +703,138 @@ class ReduceAccumulator:
             if op not in self._SUPPORTED:
                 raise NotImplementedError(op)
         self.aggs = list(aggs)
-        self.moments: Dict[int, List] = {}   # i -> [n, s, m2]
-        self.scalars: Dict[int, object] = {}
         self._template: Optional[Table] = None
+        self._carry: Optional[Tuple] = None  # flat 0-d device scalars
+        self._sig: Optional[Tuple] = None
+        self._nbatches = 0
+        self._donate = jax.default_backend() in ("tpu", "gpu")
+        # verify_donation verdict after the first donated step (None
+        # until one runs; False on backends that silently copy)
+        self.donation_verified: Optional[bool] = None
+
+    def _init_carry(self) -> Tuple:
+        slots: List = []
+        for op, dstr, _hv in self._sig:
+            if op in _MOMENT_OPS:
+                slots += [np.int64(0), np.float64(0.0), np.float64(0.0)]
+            elif op == "sum":
+                slots.append(np.zeros(
+                    (), _sum_acc_dtype(np.dtype(dstr)))[()])
+            elif op == "sumnull":
+                slots += [np.zeros((), _sum_acc_dtype(np.dtype(dstr)))[()],
+                          np.int64(0)]
+            elif op in ("count", "size"):
+                slots.append(np.int64(0))
+            elif op in ("min", "max"):
+                slots += [_minmax_identity(np.dtype(dstr), op),
+                          np.int64(0)]
+            elif op == "prod":
+                slots.append(np.float64(1.0))
+        return tuple(jnp.asarray(s) for s in slots)
 
     def push(self, batch: Table) -> None:
         if self._template is None:
             self._template = batch
-        req = []
-        for i, (col, op, _) in enumerate(self.aggs):
-            if op in ("mean", "var", "std", "var0", "std0"):
-                req += [(col, "sum", f"s{i}"), (col, "count", f"c{i}"),
-                        (col, "var0", f"v{i}")]
-            elif op in ("sumnull", "min", "max"):
-                req += [(col, op, f"x{i}"), (col, "count", f"c{i}")]
-            else:
-                req += [(col, op, f"x{i}")]
-        out = R.reduce_table(batch, req)
-        for i, (col, op, _) in enumerate(self.aggs):
-            if op in ("mean", "var", "std", "var0", "std0"):
-                n_b = out[f"c{i}"]
-                if not n_b:
-                    continue
-                s_b = float(out[f"s{i}"])
-                m2_b = float(out[f"v{i}"]) * n_b  # var0 ⇒ m2 = var·n
-                m = self.moments.get(i)
-                if m is None:
-                    self.moments[i] = [n_b, s_b, m2_b]
-                else:
-                    n_a, s_a, m2_a = m
-                    n_ab = n_a + n_b
-                    delta = s_b / n_b - s_a / n_a
-                    m2 = m2_a + m2_b + delta * delta * n_a * n_b / n_ab
-                    self.moments[i] = [n_ab, s_a + s_b, m2]
-            else:
-                cur = self.scalars.get(i)
-                v = out[f"x{i}"]
-                if op in ("sumnull", "min", "max"):
-                    if not out[f"c{i}"]:  # all-null batch contributes nothing
-                        continue
-                if cur is None:
-                    self.scalars[i] = v
-                elif op in ("sum", "sumnull"):
-                    self.scalars[i] = cur + v
-                elif op in ("count", "size"):
-                    self.scalars[i] = cur + v
-                elif op == "min":
-                    self.scalars[i] = min(cur, v)
-                elif op == "max":
-                    self.scalars[i] = max(cur, v)
-                elif op == "prod":
-                    self.scalars[i] = cur * v
+            self._sig = tuple(
+                (op, str(batch.column(col).data.dtype),
+                 batch.column(col).valid is not None)
+                for col, op, _ in self.aggs)
+        if self._carry is None:
+            self._carry = self._init_carry()
+        arrays = tuple((batch.column(col).data, batch.column(col).valid)
+                       for col, _, _ in self.aggs)
+        step = _build_reduce_step(self._sig, batch.capacity, self._donate)
+        from bodo_tpu.utils import tracing
+        old = self._carry
+        with tracing.event("stream_reduce"):
+            self._carry = step(old, arrays, jnp.asarray(batch.nrows))
+        self._nbatches += 1
+        if self._donate and self.donation_verified is None:
+            self.donation_verified = verify_carry_donation(old)
 
     def finish(self) -> Dict:
+        from bodo_tpu.relational import _reduce_scalar
+        if self._carry is None:
+            host: List = []
+        else:
+            _note_sync()
+            host = [np.asarray(x)
+                    for x in jax.device_get(self._carry)]  # dispatch-boundary
         res = {}
+        ci = 0
         for i, (col, op, oname) in enumerate(self.aggs):
-            if op in ("mean", "var", "std", "var0", "std0"):
-                m = self.moments.get(i)
-                if m is None:
+            src_dt = (self._template.column(col).dtype
+                      if self._template is not None else None)
+            if op in _MOMENT_OPS:
+                if not host:
                     res[oname] = np.nan
                     continue
-                n, s, m2 = m
-                if op == "mean":
-                    res[oname] = s / n
+                n = int(host[ci])
+                s, m2 = float(host[ci + 1]), float(host[ci + 2])
+                ci += 3
+                if n == 0:
+                    res[oname] = np.nan
+                elif op == "mean":
+                    res[oname] = _reduce_scalar(s / n, op, src_dt, n)
                 else:
                     ddof = 0 if op.endswith("0") else 1
                     if n > ddof:
                         v = max(m2 / (n - ddof), 0.0)
-                        res[oname] = float(np.sqrt(v)) \
-                            if op.startswith("std") else v
+                        v = float(np.sqrt(v)) if op.startswith("std") else v
+                        res[oname] = _reduce_scalar(v, op, src_dt, n)
                     else:
                         res[oname] = np.nan
-            else:
-                v = self.scalars.get(i)
-                if v is None:
-                    if op in ("count", "size"):
-                        v = 0
-                    elif op == "prod":
-                        v = 1.0
-                    else:
-                        v = np.nan
-                res[oname] = v
+            elif op == "sum":
+                res[oname] = (_reduce_scalar(host[ci], op, src_dt, None)
+                              if host else np.nan)
+                ci += 1
+            elif op == "sumnull":
+                if host and int(host[ci + 1]):
+                    res[oname] = _reduce_scalar(host[ci], op, src_dt,
+                                                int(host[ci + 1]))
+                else:
+                    res[oname] = np.nan
+                ci += 2
+            elif op in ("count", "size"):
+                res[oname] = int(host[ci]) if host else 0
+                ci += 1
+            elif op in ("min", "max"):
+                if host and int(host[ci + 1]):
+                    res[oname] = _reduce_scalar(host[ci], op, src_dt,
+                                                int(host[ci + 1]))
+                else:
+                    res[oname] = np.nan
+                ci += 2
+            elif op == "prod":
+                res[oname] = (_reduce_scalar(host[ci], op, src_dt, None)
+                              if host else 1.0)
+                ci += 1
         return res
+
+
+class _CarryView:
+    """Duck-typed Table over a flat carry tuple, so the observatory's
+    `verify_donation` (which walks `.columns[*].data/.valid`) can check
+    a streamed carry's buffers were consumed by a donated dispatch."""
+
+    class _Col:
+        __slots__ = ("data", "valid")
+
+        def __init__(self, data):
+            self.data, self.valid = data, None
+
+    def __init__(self, carry: Sequence):
+        self.columns = {f"__c{i}": self._Col(a)
+                        for i, a in enumerate(carry)}
+
+
+def verify_carry_donation(carry: Sequence) -> bool:
+    """After a donated streaming step, prove the previous carry's device
+    buffers were actually consumed (not silently copied) via the
+    observatory ledger. Returns the verdict; also feeds the
+    donated-dispatch verification counters."""
+    from bodo_tpu.runtime import xla_observatory as xobs
+    return xobs.verify_donation(_CarryView(carry))
 
 
 class SortAccumulator:
@@ -928,6 +1113,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
             adaptive.observe_batch(b)
             acc.push(b)
             nb += 1
+            _note_batch()
         if isinstance(acc, GroupbyAccumulator):
             if acc._template is None:
                 return None  # empty stream: no schema — fall back
@@ -951,6 +1137,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
         for b in src:
             adaptive.observe_batch(b)
             acc.push(b)
+            _note_batch()
         scalars = acc.finish()
         import pandas as pd
         return Table.from_pandas(
@@ -969,6 +1156,7 @@ def try_stream_execute(node: L.Node) -> Optional[Table]:
         for b in src:
             adaptive.observe_batch(b)
             acc.push(b)
+            _note_batch()
         if not acc.parts:
             acc.close()
             return None  # empty stream: fall back (handles the 0-row case)

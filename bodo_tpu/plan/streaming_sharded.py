@@ -228,16 +228,22 @@ class ShardedGroupbyAccumulator:
     (keys + partial-agg columns); finish() finalizes in place, so the
     result is already a valid 1D table — no gather anywhere.
 
-    Pipelining: push(k) dispatches step k FIRST, then resolves batch
-    k-1's overflow flag and output group counts — both long computed by
-    the time batch k was decoded on host, so neither read stalls the
-    pipe. The host therefore always knows the exact per-shard group count
-    with a one-batch lag, and sizes the state capacity as
-    known_count + 2·recv_window — flat in the number of batches. The
-    rare overflow rewinds to the kept pre-state and replays the affected
-    batches at a larger bucket capacity (O(2 batches + 1 state) extra
-    memory, the price of never blocking on a flag read).
+    Pipelining: push(k) dispatches step k FIRST; overflow flags and
+    output group counts resolve in WINDOWS of RESOLVE_WINDOW dispatches
+    — all of a window's flags plus the newest resolved count travel in
+    ONE batched `jax.device_get`, so host syncs per stage are
+    O(batches / W), not O(batches), and by the time a window retires its
+    flags are long computed (no read ever stalls the pipe). The host
+    therefore knows the exact per-shard group count with an at-most-W-
+    batch lag and sizes the state capacity as known_count + one recv
+    window per in-flight dispatch — flat in the number of batches. The
+    rare overflow rewinds to the kept pre-state of the FIRST overflowed
+    dispatch and replays from there at a larger bucket capacity
+    (O(W batches + 1 state) extra memory, the price of never blocking
+    on a flag read).
     """
+
+    RESOLVE_WINDOW = 8
 
     def __init__(self, keys: Sequence[str], aggs: Sequence[Tuple],
                  mesh=None):
@@ -327,21 +333,35 @@ class ShardedGroupbyAccumulator:
         bdicts = self._batch_dicts(batch)
         self._absorb_dicts(bdicts)
 
-        # state must hold: last exact count (1 batch stale) + each
-        # unresolved dispatch's OWN recv window + this batch's window
+        # state sizing gates on the last EXACT count plus this batch's
+        # worst case only — NOT a worst-case sum over the in-flight
+        # queue, which would force a drain (host sync) every few batches
+        # whenever the state is small relative to the batch size. Queued
+        # dispatches may have grown the true count past _known; that is
+        # caught at window resolution (the step's ng2 is the TRUE group
+        # count even when the state scatter dropped rows past capacity)
+        # and repaired by the same rewind-replay that handles bucket
+        # overflow, so the steady state keeps its O(B/W) sync cadence
+        # and its flat capacity.
         recv = min(self.S * self._bucket_cap, self.S * bcap)
-        need = self._known + sum(e["recv"] for e in self._queue) + recv
+        need = self._known + recv
         if self._state is None:
-            self._state_cap = _pow2_cap(max(need, 1))
+            # first push: _known is definitionally stale (no resolve has
+            # run yet) — budget one extra recv window of headroom so the
+            # steady-state capacity is reached immediately rather than
+            # via a growth step after the first exact count lands
+            self._state_cap = _pow2_cap(max(2 * recv, 1))
             self._state = self._zero_state(self._state_cap)
         elif need > self._state_cap:
             self._state_cap = _pow2_cap(need)
             self._state = self._recap_state(self._state, self._state_cap)
         self._dispatch(self._batch_inputs(batch), bcap, bdicts)
-        # resolve the PREVIOUS dispatch only after launching this one —
-        # its flag/counts are computed by now, so the read doesn't stall
-        while len(self._queue) > 1:
-            self._resolve_oldest()
+        # resolve in windows, always after launching the newest dispatch:
+        # a full window's flags retire with one batched host read, and
+        # the newest dispatch stays in flight to keep decode(n+1)
+        # overlapping compute(n)
+        if len(self._queue) >= self.RESOLVE_WINDOW:
+            self._resolve_window(len(self._queue) - 1)
 
     def _dispatch(self, inputs, bcap: int, bdicts) -> None:
         from bodo_tpu.parallel import comm
@@ -375,18 +395,48 @@ class ShardedGroupbyAccumulator:
             "pre_meta": list(self._state_meta),
             "inputs": inputs, "bdicts": bdicts,
             "ovf": ovf, "out_counts": ng2, "bcap": bcap,
-            "recv": min(self.S * self._bucket_cap, self.S * bcap)})
+            "scap": self._state_cap})
         self.peak_state_cap = max(self.peak_state_cap, self._state_cap)
         row_bytes = sum(m[1].numpy.itemsize + 1 for m in self._state_meta)
         self._grant.update(self.S * self._state_cap * row_bytes)
 
     def _resolve_oldest(self) -> None:
-        e = self._queue.pop(0)
-        flags = np.asarray(jax.device_get(e["ovf"])).reshape(-1)
-        if not flags.any():
-            cnts = np.asarray(jax.device_get(e["out_counts"])).reshape(-1)
-            self._known = int(cnts.max(initial=0))
+        self._resolve_window(1)
+
+    def _resolve_window(self, k: int) -> None:
+        """Retire the oldest k dispatches with ONE batched host read:
+        every flag in the window plus the newest retired dispatch's
+        group counts ride a single `jax.device_get`."""
+        from bodo_tpu.plan.streaming import _note_sync
+        if not self._queue or k <= 0:
             return
+        k = min(k, len(self._queue))
+        entries = self._queue[:k]
+        _note_sync()
+        got = jax.device_get(  # dispatch-boundary
+            [e["ovf"] for e in entries]
+            + [e["out_counts"] for e in entries])
+        flags = [np.asarray(f).reshape(-1) for f in got[:k]]
+        counts = [int(np.asarray(c).reshape(-1).max(initial=0))
+                  for c in got[k:]]
+        # two overflow modes per entry: the shuffle bucket dropped rows
+        # (ovf flag), or the state scatter dropped groups — visible as
+        # the TRUE group count ng2 exceeding the capacity the step was
+        # built with (push sizes state from a one-window-stale count)
+        first_bad = next(
+            (i for i, (f, e2, c) in enumerate(zip(flags, entries, counts))
+             if f.any() or c > e2["scap"]), None)
+        if first_bad is None:
+            self._queue = self._queue[k:]
+            self._known = counts[-1]
+            return
+        bucket_bad = bool(flags[first_bad].any())
+        # dispatches before the first overflow resolved clean — adopt
+        # the last clean count
+        self._queue = self._queue[first_bad:]
+        if first_bad > 0:
+            self._known = counts[first_bad - 1]
+        e = self._queue.pop(0)
         # overflow: every dispatch from this one on was built on a state
         # missing the dropped rows — rewind state AND dictionary metadata
         # to just before it, then replay them all at a larger bucket
@@ -399,10 +449,16 @@ class ShardedGroupbyAccumulator:
         self._queue = []
         self._state = e["pre_state"]
         self._state_meta = list(e["pre_meta"])
+        self._state_cap = e["scap"]  # capacity the rewound state has
         safe = max(_pow2_cap(x["bcap"]) for x in replay)
-        self._bucket_cap = min(self._bucket_cap * 4, safe)
-        log(1, f"stream1d shuffle overflow: replaying {len(replay)} "
-               f"batches at bucket_cap={self._bucket_cap}")
+        if bucket_bad:
+            self._bucket_cap = min(self._bucket_cap * 4, safe)
+        # state overflow needs no explicit growth here: _known is exact
+        # after the rewind, so the replay loop's known+recv sizing grows
+        # the state just enough before re-dispatching
+        log(1, f"stream1d overflow ({'bucket' if bucket_bad else 'state'})"
+               f": replaying {len(replay)} batches at "
+               f"bucket_cap={self._bucket_cap}")
         for x in replay:
             self._absorb_dicts(x["bdicts"])
             while True:
@@ -414,10 +470,11 @@ class ShardedGroupbyAccumulator:
                                                     self._state_cap)
                 self._dispatch(x["inputs"], x["bcap"], x["bdicts"])
                 e2 = self._queue.pop()
-                f2 = np.asarray(jax.device_get(e2["ovf"])).reshape(-1)
+                _note_sync()
+                f2, c2 = (np.asarray(a).reshape(-1) for a in
+                          jax.device_get(  # dispatch-boundary
+                              [e2["ovf"], e2["out_counts"]]))
                 if not f2.any():
-                    c2 = np.asarray(
-                        jax.device_get(e2["out_counts"])).reshape(-1)
                     self._known = int(c2.max(initial=0))
                     break
                 self._state = e2["pre_state"]
@@ -472,13 +529,16 @@ class ShardedGroupbyAccumulator:
             self._state = ((tuple(cols[:nk]), tuple(cols[nk:])), cnts)
 
     def finish(self) -> Table:
+        from bodo_tpu.plan.streaming import _note_sync
         assert self._template is not None, "empty stream"
         while self._queue:
-            self._resolve_oldest()
+            self._resolve_window(len(self._queue))
         nk = len(self.keys)
         (mk, mv), cnts_dev = self._state
-        counts = np.asarray(jax.device_get(cnts_dev)).reshape(-1) \
-            .astype(np.int64)
+        _note_sync()
+        counts = np.asarray(
+            jax.device_get(cnts_dev)).reshape(-1) \
+            .astype(np.int64)  # dispatch-boundary
         cols: Dict[str, Column] = {}
         for (name, dtype, dic, _), (d, v) in zip(self._state_meta[:nk],
                                                  mk):
@@ -733,11 +793,13 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
             acc = ShardedGroupbyAccumulator(node.keys, node.aggs, m)
         except NotImplementedError:
             return None
+        from bodo_tpu.plan.streaming import _note_batch
         nb = 0
         for b in src:
             adaptive.observe_batch(b)
             acc.push(b)
             nb += 1
+            _note_batch()
         if acc._template is None:
             acc._grant.release()
             return None
@@ -752,6 +814,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
         src1 = build_stream_sharded(node.child, m)
         if src1 is None:
             return None
+        from bodo_tpu.plan.streaming import _note_batch
         ss = ShardedStreamSort(node.by, node.ascending, node.na_last, m)
         nb = 0
         for b in src1:
@@ -759,6 +822,7 @@ def try_stream_execute_sharded(node) -> Optional[Table]:
             if not ss.push(b):
                 return None  # dict drift across batches: whole-table
             nb += 1
+            _note_batch()
         if ss.state is None and not ss.runs:
             ss.close()
             return None
@@ -843,7 +907,10 @@ def append_sharded(state: Optional[Table], batch: Table,
                        batch.shard_capacity, new_cap)
     out, cnts = fn(tuple(sflat), tuple(bflat), state.counts_device(),
                    batch.counts_device())
-    counts = np.asarray(jax.device_get(cnts)).reshape(-1).astype(np.int64)
+    from bodo_tpu.plan.streaming import _note_sync
+    _note_sync()
+    counts = np.asarray(
+        jax.device_get(cnts)).reshape(-1).astype(np.int64)  # dispatch-boundary
     cols: Dict[str, Column] = {}
     j = 0
     for n, has_v in zip(names, slots):
@@ -903,8 +970,8 @@ def _host_cols(t: Table):
     out = {}
     for n in t.names:
         c = t.column(n)
-        d = np.asarray(jax.device_get(c.data))[:t.nrows]
-        v = (np.asarray(jax.device_get(c.valid))[:t.nrows]
+        d = np.asarray(jax.device_get(c.data))[:t.nrows]  # dispatch-boundary
+        v = (np.asarray(jax.device_get(c.valid))[:t.nrows]  # dispatch-boundary
              if c.valid is not None else None)
         out[n] = (d, v)
     return out
@@ -977,7 +1044,7 @@ def _key_membership(p: Table, b: Table, left_on, right_on,
     T = HT.table_size(b.capacity)
     slot, owner, _r, un1 = HT.claim_slots(bcodes, b_ok, T)
     idx, un2 = HT.probe_slots(bcodes, owner, pcodes, p_ok, T)
-    if bool(jax.device_get(un1 | un2)):
+    if bool(jax.device_get(un1 | un2)):  # dispatch-boundary
         from bodo_tpu.utils import tracing
         log(1, "stream join drain: membership probe-round exhaustion — "
                f"falling back to host pandas merge ({p.nrows} probe x "
@@ -994,7 +1061,7 @@ def _key_membership(p: Table, b: Table, left_on, right_on,
             if ev is not None:
                 ev["rows"] = p.nrows
         return matched
-    return np.asarray(jax.device_get(idx))[:p.nrows] >= 0
+    return np.asarray(jax.device_get(idx))[:p.nrows] >= 0  # dispatch-boundary
 
 
 # ---------------------------------------------------------------------------
@@ -1285,7 +1352,7 @@ class ShardedStreamSort:
         padmask = jnp.arange(g.capacity) < g.nrows
         pk = _partition_key([(c0.data, c0.valid)], [self.ascending[0]],
                             self.na_last, padmask)
-        pk = np.asarray(jax.device_get(pk))[:g.nrows]
+        pk = np.asarray(jax.device_get(pk))[:g.nrows]  # dispatch-boundary
         nbytes = _table_device_bytes(g)
         ot = self._comp.park(self._op, g)
         self.runs.append((ot, pk, nbytes))

@@ -368,6 +368,14 @@ def classify_transient_text(text: str) -> Optional[str]:
         return "resource_exhausted"
     if any(m in text for m in _COORD_MARKERS):
         return "coordination"
+    if "terminate called without an active exception" in text \
+            and "Traceback" not in text:
+        # a bare C++ std::terminate with NO Python traceback: the worker
+        # died inside native thread machinery (TSL/XLA startup or
+        # teardown under load), never reaching user code — retry the
+        # gang like a coordination flake; a deterministic native bug
+        # still fails the bounded retry
+        return "native_abort"
     return None
 
 

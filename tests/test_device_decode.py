@@ -168,6 +168,26 @@ def test_parity_rle_bool_v2(tmp_path):
     _roundtrip(tmp_path, df, version="2.6")
 
 
+def test_arrow_schema_cache_pins_metadata(tmp_path):
+    """The id(md)-keyed arrow-schema cache must never serve a schema
+    left by a FREED FileMetaData whose address got reused: a stale
+    entry planted under this md's id (simulating reuse after the
+    bounded footer cache evicts) must be ignored, and the live entry
+    must pin md so its id can't be recycled while cached."""
+    path = str(tmp_path / "a.parquet")
+    pd.DataFrame({"x": [1, 2, 3]}).to_parquet(path, index=False)
+    md = footer_metadata(path)
+    stale = pa.schema([("ghost_i64", pa.int64())])
+    with dd._arrow_schema_lock:
+        dd._arrow_schema_cache[id(md)] = (object(), stale)
+    sch = dd._arrow_schema_of(md)
+    assert sch.names == ["x"]
+    with dd._arrow_schema_lock:
+        ent = dd._arrow_schema_cache[id(md)]
+    assert ent[0] is md  # pinned: id(md) stays unique while cached
+    assert dd._arrow_schema_of(md).names == ["x"]
+
+
 def test_parity_def_levels(tmp_path):
     _roundtrip(tmp_path, _mixed_frame(3000, nulls=True))
 

@@ -100,6 +100,13 @@ def test_transient_taxonomy():
         "Traceback ...\nConnectionRefusedError: [Errno 111]") \
         == "coordination"
     assert resilience.classify_transient_text("ValueError: nope") is None
+    # bare native abort (no Python traceback) retries like a flake ...
+    assert resilience.classify_transient_text(
+        "terminate called without an active exception") == "native_abort"
+    # ... but an abort AFTER a real Python failure stays permanent
+    assert resilience.classify_transient_text(
+        "Traceback ...\nValueError: nope\n"
+        "terminate called without an active exception") is None
 
 
 # ---------------------------------------------------------------------------

@@ -484,6 +484,51 @@ class TestLint:
         """)
         assert got == []
 
+    def _lint_streaming_src(self, tmp_path, source: str):
+        d = tmp_path / "plan"
+        d.mkdir(exist_ok=True)
+        p = d / "streaming_fixture.py"
+        p.write_text(textwrap.dedent(source))
+        return lint.lint_file(str(p), root=str(tmp_path))
+
+    def test_stream_sync_unannotated(self, tmp_path):
+        got = self._lint_streaming_src(tmp_path, """
+            import jax
+
+            def push(self, batch):
+                n = int(jax.device_get(batch))
+                n += 1
+                n += 2
+                batch.block_until_ready()
+                return n
+        """)
+        assert [f.rule for f in got] == ["stream-sync-unannotated"] * 2
+        assert {f.func for f in got} == {"push"}
+
+    def test_stream_sync_annotated_ok(self, tmp_path):
+        # annotation on the call line, on an adjacent line, and after
+        # the closing paren of a multi-line call all count
+        got = self._lint_streaming_src(tmp_path, """
+            import jax
+
+            def finish(self):
+                n = int(jax.device_get(self._n))  # dispatch-boundary
+                m = int(jax.device_get(
+                    self._m))  # dispatch-boundary
+                return n + m
+        """)
+        assert got == []
+
+    def test_stream_sync_rule_scoped_to_streaming_modules(self, tmp_path):
+        # the same unannotated sync outside plan/streaming*.py is fine
+        got = _lint_src(tmp_path, """
+            import jax
+
+            def push(self, batch):
+                return int(jax.device_get(batch))
+        """)
+        assert got == []
+
     def test_baseline_roundtrip(self, tmp_path, monkeypatch, capsys):
         mod = tmp_path / "legacy.py"
         mod.write_text(textwrap.dedent("""

@@ -137,8 +137,11 @@ def test_overflow_retry_under_skew(mesh8):
     set_config(shuffle_skew_factor=1.0)  # size buckets for no skew
     try:
         acc = _run_stream(df, ["k"], batch_rows=256)
-        assert acc.n_retries > 0, "skew must trigger the overflow replay"
         got = _got(acc.finish(), ["k"])
+        # the windowed protocol defers overflow detection to the next
+        # resolution (which for a short stream is the finish drain) —
+        # assert after finish so the check covers the deferred path
+        assert acc.n_retries > 0, "skew must trigger the overflow replay"
     finally:
         set_config(shuffle_skew_factor=old)
     exp = _expected(df, ["k"])

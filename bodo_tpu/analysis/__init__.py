@@ -7,19 +7,23 @@
                    (`python -m bodo_tpu.analysis`)
   lockstep         runtime collective-dispatch lockstep checker
                    (debug mode, BODO_TPU_LOCKSTEP=1)
+  progcheck        jaxpr-level SPMD program verifier at registration
+                   points: static lockstep manifests, donation audit,
+                   pre-dispatch HBM peak estimation
+                   (`python -m bodo_tpu.analysis --programs`)
 
-Submodules import lazily: `lockstep` is on the hot collective-dispatch
-path and must not drag the plan layer in, and `plan_validator` pulls
-plan.expr (jax) which the stdlib-only lint CLI path defers as long as
-possible.
+Submodules import lazily: `lockstep` and `progcheck` are on the hot
+dispatch/registration paths and must not drag the plan layer in, and
+`plan_validator` pulls plan.expr (jax) which the stdlib-only lint CLI
+path defers as long as possible.
 """
 
 from __future__ import annotations
 
-_LAZY = ("plan_validator", "lint", "lockstep")
+_LAZY = ("plan_validator", "lint", "lockstep", "progcheck")
 
-__all__ = ["PlanInvariantError", "LockstepError", "validate_plan",
-           "dist_of", *_LAZY]
+__all__ = ["PlanInvariantError", "LockstepError",
+           "ProgramInvariantError", "validate_plan", "dist_of", *_LAZY]
 
 
 def __getattr__(name):
@@ -32,4 +36,7 @@ def __getattr__(name):
     if name == "LockstepError":
         from bodo_tpu.analysis.lockstep import LockstepError
         return LockstepError
+    if name == "ProgramInvariantError":
+        from bodo_tpu.analysis.progcheck import ProgramInvariantError
+        return ProgramInvariantError
     raise AttributeError(name)

@@ -1,10 +1,24 @@
-"""`python -m bodo_tpu.analysis` — run the shardcheck lint CLI.
+"""`python -m bodo_tpu.analysis` — the shardcheck CLI.
 
-Exit 0 when every finding is inline-suppressed or baselined; exit 1 on
-any new finding (the `runtests.py lint` CI gate)."""
+Default mode runs the stdlib-only lint over the package (exit 0 when
+every finding is inline-suppressed or baselined; exit 1 on any new
+finding — the `runtests.py lint` CI gate, which also fails on DEAD
+baseline entries; `--prune-baseline` rewrites the baseline without
+them).
+
+`--programs` switches to the progcheck self-check: trace a
+representative program per family, extract collective manifests, and
+exit 1 on any invariant violation (the `runtests.py progcheck` gate).
+"""
 
 import sys
 
+argv = sys.argv[1:]
+if "--programs" in argv:
+    from bodo_tpu.analysis import progcheck
+
+    sys.exit(progcheck.main([a for a in argv if a != "--programs"]))
+
 from bodo_tpu.analysis import lint
 
-sys.exit(lint.main(sys.argv[1:]))
+sys.exit(lint.main(argv))

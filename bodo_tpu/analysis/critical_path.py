@@ -63,13 +63,19 @@ def critical_path(trace: dict,
 
     cur = max(evs, key=key)
     chain = [cur]
+    # zero-duration spans end exactly where they start, so without the
+    # visited set a mark event is its own "latest-ending predecessor"
+    # and the walk never terminates
+    seen = {id(cur)}
     while True:
         t_start = float(cur.get("ts", 0.0))
-        preds = [e for e in evs if end(e) <= t_start]
+        preds = [e for e in evs
+                 if end(e) <= t_start and id(e) not in seen]
         if not preds:
             break
         cur = max(preds, key=key)
         chain.append(cur)
+        seen.add(id(cur))
     chain.reverse()
 
     comm_us = compute_us = 0.0

@@ -71,9 +71,13 @@ Rules:
 
   stream-sync-unannotated
       A host sync (`jax.device_get` / `.block_until_ready()`) inside a
-      streaming accumulator module (plan/streaming*.py) without a
+      streaming accumulator module (plan/streaming*.py), a fused-join
+      dispatch body (plan/fusion_join.py), or a view step/maintenance
+      body (runtime/views.py functions whose name carries step/
+      maintenance/tick/refresh/materialize) without a
       `# dispatch-boundary` comment on the call or an adjacent line.
-      Streaming steps are dispatch-free by design — syncs per stage
+      Streaming steps — and the view-maintenance path that rides the
+      same executors — are dispatch-free by design — syncs per stage
       must stay O(1)-O(log batches), so every deliberate sync site is
       annotated and counted in `stream_stats`; an unannotated sync is
       either an accidental pipeline stall (O(batches) regression) or
@@ -85,10 +89,13 @@ line directly above. Grandfathered findings live in
 `analysis/baseline.json`, matched line-number-insensitively on
 (rule, file, enclosing function, source text) so unrelated edits don't
 resurrect them; `python -m bodo_tpu.analysis --write-baseline`
-regenerates it.
+regenerates it, and `--prune-baseline` drops DEAD entries (ones no
+current finding matches) without touching live ones.
 
 Exit status (CLI): 0 when every finding is suppressed or baselined,
-1 otherwise — `runtests.py lint` gates on this.
+1 otherwise — `runtests.py lint` gates on this. A full-package run
+also fails (exit 1) on dead baseline entries, so the baseline can only
+shrink as findings are fixed.
 """
 
 from __future__ import annotations
@@ -174,8 +181,15 @@ _DIVERGENT_SYNC_NAMES = {"device_get", "block_until_ready"}
 
 # streaming accumulator modules: every host sync in a step body must be
 # a deliberate, annotated dispatch boundary (plan/streaming.py's
-# host-sync accounting contract)
+# host-sync accounting contract). plan/fusion_join.py rides the same
+# contract whole-module (its group dispatch is the one budgeted sync);
+# runtime/views.py only in step/maintenance bodies (the serving-path
+# refresh loop), matched by enclosing-function name.
 _STREAMING_FILE_RE = re.compile(r"(^|[/\\])plan[/\\]streaming[^/\\]*\.py$")
+_STREAM_WHOLE_FILE_RE = re.compile(r"(^|[/\\])plan[/\\]fusion_join\.py$")
+_STREAM_SCOPED_FILE_RE = re.compile(r"(^|[/\\])runtime[/\\]views\.py$")
+_STREAM_SCOPED_FUNC_RE = re.compile(
+    r"step|maintenance|tick|refresh|materialize")
 _DISPATCH_BOUNDARY_RE = re.compile(r"#\s*dispatch-boundary")
 
 # RNG seeding entry points (numpy + jax.random)
@@ -384,8 +398,12 @@ class _Checker(ast.NodeVisitor):
         self.lines = src_lines
         self.info = info
         self.dispatch_lines = dispatch_lines or set()
+        rel_posix = rel.replace(os.sep, "/")
         self._stream_mod = bool(
-            _STREAMING_FILE_RE.search(rel.replace(os.sep, "/")))
+            _STREAMING_FILE_RE.search(rel_posix)
+            or _STREAM_WHOLE_FILE_RE.search(rel_posix))
+        self._stream_scoped = bool(
+            _STREAM_SCOPED_FILE_RE.search(rel_posix))
         self.findings: List[Finding] = []
         self._func: List[str] = []       # qualname stack
         self._div_depth = 0              # rank-divergent control flow
@@ -552,7 +570,10 @@ class _Checker(ast.NodeVisitor):
                 f"sharded array is a cross-host transfer — ranks that "
                 f"took the other branch never participate, wedging "
                 f"this rank like a skipped collective")
-        if self._stream_mod and self._func and \
+        in_stream_body = self._stream_mod or (
+            self._stream_scoped and any(
+                _STREAM_SCOPED_FUNC_RE.search(fn) for fn in self._func))
+        if in_stream_body and self._func and \
                 t in _DIVERGENT_SYNC_NAMES:
             lo = getattr(node, "lineno", 1) - 1
             hi = getattr(node, "end_lineno", lo + 1) + 1
@@ -785,8 +806,12 @@ def load_baseline(path: str) -> List[tuple]:
 
 
 def write_baseline(path: str, findings: List[Finding]) -> None:
-    entries = [{"rule": f.rule, "file": f.path, "func": f.func,
-                "text": f.text} for f in findings]
+    _write_baseline_keys(path, [f.key() for f in findings])
+
+
+def _write_baseline_keys(path: str, keys: List[tuple]) -> None:
+    entries = [{"rule": rule, "file": file, "func": func, "text": text}
+               for rule, file, func, text in keys]
     with open(path, "w") as fh:
         json.dump(entries, fh, indent=1, sort_keys=True)
         fh.write("\n")
@@ -865,6 +890,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="report baselined findings too")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current findings")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries no current finding "
+                         "matches (keeps live ones untouched)")
     args = ap.parse_args(argv)
     _stats["runs"] += 1
     if args.paths:
@@ -877,6 +905,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"shardcheck: wrote {len(findings)} baseline entries to "
               f"{args.baseline}")
         return 0
+    live_keys = {f.key() for f in findings}
+    if args.prune_baseline:
+        if args.paths:
+            # a partial scan would read unscanned files' entries as
+            # falsely dead and silently delete them
+            print("shardcheck: --prune-baseline requires a full-package "
+                  "run (no explicit paths)")
+            return 1
+        entries = load_baseline(args.baseline)
+        kept = [e for e in entries if e in live_keys]
+        _write_baseline_keys(args.baseline, kept)
+        print(f"shardcheck: pruned {len(entries) - len(kept)} dead "
+              f"baseline entries ({len(kept)} kept) in {args.baseline}")
+        return 0
     baseline = set() if args.no_baseline else \
         set(load_baseline(args.baseline))
     fresh = []
@@ -887,9 +929,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             fresh.append(f)
     for f in fresh:
         print(f.render())
+    # full-package runs also gate on DEAD baseline entries: a fixed
+    # finding must leave the baseline (--prune-baseline removes it),
+    # otherwise the grandfather list silently grows stale and can
+    # resurrect a regression unnoticed. Partial-path runs skip this —
+    # entries for unscanned files would read as falsely dead.
+    dead: List[tuple] = []
+    if not args.paths and not args.no_baseline:
+        dead = sorted(baseline - live_keys)
+        for rule, file, func, text in dead:
+            where = f" (in {func})" if func else ""
+            print(f"{file}: [{rule}] DEAD baseline entry — the finding "
+                  f"no longer fires{where}; run --prune-baseline"
+                  f"\n    {text}")
     n_base = len(findings) - len(fresh)
     print(f"shardcheck: {_stats['files']} files, "
           f"{len(findings)} findings "
           f"({n_base} baselined, {_stats['suppressed']} suppressed "
-          f"inline, {len(fresh)} new)")
-    return 1 if fresh else 0
+          f"inline, {len(fresh)} new"
+          + (f", {len(dead)} dead baseline entries" if dead else "")
+          + ")")
+    return 1 if fresh or dead else 0

@@ -65,7 +65,8 @@ class LockstepError(RuntimeError):
 _lock = threading.Lock()
 _checker = None       # Checker | False (disabled after warning) | None
 _stats = {"collectives": 0, "wait_s": 0.0, "max_wait_s": 0.0,
-          "mismatches": 0, "timeouts": 0, "fused_dispatches": 0}
+          "mismatches": 0, "timeouts": 0, "fused_dispatches": 0,
+          "prevalidations": 0, "prevalidation_issues": 0}
 # mesh epoch: bumped by the elastic layer on every re-mesh (shrink or
 # grow). Sequence numbers and fingerprints are namespaced per epoch —
 # survivors of a shrink restart from seq 1 in fresh per-epoch logs, so
@@ -81,6 +82,14 @@ _mesh_epoch = 0
 # dispatch is sequence-numbered as ONE composite collective via
 # pre_fused() — peers must dispatch the same group at the same seq.
 _manifests: Dict[str, dict] = {}
+
+# Static per-program collective manifests extracted by the jaxpr
+# verifier (analysis/progcheck.py) at registration time: program name
+# -> ordered collective primitive names + rank-invariance verdict.
+# These are what pre_validate_programs() checks BEFORE a gang's first
+# dispatch — a rank-variant program is a guaranteed future divergence,
+# so it is reported while the gang is still idle and debuggable.
+_program_manifests: Dict[str, dict] = {}
 
 
 def stats() -> dict:
@@ -251,6 +260,79 @@ def fusion_manifests() -> Dict[str, dict]:
         return {k: dict(v) for k, v in _manifests.items()}
 
 
+def register_program_manifest(program: str, *, collectives=(),
+                              rank_invariant: bool = True,
+                              subsystem: str = "", hbm_bytes: int = 0,
+                              violations: int = 0) -> None:
+    """Register the STATIC collective manifest of one verified program
+    (called by progcheck at trace time): the ordered collective
+    primitive names the compiled body dispatches, whether the schedule
+    is provably rank-invariant, and the static HBM peak estimate.
+    Unconditional and cheap, like register_fusion_manifest — manifests
+    must exist before lockstep is ever enabled."""
+    with _lock:
+        _program_manifests[program] = {
+            "collectives": tuple(collectives),
+            "rank_invariant": bool(rank_invariant),
+            "subsystem": subsystem,
+            "hbm_bytes": int(hbm_bytes),
+            "violations": int(violations),
+        }
+
+
+def program_manifest(program: str) -> Optional[dict]:
+    with _lock:
+        m = _program_manifests.get(program)
+        return dict(m) if m is not None else None
+
+
+def program_manifests() -> Dict[str, dict]:
+    with _lock:
+        return {k: dict(v) for k, v in _program_manifests.items()}
+
+
+def clear_program_manifests() -> None:
+    with _lock:
+        _program_manifests.clear()
+
+
+def pre_validate_programs() -> list:
+    """Validate the gang's registered program set BEFORE first
+    dispatch: (1) no program's static manifest is rank-variant (a
+    guaranteed divergence once dispatched); (2) every fused group that
+    declared in-program collectives agrees with the verifier's
+    extracted manifest for its compiled program. Returns the issue
+    strings (also counted in stats); called when the checker binds."""
+    issues = []
+    with _lock:
+        progs = {k: dict(v) for k, v in _program_manifests.items()}
+        groups = {k: dict(v) for k, v in _manifests.items()}
+    for name, m in sorted(progs.items()):
+        if not m["rank_invariant"]:
+            issues.append(
+                f"program {name!r} has a rank-VARIANT collective "
+                f"schedule (collectives under axis_index-derived "
+                f"control flow): dispatching it will diverge the gang")
+    for fp, g in sorted(groups.items()):
+        declared = set(g.get("in_program") or ())
+        if not declared:
+            continue
+        pm = progs.get(f"fused:{fp}")
+        if pm is None:
+            continue
+        got = set(pm["collectives"])
+        if not declared <= got:
+            issues.append(
+                f"fused group {fp!r} declares in-program collectives "
+                f"{sorted(declared)} but its verified program traced "
+                f"only {sorted(got)}: the manifest lies to the "
+                f"runtime checker")
+    with _lock:
+        _stats["prevalidations"] += 1
+        _stats["prevalidation_issues"] += len(issues)
+    return issues
+
+
 def pre_fused(group_fp: str) -> float:
     """Sequence-number one fused-group dispatch as a composite
     collective. The fingerprint is the group fp alone (derived from the
@@ -287,7 +369,13 @@ def _get_checker() -> Optional["Checker"]:
             return None
         _checker = Checker(d or None, _rank(), nprocs,
                            epoch=_mesh_epoch)
-        return _checker
+        c = _checker
+    # pre-validate the program set before this gang's FIRST dispatch
+    # (outside _lock: pre_validate_programs takes it)
+    for issue in pre_validate_programs():
+        sys.stderr.write(f"bodo_tpu.lockstep: pre-validation: "
+                         f"{issue}\n")
+    return c
 
 
 class _PeerLog:

@@ -44,6 +44,7 @@ API for plan-building frontends.
 
 from __future__ import annotations
 
+import sys
 from typing import Dict
 
 from bodo_tpu.plan.expr import expr_columns
@@ -116,6 +117,11 @@ OP_DIST = {
     "Union": lambda n, ds: DIST,
     "NonEquiJoin": lambda n, ds: DIST,
     "Explode": lambda n, ds: DIST,
+    # a ViewScan serves its view's materialization through
+    # physical._maybe_shard, which may re-shard even a replicated
+    # materialization once it grows past shard_min_rows — so like the
+    # base source scans it is DIST regardless of the defining plan
+    "ViewScan": lambda n, ds: DIST,
 }
 
 # what the relational-layer kernel RETURNS for ops whose result
@@ -181,11 +187,75 @@ def _is_string(dtype) -> bool:
         getattr(dtype, "name", "") == "string"
 
 
+def _check_view_scan(node, path: str) -> None:
+    """ViewScan leaf rules. Lazy: runtime/views.py is consulted only
+    when already imported — a ViewScan can only be minted by
+    views.scan_node, so the module is resident whenever a genuine plan
+    carries one (a hand-built ViewScan with views never loaded
+    validates permissively, matching the unknown-node default).
+
+      unknown-view           the named view is not registered; execute
+                             would fail deep inside materialization
+      unsigned-view-sources  some leaf of the view's defining plan is
+                             unsignable, so the result cache could not
+                             sign — or ever invalidate — a consumer's
+                             entry built over this scan
+      view-schema-drift      the scan's snapshotted schema disagrees
+                             with the live view (redefined since the
+                             consumer plan was built): every downstream
+                             column reference was checked against a
+                             stale schema
+      view-dist              the view's current materialization arrived
+                             sharded (1D) where the defining plan's
+                             root is abstractly REP — the fusion-input-
+                             dist failure class at the view boundary
+    """
+    vw = sys.modules.get("bodo_tpu.runtime.views")
+    if vw is None:
+        return
+    try:
+        v = vw._get(node.name)
+    except Exception:  # noqa: BLE001 — ViewError(ValueError)
+        _err(node, path, "unknown-view",
+             f"ViewScan references unregistered view {node.name!r}")
+        return
+    try:
+        srcs = vw.base_sources(node.name)
+    except Exception:  # noqa: BLE001
+        srcs = None
+    if srcs is None:
+        _err(node, path, "unsigned-view-sources",
+             f"view {node.name!r} has an unsignable leaf in its "
+             f"defining plan: the result cache cannot sign or "
+             f"invalidate entries built over this ViewScan")
+    if list(node.schema) != list(v.schema):
+        _err(node, path, "view-schema-drift",
+             f"ViewScan snapshotted schema {sorted(node.schema)} "
+             f"disagrees with live view {node.name!r} schema "
+             f"{sorted(v.schema)} — the view was redefined after this "
+             f"consumer plan was built")
+    # materialization consistency: the defining plan's root caches its
+    # last materialized Table in root._cached between refreshes
+    cached = getattr(v.root, "_cached", None)
+    if cached is not None and \
+            getattr(cached, "distribution", None) == "1D" and \
+            dist_of(v.root) == REP:
+        _err(node, path, "view-dist",
+             f"view {node.name!r} materialization is sharded (1D) but "
+             f"its defining plan's root is abstractly REP — the "
+             f"materializing kernel and the lattice disagree")
+
+
 def _check_node(node, path: str) -> None:
     name = type(node).__name__
     if name in ("ReadParquet", "ReadCsv", "FromPandas"):
         if node.children:
             _err(node, path, "arity", f"{name} must be a leaf")
+        return
+    if name == "ViewScan":
+        if node.children:
+            _err(node, path, "arity", "ViewScan must be a leaf")
+        _check_view_scan(node, path)
         return
     kids = node.children
     if name == "Projection":

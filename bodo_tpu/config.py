@@ -618,6 +618,20 @@ class Config:
         default_factory=lambda: _env_float("BODO_TPU_LOCKSTEP_TIMEOUT",
                                            10.0)
     )
+    # progcheck (analysis/progcheck.py): jaxpr-level verification of
+    # every registered program — collective-manifest extraction +
+    # rank-invariance, donation/aliasing audit, static HBM peak
+    # estimation. Default on (one trace walk per distinct program);
+    # violations warn-and-record unless progcheck_enforce raises them
+    # as ProgramInvariantError at registration. set_config exports both
+    # so spawned workers inherit the posture.
+    progcheck: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_PROGCHECK", True)
+    )
+    progcheck_enforce: bool = field(
+        default_factory=lambda: _env_bool("BODO_TPU_PROGCHECK_ENFORCE",
+                                          False)
+    )
 
 
 config = Config()
@@ -721,6 +735,14 @@ def set_config(**kwargs) -> None:
                     os.environ["BODO_TPU_LOCKSTEP_DIR"] = v
                 else:
                     os.environ.pop("BODO_TPU_LOCKSTEP_DIR", None)
+        if k in ("progcheck", "progcheck_enforce"):
+            # export like lockstep so spawned workers inherit the
+            # verification posture
+            env_name = "BODO_TPU_" + k.upper()
+            if v:
+                os.environ[env_name] = "1"
+            else:
+                os.environ.pop(env_name, None)
         if k == "trace_events_max":
             # rebuild the ring buffer at the new capacity (keeps the
             # newest events)

@@ -355,6 +355,56 @@ def _triage_xla(bundle: str) -> Optional[dict]:
     return out
 
 
+def _triage_progcheck(bundle: str) -> Optional[dict]:
+    """Static-verifier triage: which programs carry violations (naming
+    the offending eqn path), which are rank-variant, and the largest
+    static HBM peak estimates. Reads the bundle's progcheck.json dump,
+    falling back to the per-program verdicts in xla_registry.json."""
+    pc = _read_json(os.path.join(bundle, "progcheck.json"))
+    out: dict = {}
+    if pc:
+        st = pc.get("stats") or {}
+        out["programs"] = st.get("programs", 0)
+        viols = []
+        for v in pc.get("violations") or []:
+            viols.append({"program": v.get("program"),
+                          "rule": v.get("rule"),
+                          "eqn": v.get("eqn"),
+                          "message": v.get("message")})
+        out["violations"] = viols
+        mans = pc.get("manifests") or {}
+        out["rank_variant"] = sorted(
+            p for p, m in mans.items()
+            if not m.get("rank_invariant", True))
+        hbm = sorted(((p, int(m.get("hbm_bytes", 0)))
+                      for p, m in mans.items()), key=lambda kv: -kv[1])
+        out["hbm_top"] = [{"program": p, "hbm_bytes": b}
+                          for p, b in hbm[:3] if b > 0]
+        return out if out.get("programs") else None
+    reg = _read_json(os.path.join(bundle, "xla_registry.json"))
+    if not reg:
+        return None
+    checked = [p for p in (reg.get("programs") or [])
+               if p.get("progcheck")]
+    if not checked:
+        return None
+    out["programs"] = len(checked)
+    out["violations"] = [
+        {"program": f"{p.get('subsystem')}:{p.get('base')}",
+         "rule": v.get("rule"), "eqn": v.get("eqn"), "message": ""}
+        for p in checked for v in p["progcheck"].get("violations", [])]
+    out["rank_variant"] = sorted(
+        f"{p.get('subsystem')}:{p.get('base')}" for p in checked
+        if not p["progcheck"].get("rank_invariant", True))
+    hbm = sorted(checked, key=lambda p: -int(
+        p["progcheck"].get("hbm_bytes", 0)))
+    out["hbm_top"] = [
+        {"program": f"{p.get('subsystem')}:{p.get('base')}",
+         "hbm_bytes": int(p["progcheck"].get("hbm_bytes", 0))}
+        for p in hbm[:3] if int(p["progcheck"].get("hbm_bytes", 0)) > 0]
+    return out
+
+
 def triage(bundle: str) -> dict:
     """Machine-readable triage of one flight-recorder bundle."""
     if not os.path.isdir(bundle):
@@ -391,6 +441,7 @@ def triage(bundle: str) -> dict:
     out["views"] = _triage_views(telem)
     out["elastic"] = _triage_elastic(bundle, manifest, telem)
     out["xla"] = _triage_xla(bundle)
+    out["progcheck"] = _triage_progcheck(bundle)
     slow = _read_json(os.path.join(bundle, "slow_queries.json")) or []
     out["slow_queries"] = [{"query_id": q.get("query_id"),
                             "wall_s": q.get("wall_s")} for q in slow]
@@ -628,6 +679,25 @@ def render(t: dict) -> str:
                 f"  donation: {don.get('verified', 0)} verified, "
                 f"{don['copied']} dispatches COPIED instead of "
                 f"donating (double memory on those inputs)")
+    pc = t.get("progcheck")
+    if pc:
+        lines.append("progcheck (static program verification):")
+        lines.append(f"  {pc.get('programs', 0)} programs verified")
+        for v in pc.get("violations", []):
+            lines.append(
+                f"  VIOLATION [{v.get('rule')}] program "
+                f"{v.get('program')!r} at {v.get('eqn') or '?'}"
+                + (f": {v['message']}" if v.get("message") else ""))
+        if pc.get("rank_variant"):
+            lines.append(
+                "  RANK-VARIANT programs (collective under "
+                "rank-derived control flow): "
+                + ", ".join(pc["rank_variant"]))
+        if pc.get("hbm_top"):
+            tops = ", ".join(
+                f"{h['program']} {_fmt_bytes(h['hbm_bytes'])}"
+                for h in pc["hbm_top"])
+            lines.append(f"  static HBM peak estimates: {tops}")
     if t.get("slow_queries"):
         lines.append("slow queries:")
         for q in t["slow_queries"]:

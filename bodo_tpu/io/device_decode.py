@@ -63,6 +63,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from bodo_tpu.analysis import progcheck
 from bodo_tpu.config import config
 from bodo_tpu.table import dtypes as dt
 from bodo_tpu.table.table import Column, REP, Table, round_capacity
@@ -723,6 +724,11 @@ def _run_page_program(spec: _PageSpec, page_bytes: bytes, n_values: int,
             _programs[spec] = fn
         out = fn(*args_in)
     if compiled:
+        h = _programs.handle_for(spec)
+        progcheck.check_jit(fn, args_in,
+                            program=f"device_decode:{spec.kind}",
+                            subsystem="device_decode", obs_handle=h)
+        progcheck.mark_checked(h)
         with _programs_lock:
             _programs.record_compile(f"device_decode:{spec.kind}",
                                      time.perf_counter() - t0,

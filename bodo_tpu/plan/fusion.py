@@ -107,6 +107,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from bodo_tpu.analysis import lockstep
+from bodo_tpu.analysis import progcheck
 from bodo_tpu.config import config
 from bodo_tpu.ops import kernels as K
 from bodo_tpu.parallel import collectives as C
@@ -658,6 +659,14 @@ def _run_chain(t: Table, steps, donate: bool = False) -> Table:
         lockstep.register_fusion_manifest(
             fp, _member_kinds(steps),
             1 if t.distribution == ONED and t.num_shards > 1 else 0)
+        # static verification BEFORE first dispatch: collective
+        # manifest + rank-invariance, donation audit, HBM estimate
+        progcheck.check_jit(
+            fn,
+            (t.device_data(), t.counts_device())
+            if t.distribution == ONED
+            else (t.device_data(), jnp.asarray(t.nrows)),
+            program=f"fused:{fp}", subsystem="fusion")
 
     # host-level fault point + composite-collective sequencing: the
     # fused program subsumes its members' dispatches, so the GROUP is
@@ -667,22 +676,25 @@ def _run_chain(t: Table, steps, donate: bool = False) -> Table:
         maybe_inject("collective")
         lockstep.pre_fused(fp)
 
+    from bodo_tpu.runtime import memory_governor as _mg
     t0 = _time.perf_counter()
     try:
-        if t.distribution == ONED:
-            out, cnts = fn(t.device_data(), t.counts_device())
-            counts = np.asarray(jax.device_get(cnts)).reshape(-1) \
-                .astype(np.int64)
-        else:
-            out, cnt = fn(t.device_data(), jnp.asarray(t.nrows))
-            counts = None
-            nrows = int(jax.device_get(cnt))
+        with _mg.preadmission_charge(f"fused:{fp}"):
+            if t.distribution == ONED:
+                out, cnts = fn(t.device_data(), t.counts_device())
+                counts = np.asarray(jax.device_get(cnts)).reshape(-1) \
+                    .astype(np.int64)
+            else:
+                out, cnt = fn(t.device_data(), jnp.asarray(t.nrows))
+                counts = None
+                nrows = int(jax.device_get(cnt))
     except Exception as e:  # noqa: BLE001 - classified below
         _classify_dispatch_error(e, fp_sig, compiled)
         raise FusionFallback(str(e)) from e
     dt_s = _time.perf_counter() - t0
     if compiled:
         _programs[sig] = fn
+        progcheck.mark_checked(_programs.handle_for(sig))
         _programs.record_compile("fused_stage", dt_s)
     if donate:
         _stats["donated"] += 1
@@ -850,10 +862,15 @@ def _run_fused_agg(t: Table, group: FusionGroup, donate: bool):
         fn = jax.jit(fused, donate_argnums=(0,) if donate else ())
         lockstep.register_fusion_manifest(
             fp, _member_kinds(steps, agg), 0)
+        progcheck.check_jit(
+            fn, (t.device_data(), jnp.asarray(t.nrows)),
+            program=f"fused:{fp}", subsystem="fusion")
+    from bodo_tpu.runtime import memory_governor as _mg
     t0 = _time.perf_counter()
     try:
-        out_keys, out_vals, ng = fn(t.device_data(),
-                                    jnp.asarray(t.nrows))
+        with _mg.preadmission_charge(f"fused:{fp}"):
+            out_keys, out_vals, ng = fn(t.device_data(),
+                                        jnp.asarray(t.nrows))
         nrows = int(jax.device_get(ng))
     except Exception as e:  # noqa: BLE001 - classified below
         from bodo_tpu.runtime import resilience
@@ -875,6 +892,7 @@ def _run_fused_agg(t: Table, group: FusionGroup, donate: bool):
     dt_s = _time.perf_counter() - t0
     if compiled:
         _programs[sig] = fn
+        progcheck.mark_checked(_programs.handle_for(sig))
         _programs.record_compile("fused_stage", dt_s)
     if donate:
         _stats["donated"] += 1

@@ -104,6 +104,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from bodo_tpu.analysis import lockstep
+from bodo_tpu.analysis import progcheck
 from bodo_tpu.config import config
 from bodo_tpu.ops import hashtable as HT
 from bodo_tpu.ops import kernels as K
@@ -377,7 +378,8 @@ def build_hash_table(right: Table, right_on, null_cols,
            tuple((c.dtype.name, c.valid is not None) for c in kcols),
            nk, bool(null_equal), T, tuple(null_cols))
     fn = _build_jit_cache.get(sig)
-    if fn is None:
+    built_fresh = fn is None
+    if built_fresh:
         ncols = tuple(null_cols)
 
         def bbody(arrays, count):
@@ -396,9 +398,20 @@ def build_hash_table(right: Table, right_on, null_cols,
         fn = jax.jit(bbody)
         _build_jit_cache[sig] = fn
     karrays = tuple((c.data, c.valid) for c in kcols)
+    if built_fresh:
+        # the cached slot-owner LUT outlives this dispatch: donation of
+        # any build input would leave the cache pointing at freed
+        # buffers, so "never donate" is a checked contract here
+        h = _build_jit_cache.handle_for(sig)
+        progcheck.check_jit(fn, (karrays, jnp.asarray(right.nrows)),
+                            program="joinbuild",
+                            subsystem="fusion_join",
+                            forbid_donation=True, obs_handle=h)
+        progcheck.mark_checked(h)
     bcodes, owner, bad = fn(karrays, jnp.asarray(right.nrows))
     _cstats["builds"] += 1
-    if bool(jax.device_get(bad)):
+    # the one budgeted sync per build MISS (dup-key verdict)
+    if bool(jax.device_get(bad)):  # dispatch-boundary
         _cstats["negative"] += 1
         _cache_put(key, None)
         return None
@@ -876,23 +889,33 @@ def _dispatch_chain(t, b, group, body, bargs, bspecs, out_names,
             fn = jax.jit(fused)
         _register_manifest(group, fp, multi, inprogram=False,
                            gather=build_inprogram)
+        if t.distribution == ONED:
+            _ck_args = (t.device_data(), t.counts_device(), bargs)
+        else:
+            _ck_args = (t.device_data(), jnp.asarray(t.nrows), bargs)
+        progcheck.check_jit(
+            fn, _ck_args, program=f"fused:{fp}", subsystem="fusion_join",
+            declared_collectives=(("all_gather",) if build_inprogram
+                                  else None) if multi else None)
 
+    from bodo_tpu.runtime import memory_governor as _mg
     w = _pre_dispatch(fp, multi)
     t0 = _time.perf_counter()
     try:
-        if t.distribution == ONED:
-            out, cnts, unres = fn(t.device_data(), t.counts_device(),
-                                  bargs)
-            cnts_h, unres_h = jax.device_get((cnts, unres))
-            counts = np.asarray(cnts_h).reshape(-1).astype(np.int64)
-            bad = bool(np.asarray(unres_h).any())
-        else:
-            out, cnt, unres = fn(t.device_data(), jnp.asarray(t.nrows),
-                                 bargs)
-            cnt_h, unres_h = jax.device_get((cnt, unres))
-            counts = None
-            nrows = int(cnt_h)
-            bad = bool(unres_h)
+        with _mg.preadmission_charge(f"fused:{fp}"):
+            if t.distribution == ONED:
+                out, cnts, unres = fn(t.device_data(),
+                                      t.counts_device(), bargs)
+                cnts_h, unres_h = jax.device_get((cnts, unres))  # dispatch-boundary
+                counts = np.asarray(cnts_h).reshape(-1).astype(np.int64)
+                bad = bool(np.asarray(unres_h).any())
+            else:
+                out, cnt, unres = fn(t.device_data(),
+                                     jnp.asarray(t.nrows), bargs)
+                cnt_h, unres_h = jax.device_get((cnt, unres))  # dispatch-boundary
+                counts = None
+                nrows = int(cnt_h)
+                bad = bool(unres_h)
     except Exception as e:  # noqa: BLE001 - classified below
         F._classify_dispatch_error(e, fp_sig, compiled)
         raise F.FusionFallback(str(e)) from e
@@ -900,6 +923,7 @@ def _dispatch_chain(t, b, group, body, bargs, bspecs, out_names,
     if compiled:
         F._programs[sig] = fn
         F._programs.record_compile("fused_join", dt_s)
+        progcheck.mark_checked(F._programs.handle_for(sig))
     if multi and build_inprogram:
         from bodo_tpu.parallel import comm
         comm.record_in_program(fp, bytes_in=comm.table_bytes(b),
@@ -1042,13 +1066,23 @@ def _dispatch_agg(t, b, group, body, bargs, bspecs, agg_plan,
                 out_specs=(P(ax), P(ax), P(ax), P(ax)), mesh=m))
             _register_manifest(group, fp, multi, inprogram=True,
                                gather=build_inprogram)
+            progcheck.check_jit(
+                fn, (t.device_data(), t.counts_device(), bargs),
+                program=f"fused:{fp}", subsystem="fusion_join",
+                declared_collectives=((("all_gather",)
+                                       if build_inprogram else ())
+                                      + ("all_to_all",))
+                if multi else None)
 
+        from bodo_tpu.runtime import memory_governor as _mg
         w = _pre_dispatch(fp, multi)
         t0 = _time.perf_counter()
         try:
-            res_out, ngs, ovf, unres = fn(
-                t.device_data(), t.counts_device(), bargs)
-            ngs_h, ovf_h, unres_h = jax.device_get((ngs, ovf, unres))
+            with _mg.preadmission_charge(f"fused:{fp}"):
+                res_out, ngs, ovf, unres = fn(
+                    t.device_data(), t.counts_device(), bargs)
+                ngs_h, ovf_h, unres_h = jax.device_get(  # dispatch-boundary
+                    (ngs, ovf, unres))
         except Exception as e:  # noqa: BLE001 - classified below
             F._classify_dispatch_error(e, fp_sig, compiled)
             raise F.FusionFallback(str(e)) from e
@@ -1056,6 +1090,7 @@ def _dispatch_agg(t, b, group, body, bargs, bspecs, agg_plan,
         if compiled:
             F._programs[sig] = fn
             F._programs.record_compile("fused_join", dt_s)
+            progcheck.mark_checked(F._programs.handle_for(sig))
         if multi:
             from bodo_tpu.parallel import comm
             comm.record_in_program(fp, bytes_in=comm.table_bytes(t),

@@ -418,6 +418,25 @@ def reserve(name: str, nbytes: int):
     return _cm()
 
 
+def preadmission_charge(program: str):
+    """Charge a compiled program's STATIC HBM peak estimate (the
+    progcheck liveness sweep) against the governor for the duration of
+    its dispatch. Pre-admission: when the budget is oversubscribed the
+    dispatch queues (or runs under a reduced grant and the stage's
+    OOM-retry envelope fires earlier) instead of discovering pressure
+    via RESOURCE_EXHAUSTED mid-flight. A no-op context when progcheck
+    has no estimate for the program, estimates are tiny, or the
+    governor is off — and re-entrancy-safe like reserve()."""
+    import contextlib
+    import sys
+
+    pc = sys.modules.get("bodo_tpu.analysis.progcheck")
+    est = pc.hbm_estimate(program) if pc is not None else None
+    if not est or est < _MIN_GRANT:
+        return contextlib.nullcontext()
+    return reserve(f"progcheck:{program}", int(est))
+
+
 def table_device_bytes(t) -> int:
     """Device bytes of a Table's columns (data + validity)."""
     n = 0

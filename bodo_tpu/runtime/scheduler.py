@@ -147,6 +147,10 @@ class AdmissionSignals:
     xla_budget_remaining: Optional[int] = None
     result_cache_occupancy: Optional[float] = None
     result_cache_pressure_sheds: Optional[int] = None
+    # static HBM peak (progcheck liveness sweep) of the largest verified
+    # program: admission sheds BEFORE trace when even the biggest known
+    # program wouldn't fit the governor's remaining headroom
+    progcheck_hbm_peak_bytes: Optional[int] = None
     # elastic capacity: <1.0 when the gang shrank after a rank loss —
     # the fleet admission twin scales the per-gang session quota (and
     # routing weight) by this instead of rejecting outright
@@ -314,6 +318,14 @@ def local_signals() -> AdmissionSignals:
             sig.oom_retries = int(st.get("n_oom_retries", 0))
         except Exception:  # noqa: BLE001
             pass
+    pc = _mod("bodo_tpu.analysis.progcheck")
+    if pc is not None:
+        try:
+            est = int(pc.max_hbm_estimate())
+            if est > 0:
+                sig.progcheck_hbm_peak_bytes = est
+        except Exception:  # noqa: BLE001
+            pass
     rc = _mod("bodo_tpu.runtime.result_cache")
     if rc is not None and sig.result_cache_occupancy is None:
         try:
@@ -381,6 +393,19 @@ class AdmissionController:
         pressure = self._pressure_event(sig)
         if pressure is not None:
             return Decision("shed", pressure, retry_after_s=base * 4)
+        # 1b) shed BEFORE trace when the statically-estimated peak of
+        #     the gang's largest verified program exceeds the governor's
+        #     remaining headroom: the query would compile, dispatch and
+        #     only then discover the pressure mid-flight
+        est = sig.progcheck_hbm_peak_bytes
+        if est and sig.governor_budget_bytes:
+            headroom = sig.governor_budget_bytes \
+                - int(sig.governor_granted_bytes or 0)
+            if est > headroom > 0 or headroom <= 0:
+                return Decision(
+                    "shed",
+                    f"progcheck_hbm_estimate={est}>headroom={headroom}",
+                    retry_after_s=base * 4)
         # 2) degrade on gang health: dead/hung ranks mean sharded
         #    results are at risk — only opted-in sessions proceed
         if sig.unhealthy_ranks:

@@ -673,6 +673,7 @@ def dump_bundle(reason: str, *, gang_dir: Optional[str] = None,
         _write_telemetry(d)
         _write_metrics(d)
         _write_xla(d)
+        _write_progcheck(d)
         _write_slow_queries(d)
         _write_stacks(d)
         _write_traces(d, gang_dir)
@@ -756,6 +757,22 @@ def _write_xla(d: str) -> None:
                     {"summary": ob.stats(),
                      "programs": ob.registry_dump(limit=200),
                      "leaks": ob.leak_check(collect=False)})
+    except Exception:
+        pass
+
+
+def _write_progcheck(d: str) -> None:
+    """Embed the static verifier's collective manifests + violations in
+    the bundle (doctor's progcheck triage section reads this; the
+    per-program verdicts ride in xla_registry.json too)."""
+    pc = _mod("bodo_tpu.analysis.progcheck")
+    if pc is None:
+        return
+    try:
+        _write_json(os.path.join(d, "progcheck.json"),
+                    {"stats": pc.stats(),
+                     "manifests": pc.reports(),
+                     "violations": pc.violations()})
     except Exception:
         pass
 

@@ -186,7 +186,8 @@ _CAUSE_BY_FACET = (
 class ProgramRecord:
     __slots__ = ("handle", "subsystem", "base", "facets", "compile_s",
                  "flops", "bytes_accessed", "dispatches", "created",
-                 "last_used", "donated", "retrace_cause", "alive")
+                 "last_used", "donated", "retrace_cause", "alive",
+                 "progcheck")
 
     def __init__(self, handle: int, subsystem: str, base: str,
                  facets: Dict[str, Any], donated: bool,
@@ -204,9 +205,10 @@ class ProgramRecord:
         self.donated = donated
         self.retrace_cause = retrace_cause
         self.alive = True
+        self.progcheck = None  # verifier verdict (note_progcheck)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "handle": self.handle, "subsystem": self.subsystem,
             "base": self.base,
             "facets": {k: repr(v)[:120] for k, v in self.facets.items()},
@@ -217,6 +219,9 @@ class ProgramRecord:
             "donated": self.donated,
             "retrace_cause": self.retrace_cause, "alive": self.alive,
         }
+        if self.progcheck is not None:
+            out["progcheck"] = self.progcheck
+        return out
 
 
 _records: "OrderedDict[int, ProgramRecord]" = OrderedDict()
@@ -297,6 +302,19 @@ def note_compile(handle: int, seconds: float) -> None:
         rec = _records.get(handle)
         if rec is not None:
             rec.compile_s += float(seconds)
+
+
+def note_progcheck(handle: int, info: dict) -> None:
+    """Attach the static verifier's verdict (analysis/progcheck.py) to
+    a registered executable: collective manifest, rank-invariance,
+    static HBM peak, violations. Flows into registry dumps and
+    flight-recorder bundles, where doctor's triage reads it."""
+    if not handle:
+        return
+    with _lock:
+        rec = _records.get(handle)
+        if rec is not None:
+            rec.progcheck = dict(info)
 
 
 def note_cost(handle: int, flops: float = 0.0,
@@ -421,7 +439,11 @@ def facets_from_leaves(struct: Any, leaf_keys: Tuple) -> Dict[str, Any]:
 
 _live: Dict[int, Tuple[int, Optional[str], str]] = {}  # id -> (nbytes, qid, op)
 _ledger = {"created_bytes": 0, "freed_bytes": 0,
-           "created_buffers": 0, "freed_buffers": 0}
+           "created_buffers": 0, "freed_buffers": 0,
+           # high-water mark of live tracked bytes — what progcheck's
+           # static HBM estimates are judged against (bench.py's
+           # progcheck_hbm_estimate_ratio)
+           "peak_live_bytes": 0}
 _by_op: Dict[str, Dict[str, int]] = {}
 _MAX_QUERY_REPORTS = 256
 _by_query: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
@@ -499,6 +521,9 @@ def track_buffer(arr: Any, op: str,
         _live[key] = (nbytes, qid, op)
         _ledger["created_bytes"] += nbytes
         _ledger["created_buffers"] += 1
+        live = _ledger["created_bytes"] - _ledger["freed_bytes"]
+        if live > _ledger["peak_live_bytes"]:
+            _ledger["peak_live_bytes"] = live
         ops = _by_op.setdefault(op, {"created_bytes": 0,
                                      "freed_bytes": 0,
                                      "live_buffers": 0})
@@ -621,6 +646,7 @@ def ledger_stats() -> dict:
             - _ledger["freed_bytes"],
             "created_buffers": _ledger["created_buffers"],
             "freed_buffers": _ledger["freed_buffers"],
+            "peak_live_bytes": _ledger["peak_live_bytes"],
             "live_buffers": len(_live),
             "by_op": {k: dict(v) for k, v in _by_op.items()},
             "donation": dict(_donation),
@@ -655,8 +681,15 @@ def stats() -> dict:
             s["alive"] += 1 if r.alive else 0
             s["compile_s"] += r.compile_s
             s["dispatches"] += r.dispatches
+        pc_programs = pc_violations = 0
+        for r in _records.values():
+            if r.progcheck is not None:
+                pc_programs += 1
+                pc_violations += len(r.progcheck.get("violations", ()))
         summary = {
             "executables": len(_records), "alive": alive,
+            "progcheck": {"programs": pc_programs,
+                          "violations": pc_violations},
             "compiles": _totals["compiles"],
             "dispatches": _totals["dispatches"],
             "evicted": _totals["evicted"],

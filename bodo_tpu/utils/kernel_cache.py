@@ -67,11 +67,24 @@ class KernelCache:
                               donated=bool(facets.get("donate")))
             self._handles[key] = h
             self.last_handle = h
+            # every traceable program entering a registered cache gets
+            # a progcheck proxy: its first dispatch through the cache
+            # verifies the jaxpr (collective manifest, donation audit,
+            # HBM estimate) against the real call args
+            value = self._progcheck_wrap(value, base, h)
         self._d[key] = value
         while len(self._d) > self.maxsize:
             k, _ = self._d.popitem(last=False)
             self.evictions += 1
             _obs.mark_evicted(self._handles.pop(k, 0))
+
+    def _progcheck_wrap(self, value, base, handle):
+        if not hasattr(value, "trace") or not callable(value):
+            return value
+        from bodo_tpu.analysis import progcheck
+        return progcheck.wrap_program(
+            value, program=f"{self.subsystem}:{base}",
+            subsystem=self.subsystem, obs_handle=handle)
 
     def __contains__(self, key):
         return key in self._d
@@ -183,8 +196,11 @@ def cached_builder(subsystem: str, maxsize: int = 256):
             key = (args, tuple(sorted(kwargs.items())))
             fn = cache.get(key)
             if fn is None:
-                fn = fun(*args, **kwargs)
-                cache[key] = fn
+                cache[key] = fun(*args, **kwargs)
+                # hand back the cache's entry (the progcheck proxy for
+                # traceable programs) so even the building call's first
+                # dispatch is verified
+                fn = cache.get(key)
             return fn
 
         wrapper.cache = cache
@@ -250,6 +266,13 @@ def bounded_jit(fun=None, *, static_argnames=(), maxsize=None):
         if fn is None:
             fn = jax.jit(fun, static_argnames=static_argnames)
             cache[key] = fn
+            # verify BEFORE timing so the recorded compile cost stays a
+            # pure trace+lower+compile measurement
+            from bodo_tpu.analysis import progcheck
+            progcheck.check_jit(fn, args, kwargs,
+                                program=f"bounded_jit:{fun.__name__}",
+                                subsystem="bounded_jit",
+                                obs_handle=cache.handle_for(key))
             # first invocation pays trace+lower+compile: record it as
             # this program's compile cost (bodo_tpu_jit_compile_seconds)
             t0 = time.perf_counter()

@@ -758,6 +758,42 @@ def sync_engine_metrics() -> None:
             ls.get("max_wait_s", 0.0))
     except Exception:  # pragma: no cover
         pass
+    # -- progcheck (jaxpr-level SPMD program verifier; lazy-module rule:
+    # nothing to report until a registration point has imported it) ----------
+    pc = sys.modules.get("bodo_tpu.analysis.progcheck")
+    if pc is not None:
+        try:
+            ps = pc.stats()
+            gauge("bodo_tpu_progcheck_programs_total",
+                  "programs statically verified at registration").set(
+                ps.get("programs", 0))
+            gauge("bodo_tpu_progcheck_violations_total",
+                  "program invariant violations found").set(
+                ps.get("violations", 0))
+            gauge("bodo_tpu_progcheck_skipped_total",
+                  "programs whose trace could not be reproduced").set(
+                ps.get("skipped", 0))
+            gauge("bodo_tpu_progcheck_check_seconds",
+                  "cumulative verification wall seconds").set(
+                ps.get("check_s", 0.0))
+            gauge("bodo_tpu_progcheck_max_check_seconds",
+                  "worst single program verification seconds").set(
+                ps.get("max_check_s", 0.0))
+            gauge("bodo_tpu_progcheck_manifests_total",
+                  "collective manifests extracted and registered").set(
+                ps.get("manifests", 0))
+            gauge("bodo_tpu_progcheck_hbm_peak_bytes_max",
+                  "largest static HBM peak estimate across programs").set(
+                ps.get("hbm_peak_bytes_max", 0))
+            gauge("bodo_tpu_progcheck_rank_variant_programs",
+                  "programs with a collective under rank-derived "
+                  "control flow").set(
+                ps.get("rank_variant_programs", 0))
+            gauge("bodo_tpu_progcheck_enforce",
+                  "1 when violations raise instead of warn").set(
+                ps.get("enforce", 0))
+        except Exception:  # pragma: no cover
+            pass
     # -- communication observatory (parallel/comm.py is stdlib-safe) ---------
     try:
         from bodo_tpu.parallel import comm

@@ -481,7 +481,10 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
     compute); shardcheck contributes `lint:*` counters (plans
     validated/violations, lint findings) and a time-valued
     `lockstep:check` row (dispatches fingerprinted + peer-wait seconds)
-    plus `lockstep:mismatches`/`lockstep:timeouts`; whole-stage fusion
+    plus `lockstep:mismatches`/`lockstep:timeouts`; the static program
+    verifier contributes a time-valued `progcheck:check` row (programs
+    verified + verification seconds + the largest static HBM peak
+    estimate) and a `progcheck:violations` counter; whole-stage fusion
     contributes `fusion:*` counter rows plus `fusion:cache`
     (hit/miss) and a time-valued `fusion:compile` row; the comm
     observatory contributes per-collective `comm:<op>` rows carrying
@@ -641,6 +644,25 @@ def profile(query_id: Optional[str] = None) -> Dict[str, dict]:
             "max_s": series("bodo_tpu_lockstep_max_wait_seconds").get(
                 (), 0.0),
             "rows": 0}
+    # time-valued progcheck row: programs statically verified at
+    # registration + verification wall seconds, and the violation
+    # counter when any invariant failed
+    pcn = series("bodo_tpu_progcheck_programs_total").get((), 0)
+    if pcn:
+        out["progcheck:check"] = {
+            "count": int(pcn),
+            "total_s": series("bodo_tpu_progcheck_check_seconds").get(
+                (), 0.0),
+            "max_s": series(
+                "bodo_tpu_progcheck_max_check_seconds").get((), 0.0),
+            "rows": 0,
+            "hbm_peak_bytes_max": int(series(
+                "bodo_tpu_progcheck_hbm_peak_bytes_max").get((), 0))}
+        pcv = series("bodo_tpu_progcheck_violations_total").get((), 0)
+        if pcv:
+            out["progcheck:violations"] = {
+                "count": int(pcv), "total_s": 0.0, "max_s": 0.0,
+                "rows": 0}
     # comm observatory: one row per collective op with the bytes moved
     # and the wall/peer-wait split (parallel/comm.py accounting)
     cd = series("bodo_tpu_comm_dispatches_total")

@@ -23,7 +23,12 @@ bare non-zero rc.
 
 The full-suite run also gates on the shardcheck SPMD lint
 (`python -m bodo_tpu.analysis`): any finding that is neither suppressed
-inline nor in analysis/baseline.json fails the run.
+inline nor in analysis/baseline.json fails the run — as do DEAD
+baseline entries (prune with `--prune-baseline`). It additionally
+gates on the progcheck self-check
+(`python -m bodo_tpu.analysis --programs`): one representative program
+per family is traced and its collective manifest / donation / HBM
+passes must verify clean.
 
 Usage:
     python runtests.py              # whole suite + shardcheck lint
@@ -119,6 +124,26 @@ def _run_lint() -> int:
     return r.returncode
 
 
+def _run_progcheck() -> int:
+    """Static program verification self-check: trace one representative
+    program per family, extract collective manifests, and fail on any
+    invariant violation (analysis/progcheck.py)."""
+    print("[progcheck] python -m bodo_tpu.analysis --programs ... ",
+          end="", flush=True)
+    t1 = time.time()
+    r = subprocess.run([sys.executable, "-m", "bodo_tpu.analysis",
+                        "--programs"],
+                       cwd=_REPO, capture_output=True, text=True,
+                       timeout=300,
+                       env={**os.environ, "JAX_PLATFORMS":
+                            os.environ.get("JAX_PLATFORMS", "cpu")})
+    tail = (r.stdout.strip().splitlines() or [""])[-1]
+    print(f"{tail}  ({time.time() - t1:.0f}s)")
+    if r.returncode != 0:
+        sys.stdout.write(r.stdout[-4000:] + r.stderr[-2000:] + "\n")
+    return r.returncode
+
+
 def _run_benchwatch() -> int:
     """Bench-trajectory regression gate: validates every BENCH_r*.json
     against the stable schema and fails on a direction-aware regression
@@ -159,6 +184,8 @@ def main(argv: list[str]) -> int:
         if _run_lint() != 0:
             failed.append("lint")
     if full_suite:
+        if _run_progcheck() != 0:
+            failed.append("progcheck")
         if _run_benchwatch() != 0:
             failed.append("benchwatch")
     for i, group in enumerate(groups):

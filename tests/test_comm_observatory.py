@@ -233,6 +233,79 @@ class TestCriticalPath:
         assert critical_path.straggler(one) is None  # needs 2 ranks
 
 
+class TestCriticalPathDegenerate:
+    """Degenerate triage inputs must yield a compute-only verdict (or
+    None), never raise — doctor runs over whatever a dying gang managed
+    to flush."""
+
+    def test_single_rank_compute_only(self):
+        from bodo_tpu.analysis import critical_path
+        tr = {"ranks": [0], "query_ids": ["q1"], "traceEvents": [
+            {"name": "scan", "ph": "X", "ts": 0, "dur": 40, "pid": 0,
+             "args": {"query_id": "q1"}},
+            {"name": "agg", "ph": "X", "ts": 40, "dur": 10, "pid": 0,
+             "args": {"query_id": "q1"}},
+        ]}
+        cp = critical_path.critical_path(tr, "q1")
+        assert cp["comm_us"] == 0.0
+        assert cp["comm_frac"] == 0.0
+        assert all(p["kind"] == "compute" for p in cp["path"])
+        a = critical_path.analyze(tr)
+        assert a["straggler"] is None       # one rank: nothing to skew
+        assert a["comm_ops"] == {}
+        assert a["overall"]["comm_frac"] == 0.0
+        json.dumps(a)
+
+    def test_zero_comm_spans_multi_rank(self):
+        from bodo_tpu.analysis import critical_path
+        tr = {"ranks": [0, 1], "traceEvents": [
+            {"name": "scan", "ph": "X", "ts": 0, "dur": 30, "pid": 0},
+            {"name": "scan", "ph": "X", "ts": 0, "dur": 35, "pid": 1},
+        ]}
+        a = critical_path.analyze(tr)
+        assert a["straggler"] is None       # no comm spans, no waits
+        assert a["overall"]["comm_us"] == 0.0
+        assert a["overall"]["comm_frac"] == 0.0
+
+    def test_zero_duration_events(self):
+        from bodo_tpu.analysis import critical_path
+        tr = {"traceEvents": [
+            {"name": "mark", "ph": "X", "ts": 5, "dur": 0, "pid": 0}]}
+        cp = critical_path.critical_path(tr)
+        assert cp is not None
+        assert cp["comm_frac"] == 0.0       # total==0 guard, no divide
+        assert cp["wall_us"] == 0.0
+
+    def test_unknown_query_id(self):
+        from bodo_tpu.analysis import critical_path
+        tr = _synthetic_trace()
+        assert critical_path.critical_path(tr, "nope") is None
+        tr2 = dict(tr, query_ids=["q1", "nope"])
+        a = critical_path.analyze(tr2)
+        assert set(a["queries"]) == {"q1"}  # absent query just skipped
+
+    def test_two_field_lockstep_lines_no_comm_triage(self, tmp_path):
+        """Legacy 2-field `seq\\tfingerprint` lockstep lines carry no
+        arrival stamps: fingerprint triage still works, arrival-skew
+        attribution degrades to None instead of raising."""
+        from bodo_tpu import doctor
+        d = str(tmp_path / "bundle_2f")
+        os.makedirs(d)
+        for rank in (0, 1):
+            with open(os.path.join(d, f"lockstep_{rank}.log"),
+                      "w") as f:
+                f.write("1\tpsum@q.py:7\n2\tall_gather@q.py:9\n"
+                        "garbage line without tabs\n"
+                        "notanint\tx@y:1\n")
+        logs, arrivals = doctor._parse_lockstep_logs(d)
+        assert logs[0] == {1: "psum@q.py:7", 2: "all_gather@q.py:9"}
+        assert arrivals == {0: {}, 1: {}}
+        assert doctor._triage_comm(logs, arrivals) is None
+        t = doctor.triage(d)
+        assert t["comm"] is None
+        assert t["lockstep"]["head"] == 2
+
+
 # ------------------------------------------------- EXPLAIN ANALYZE
 
 class TestExplainComm:

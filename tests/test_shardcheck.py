@@ -231,6 +231,80 @@ class TestValidatorSweep:
         assert plan_validator.stats()["violations"] == 0
 
 
+class TestViewScanValidator:
+    """ViewScan leaf rules: signed transitive sources, schema/dist
+    consistency with the parent view's materialization."""
+
+    @pytest.fixture
+    def view(self, mesh8):
+        from bodo_tpu.runtime import views
+        views.create_view("pv_daily",
+                          L.Aggregate(_src(), ["k"],
+                                      [("v", "sum", "vs")]))
+        yield views
+        for name in list(views.list_views()):
+            if name.startswith("pv_"):
+                views.drop_view(name)
+
+    def test_valid_view_scan(self, view):
+        scan = view.scan_node("pv_daily")
+        assert validate_plan(scan) == DIST
+        # composes like any leaf
+        assert validate_plan(L.Limit(scan, 3)) == REP
+
+    def test_unknown_view(self, view):
+        bad = L.ViewScan("pv_nope", {"k": None})
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(bad)
+        assert ei.value.rule == "unknown-view"
+        assert "pv_nope" in str(ei.value)
+
+    def test_non_leaf_rejected(self, view):
+        scan = view.scan_node("pv_daily")
+        scan.children = [view.scan_node("pv_daily")]
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(scan)
+        assert ei.value.rule == "arity"
+
+    def test_schema_drift_after_redefine(self, view):
+        """A scan minted before the view was redefined carries a stale
+        schema: downstream column refs were checked against it."""
+        scan = view.scan_node("pv_daily")
+        view.drop_view("pv_daily")
+        view.create_view("pv_daily",
+                         L.Aggregate(_src(), ["k"],
+                                     [("v", "mean", "vm")]))
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(scan)
+        assert ei.value.rule == "view-schema-drift"
+
+    def test_unsigned_sources_rejected(self, view, monkeypatch):
+        scan = view.scan_node("pv_daily")
+        monkeypatch.setattr(view, "base_sources", lambda name: None)
+        with pytest.raises(PlanInvariantError) as ei:
+            validate_plan(scan)
+        assert ei.value.rule == "unsigned-view-sources"
+
+    def test_materialization_dist_consistency(self, view):
+        """A sharded materialization under an abstractly-REP defining
+        root is the fusion-input-dist failure class at the view edge."""
+        from types import SimpleNamespace
+        view.create_view("pv_rep", L.Limit(_src(), 4))  # root is REP
+        scan = view.scan_node("pv_rep")
+        assert validate_plan(scan) == DIST  # no materialization yet
+        v = view._get("pv_rep")
+        v.root._cached = SimpleNamespace(distribution="1D")
+        try:
+            with pytest.raises(PlanInvariantError) as ei:
+                validate_plan(scan)
+            assert ei.value.rule == "view-dist"
+            # a REP materialization is consistent
+            v.root._cached = SimpleNamespace(distribution="REP")
+            assert validate_plan(scan) == DIST
+        finally:
+            v.root._cached = None
+
+
 # ---------------------------------------------------------------------------
 # layer 2: codebase lint
 # ---------------------------------------------------------------------------
@@ -529,6 +603,45 @@ class TestLint:
         """)
         assert got == []
 
+    def test_stream_sync_rule_covers_fusion_join(self, tmp_path):
+        # plan/fusion_join.py is whole-module in scope: every
+        # unannotated sync is a finding regardless of function name
+        d = tmp_path / "plan"
+        d.mkdir()
+        p = d / "fusion_join.py"
+        p.write_text(textwrap.dedent("""
+            import jax
+
+            def anything_at_all(x):
+                return int(jax.device_get(x))
+        """))
+        got = lint.lint_file(str(p), root=str(tmp_path))
+        assert [f.rule for f in got] == ["stream-sync-unannotated"]
+
+    def test_stream_sync_rule_covers_views_maintenance(self, tmp_path):
+        # runtime/views.py is scoped: only step/maintenance/refresh/
+        # materialize-named bodies are in scope; other functions are not
+        d = tmp_path / "runtime"
+        d.mkdir()
+        p = d / "views.py"
+        p.write_text(textwrap.dedent("""
+            import jax
+
+            def maintenance_tick(sched):
+                return int(jax.device_get(sched))
+
+            def _materialize(v):
+                v.block_until_ready()
+                return v
+
+            def unrelated_helper(x):
+                return int(jax.device_get(x))
+        """))
+        got = lint.lint_file(str(p), root=str(tmp_path))
+        assert sorted((f.rule, f.func) for f in got) == [
+            ("stream-sync-unannotated", "_materialize"),
+            ("stream-sync-unannotated", "maintenance_tick")]
+
     def test_baseline_roundtrip(self, tmp_path, monkeypatch, capsys):
         mod = tmp_path / "legacy.py"
         mod.write_text(textwrap.dedent("""
@@ -560,6 +673,53 @@ class TestLint:
         assert lint.main([]) == 0
         out = capsys.readouterr().out
         assert "0 new" in out
+
+    def test_dead_baseline_entry_fails_and_prunes(self, tmp_path,
+                                                  capsys):
+        """A baseline entry no current finding matches fails the
+        full-package gate; --prune-baseline removes it and the gate
+        goes green again."""
+        import json as _json
+        base = str(tmp_path / "base.json")
+        with open(base, "w") as fh:
+            _json.dump([{"rule": "rank-divergent-collective",
+                         "file": "bodo_tpu/no_such_module.py",
+                         "func": "f", "text": "psum(x)"}], fh)
+        assert lint.main(["--baseline", base]) == 1
+        out = capsys.readouterr().out
+        assert "DEAD baseline entry" in out
+        assert "1 dead baseline entries" in out
+        assert lint.main(["--baseline", base,
+                          "--prune-baseline"]) == 0
+        assert "pruned 1 dead" in capsys.readouterr().out
+        assert lint.main(["--baseline", base]) == 0
+        capsys.readouterr()
+
+    def test_prune_baseline_requires_full_package_run(self, tmp_path,
+                                                      capsys):
+        """Partial-path prune would read unscanned files' entries as
+        falsely dead and delete them — refused."""
+        mod = tmp_path / "ok.py"
+        mod.write_text("x = 1\n")
+        base = str(tmp_path / "base.json")
+        assert lint.main([str(mod), "--baseline", base,
+                          "--prune-baseline"]) == 1
+        assert "full-package" in capsys.readouterr().out
+
+    def test_dead_gate_skipped_for_partial_paths(self, tmp_path,
+                                                 capsys):
+        """Entries for unscanned files must not read as dead on a
+        partial-path run."""
+        import json as _json
+        mod = tmp_path / "ok.py"
+        mod.write_text("x = 1\n")
+        base = str(tmp_path / "base.json")
+        with open(base, "w") as fh:
+            _json.dump([{"rule": "rank-divergent-collective",
+                         "file": "bodo_tpu/other.py",
+                         "func": "f", "text": "psum(x)"}], fh)
+        assert lint.main([str(mod), "--baseline", base]) == 0
+        assert "DEAD" not in capsys.readouterr().out
 
 
 # ---------------------------------------------------------------------------
